@@ -1,0 +1,33 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+A ground-up rebuild of the capabilities of Ray (reference at
+/root/reference) designed TPU-first: tasks/actors/objects over a
+shared-memory object store and lease-based scheduling; gang scheduling via
+placement groups; a JaxTrainer whose train steps are pjit/shard_map XLA
+programs over ICI meshes; and an RL stack (PPO/IMPALA) whose learners are
+JIT'd JAX programs while CPU EnvRunner actors stream trajectories through
+the object store.
+
+Importing ray_tpu is deliberately jax-free and fast; ML subpackages
+(ray_tpu.train, ray_tpu.rllib, ray_tpu.parallel, ray_tpu.models) import jax
+lazily on first use.
+"""
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from ray_tpu.api import (available_resources, cancel, cluster_resources,  # noqa: F401
+                         free, get, get_gcs_address, get_runtime_context,
+                         init, is_initialized, kill, nodes, put, remote,
+                         shutdown, wait)
+from ray_tpu.remote_function import RemoteFunction  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ObjectRef", "ActorClass", "ActorHandle", "get_actor", "remote", "init",
+    "shutdown", "is_initialized", "get", "put", "wait", "kill", "cancel",
+    "free", "nodes", "cluster_resources", "available_resources",
+    "get_gcs_address", "get_runtime_context", "exceptions", "RemoteFunction",
+    "__version__",
+]

@@ -1,0 +1,38 @@
+"""Accelerator plugin registry.
+
+reference parity: python/ray/_private/accelerators/__init__.py — pluggable
+per-family AcceleratorManager classes; here TPU is first-class and NVIDIA is
+a stub kept only for API-shape parity (this framework is CUDA-free).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+_MANAGERS: List[Type[AcceleratorManager]] = [TPUAcceleratorManager]
+
+
+def get_all_accelerator_managers() -> List[Type[AcceleratorManager]]:
+    return list(_MANAGERS)
+
+
+def get_accelerator_manager(resource_name: str) -> Type[AcceleratorManager]:
+    for mgr in _MANAGERS:
+        if mgr.get_resource_name() == resource_name:
+            return mgr
+    raise KeyError(f"no accelerator manager for resource '{resource_name}'")
+
+
+def detect_node_accelerators() -> Dict[str, float]:
+    """Autodetect accelerator resources on this node, including pod-slice
+    custom resources (reference tpu.py:335-398)."""
+    resources: Dict[str, float] = {}
+    for mgr in _MANAGERS:
+        n = mgr.get_current_node_num_accelerators()
+        if n > 0:
+            resources[mgr.get_resource_name()] = float(n)
+            resources.update(mgr.get_current_node_additional_resources())
+    return resources

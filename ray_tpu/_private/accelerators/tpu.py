@@ -1,0 +1,140 @@
+"""TPU accelerator manager: chip discovery, visibility, pod-slice resources.
+
+reference parity: python/ray/_private/accelerators/tpu.py:75-398
+(TPUAcceleratorManager) — chip detection via /dev/accel* or /dev/vfio
+(tpu.py:110-117), TPU_VISIBLE_CHIPS + TPU_CHIPS_PER_HOST_BOUNDS /
+TPU_HOST_BOUNDS env plumbing for 1/2/4-chip slicing (tpu.py:157-196),
+pod type from GCE metadata / GKE env (tpu.py:199-229), and the
+`{tpu_name: 1, "TPU-<type>-head": 1}` pod-slice custom resources on worker 0
+used for multi-host SPMD gang targeting (tpu.py:335-398).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+
+logger = logging.getLogger(__name__)
+
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+TPU_CHIPS_PER_HOST_BOUNDS_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"
+TPU_HOST_BOUNDS_ENV = "TPU_HOST_BOUNDS"
+TPU_SINGLE_HOST_BOUNDS = "1,1,1"
+# Valid per-task chip slices on one host (reference tpu.py:13,143-155).
+VALID_TPU_CHIP_COUNTS = (1, 2, 4)
+# Test hook: pretend this many chips exist (the chip-free fake ladder).
+TPU_FAKE_CHIPS_ENV = "RAY_TPU_FAKE_NUM_CHIPS"
+TPU_FAKE_POD_TYPE_ENV = "RAY_TPU_FAKE_POD_TYPE"
+TPU_FAKE_WORKER_ID_ENV = "RAY_TPU_FAKE_WORKER_ID"
+
+_CHIPS_PER_HOST_BOUNDS = {1: "1,1,1", 2: "1,2,1", 4: "2,2,1"}
+
+
+class TPUAcceleratorManager(AcceleratorManager):
+    @staticmethod
+    def get_resource_name() -> str:
+        return "TPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return TPU_VISIBLE_CHIPS_ENV
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        fake = os.environ.get(TPU_FAKE_CHIPS_ENV)
+        if fake is not None:
+            return int(fake)
+        # reference tpu.py:110-117: count /dev/accel* (PCIe) or vfio devices.
+        accel = glob.glob("/dev/accel*")
+        if accel:
+            return len(accel)
+        try:
+            vfio = [e for e in os.listdir("/dev/vfio") if e != "vfio"]
+            return len(vfio)
+        except FileNotFoundError:
+            return 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        pod_type = TPUAcceleratorManager._get_tpu_pod_type()
+        if pod_type is None:
+            return None
+        # 'v5p-16' -> 'TPU-V5P'
+        return "TPU-" + pod_type.split("-")[0].upper()
+
+    @staticmethod
+    def _get_tpu_pod_type() -> Optional[str]:
+        # GKE env, fake env, or GCE metadata (reference tpu.py:199-229; the
+        # metadata server is unreachable in tests so env wins).
+        for var in (TPU_FAKE_POD_TYPE_ENV, "TPU_ACCELERATOR_TYPE"):
+            v = os.environ.get(var)
+            if v:
+                return v
+        return None
+
+    @staticmethod
+    def _get_tpu_worker_id() -> Optional[int]:
+        for var in (TPU_FAKE_WORKER_ID_ENV, "TPU_WORKER_ID"):
+            v = os.environ.get(var)
+            if v is not None:
+                try:
+                    return int(v)
+                except ValueError:
+                    return None
+        return None
+
+    @staticmethod
+    def _get_tpu_name() -> Optional[str]:
+        return os.environ.get("TPU_NAME")
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        """Pod-slice resources for multi-host gangs: every host of slice
+        `name` gets {name: 1}; worker 0 additionally gets
+        {"TPU-<pod_type>-head": 1} so a trainer can target one actor per
+        slice head (reference tpu.py:335-398)."""
+        resources: Dict[str, float] = {}
+        name = TPUAcceleratorManager._get_tpu_name()
+        pod_type = TPUAcceleratorManager._get_tpu_pod_type()
+        worker_id = TPUAcceleratorManager._get_tpu_worker_id()
+        if name:
+            resources[name] = 1.0
+        if pod_type is not None and worker_id == 0:
+            resources[f"TPU-{pod_type}-head"] = 1.0
+        return resources
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float
+                                           ) -> Tuple[bool, Optional[str]]:
+        if quantity != int(quantity) or int(quantity) not in \
+                VALID_TPU_CHIP_COUNTS:
+            # >4 means multi-host: must use whole hosts (reference
+            # tpu.py:143-155 allows only 1, 2 or 4 chips per request).
+            if quantity == int(quantity) and int(quantity) % 4 == 0:
+                return (True, None)
+            return (False,
+                    f"TPU request must be 1, 2, 4 or a multiple of 4 chips, "
+                    f"got {quantity}")
+        return (True, None)
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[List[str]]:
+        v = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if v is None:
+            return None
+        return [s for s in v.split(",") if s]
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: List[str]) -> None:
+        """Set chip visibility + topology bounds env for subprocesses
+        (reference tpu.py:157-196: libtpu needs the host/chip bounds to
+        carve a sub-host topology)."""
+        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(i) for i in ids)
+        n = len(ids)
+        if n in _CHIPS_PER_HOST_BOUNDS and n != 4:
+            os.environ[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = _CHIPS_PER_HOST_BOUNDS[n]
+            os.environ[TPU_HOST_BOUNDS_ENV] = TPU_SINGLE_HOST_BOUNDS

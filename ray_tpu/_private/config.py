@@ -1,0 +1,51 @@
+"""Runtime config flags, overridable via RAY_TPU_<NAME> env vars.
+
+reference parity: src/ray/common/ray_config_def.h — a single X-macro list of
+RAY_CONFIG(type, name, default) entries, each overridable by env var. Same
+idea here with a plain registry.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _define(name: str, default: Any, cast: Callable[[str], Any]) -> Any:
+    env = os.environ.get(f"RAY_TPU_{name}")
+    value = cast(env) if env is not None else default
+    _REGISTRY[name] = value
+    return value
+
+
+def _bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes")
+
+
+class Config:
+    # Object store
+    object_store_capacity_bytes = _define(
+        "object_store_capacity_bytes", 4 << 30, int)
+    # Below this size task returns / puts are inlined into the owner's
+    # in-process memory store (reference: max_direct_call_object_size 100KB).
+    max_inline_object_size = _define("max_inline_object_size", 100 * 1024, int)
+    # Worker pool
+    max_workers_per_node = _define("max_workers_per_node", 32, int)
+    worker_register_timeout_s = _define("worker_register_timeout_s", 60.0, float)
+    idle_worker_kill_timeout_s = _define("idle_worker_kill_timeout_s", 300.0, float)
+    # Scheduling
+    lease_request_timeout_s = _define("lease_request_timeout_s", 120.0, float)
+    resource_report_period_s = _define("resource_report_period_s", 0.5, float)
+    # Health
+    health_check_period_s = _define("health_check_period_s", 2.0, float)
+    # Task retries (reference: default max_retries=3 for tasks)
+    default_task_max_retries = _define("default_task_max_retries", 3, int)
+    # Chaos testing: inject random handler delays up to this many micros
+    # (reference: RAY_testing_asio_delay_us, asio_chaos.cc).
+    testing_rpc_delay_us = _define("testing_rpc_delay_us", 0, int)
+
+
+def get(name: str) -> Any:
+    return _REGISTRY[name]

@@ -1,0 +1,912 @@
+"""CoreWorker: embedded in every driver and worker process.
+
+reference parity: src/ray/core_worker/core_worker.h:287 — task submission
+(SubmitTask core_worker.cc:1887), actor creation/calls (:1958, :2193), object
+put/get (:1148, :1360), ownership + reference counting (reference_count.h:61),
+retries (task_manager.h:192) and the executor side (ExecuteTask :2598). The
+direct task transports (transport/direct_task_transport.cc,
+direct_actor_task_submitter.h) map to the lease + direct-push flow here; the
+actor receiver's sequencing queue (actor_scheduling_queue.h:40) maps to the
+per-caller seq reordering buffer in _ActorExecutor.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc as rpc_lib
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import StoreClient
+from ray_tpu._private.state import TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+# Object location tags (owner's object directory entries)
+INLINE, STORE, ERROR, PENDING, FREED = "inline", "store", "error", "pending", "freed"
+
+
+@dataclass
+class _TaskEntry:
+    spec: TaskSpec
+    retries_left: int
+    return_ids: List[ObjectID]
+    lease_node: Optional[Tuple[str, int]] = None
+    done: bool = False
+
+
+@dataclass
+class _ActorState:
+    actor_id: ActorID
+    address: Optional[Tuple[str, int]] = None
+    last_address: Optional[Tuple[str, int]] = None
+    dead: bool = False
+    death_cause: str = ""
+    seq: int = 0
+    incarnation: int = 0
+    queue: List[TaskSpec] = field(default_factory=list)
+    # task hex -> incarnation it was pushed to (for failing in-flight tasks
+    # of a dead incarnation; reference: direct_actor_task_submitter
+    # DisconnectActor fails inflight requests)
+    pushed: Dict[str, int] = field(default_factory=dict)
+    resolving: bool = False
+
+
+class CoreWorker:
+    def __init__(self, *, mode: str, job_id: JobID,
+                 gcs_address: Tuple[str, int],
+                 node_manager_address: Tuple[str, int],
+                 store_address: Tuple[str, int],
+                 node_id_hex: str,
+                 worker_id: Optional[WorkerID] = None,
+                 host: str = "127.0.0.1"):
+        assert mode in ("driver", "worker")
+        self.mode = mode
+        self.job_id = job_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id_hex = node_id_hex
+        self.gcs_address = tuple(gcs_address)
+        self.nm_address = tuple(node_manager_address)
+        self._gcs = rpc_lib.RpcClient(self.gcs_address, timeout=120)
+        self._nm = rpc_lib.RpcClient(self.nm_address, timeout=120)
+        self._pool = rpc_lib.ClientPool(timeout=120)
+        self.store = StoreClient(store_address)
+
+        self._lock = threading.RLock()
+        # Owner-side object directory: oid hex -> (tag, ...) location
+        self.objects: Dict[str, Tuple] = {}
+        self.object_events: Dict[str, threading.Event] = {}
+        # Reference counting (reference reference_count.h, simplified to
+        # local refs + submitted-task arg pins; borrower chains TODO).
+        self.local_refs: Dict[str, int] = {}
+        self.arg_pins: Dict[str, int] = {}
+        self.tasks: Dict[str, _TaskEntry] = {}
+        self.actors: Dict[str, _ActorState] = {}
+        self._put_index = 0
+        self._fn_cache: Dict[str, Any] = {}
+        self._subscriptions: Dict[Tuple[str, str], Any] = {}
+        self._tls = threading.local()
+        self._shutdown = False
+
+        # Driver's root "task" context for put ids
+        self._root_task_id = TaskID.of(job_id)
+
+        handlers = {
+            "cw_lease_granted": self._on_lease_granted,
+            "cw_task_done": self._on_task_done,
+            "cw_task_failed": self._on_task_failed,
+            "cw_get_object": self._on_get_object,
+            "cw_add_ref": self._on_add_ref,
+            "cw_remove_ref": self._on_remove_ref,
+            "cw_pubsub_push": self._on_pubsub_push,
+            "cw_kill_self": self._on_kill_self,
+            "cw_ping": lambda: "pong",
+        }
+        self.executor: Optional[_Executor] = None
+        if mode == "worker":
+            self.executor = _Executor(self)
+            handlers["w_push_task"] = self.executor.push_task
+            handlers["w_cancel_task"] = self.executor.cancel_task
+        self.server = rpc_lib.RpcServer(handlers, host=host)
+        self.address = self.server.address
+
+    # ------------------------------------------------------------------
+    # Context
+    # ------------------------------------------------------------------
+
+    def current_task_id(self) -> TaskID:
+        return getattr(self._tls, "task_id", None) or self._root_task_id
+
+    def set_current_task(self, task_id: Optional[TaskID]) -> None:
+        self._tls.task_id = task_id
+
+    def next_put_index(self) -> int:
+        with self._lock:
+            self._put_index += 1
+            return self._put_index
+
+    # ------------------------------------------------------------------
+    # Reference counting
+    # ------------------------------------------------------------------
+
+    def add_local_ref(self, ref: ObjectRef) -> None:
+        with self._lock:
+            self.local_refs[ref.hex()] = self.local_refs.get(ref.hex(), 0) + 1
+
+    def remove_local_ref(self, ref: ObjectRef) -> None:
+        if self._shutdown:
+            return
+        with self._lock:
+            h = ref.hex()
+            n = self.local_refs.get(h, 0) - 1
+            if n > 0:
+                self.local_refs[h] = n
+                return
+            self.local_refs.pop(h, None)
+            if self.arg_pins.get(h, 0) > 0:
+                return
+            self._maybe_free_locked(h)
+
+    def _maybe_free_locked(self, oid_hex: str) -> None:
+        loc = self.objects.get(oid_hex)
+        if loc is None or loc[0] == PENDING:
+            return  # task in flight; keep until completion
+        if loc[0] == STORE:
+            try:
+                self.store.delete([oid_hex])
+            except Exception:  # noqa: BLE001
+                pass
+        self.objects[oid_hex] = (FREED,)
+
+    def _pin_args(self, refs: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in refs:
+                self.arg_pins[oid.hex()] = self.arg_pins.get(oid.hex(), 0) + 1
+
+    def _unpin_args(self, refs: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in refs:
+                h = oid.hex()
+                n = self.arg_pins.get(h, 0) - 1
+                if n <= 0:
+                    self.arg_pins.pop(h, None)
+                    if self.local_refs.get(h, 0) == 0:
+                        self._maybe_free_locked(h)
+                else:
+                    self.arg_pins[h] = n
+
+    # ------------------------------------------------------------------
+    # Put / Get / Wait / Free
+    # ------------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
+        blob = ser.pack(value)
+        self._store_owned_object(oid, blob)
+        return ObjectRef(oid, self.address)
+
+    def store_blob(self, oid_hex: str, blob: bytes) -> Tuple:
+        """Write a serialized value inline or to the local shm store;
+        returns its location tuple."""
+        if len(blob) <= Config.max_inline_object_size:
+            return (INLINE, blob)
+        buf = self.store.create(oid_hex, len(blob))
+        buf[:len(blob)] = blob
+        self.store.seal(oid_hex)
+        return (STORE, self.store.address, len(blob))
+
+    def _store_owned_object(self, oid: ObjectID, blob: bytes) -> None:
+        h = oid.hex()
+        loc = self.store_blob(h, blob)
+        with self._lock:
+            self.objects[h] = loc
+            ev = self.object_events.get(h)
+            if ev is not None:
+                ev.set()
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        blocked_notified = False
+        try:
+            out: List[Any] = []
+            for ref in refs:
+                need_wait = not self._ready_nowait(ref)
+                if need_wait and self.mode == "worker" and not blocked_notified \
+                        and getattr(self._tls, "task_id", None) is not None:
+                    blocked_notified = True
+                    try:
+                        self._nm.call("nm_worker_blocked",
+                                      worker_id_hex=self.worker_id.hex())
+                    except Exception:  # noqa: BLE001
+                        pass
+                out.append(self._get_one(ref, deadline))
+            return out
+        finally:
+            if blocked_notified:
+                try:
+                    self._nm.call("nm_worker_unblocked",
+                                  worker_id_hex=self.worker_id.hex())
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _is_own(self, ref: ObjectRef) -> bool:
+        return ref.owner_address in (None, self.address)
+
+    def _ready_nowait(self, ref: ObjectRef) -> bool:
+        h = ref.hex()
+        with self._lock:
+            loc = self.objects.get(h)
+        if loc is not None and loc[0] != PENDING:
+            return True
+        if self._is_own(ref):
+            return False
+        try:
+            loc = self._owner_client(ref).call("cw_get_object", oid_hex=h)
+        except Exception:  # noqa: BLE001
+            return False
+        if loc[0] in (PENDING, "unknown"):
+            return False
+        with self._lock:
+            self.objects.setdefault(h, loc)
+        return True
+
+    def _owner_client(self, ref: ObjectRef) -> rpc_lib.RpcClient:
+        assert ref.owner_address is not None
+        return self._pool.get(ref.owner_address)
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        h = ref.hex()
+        while True:
+            with self._lock:
+                loc = self.objects.get(h)
+                if loc is not None and loc[0] == PENDING:
+                    ev = self.object_events.setdefault(h, threading.Event())
+                else:
+                    ev = None
+            if loc is None or loc[0] == PENDING:
+                if self._is_own(ref):
+                    if loc is None:
+                        raise exc.ObjectLostError(
+                            f"object {h[:16]} unknown to its owner (freed?)")
+                    # our own pending task result: wait on event
+                    remaining = None if deadline is None \
+                        else deadline - time.time()
+                    if remaining is not None and remaining <= 0:
+                        raise exc.GetTimeoutError(
+                            f"get timed out waiting for {h[:16]}")
+                    ev.wait(timeout=min(remaining, 1.0)
+                            if remaining is not None else 1.0)
+                    continue
+                # borrower: poll the owner
+                try:
+                    loc = self._owner_client(ref).call("cw_get_object",
+                                                       oid_hex=h)
+                except rpc_lib.ConnectionLost:
+                    raise exc.OwnerDiedError(
+                        f"owner {ref.owner_address} of {h[:16]} died")
+                if loc[0] in (PENDING, "unknown"):
+                    if deadline is not None and time.time() > deadline:
+                        raise exc.GetTimeoutError(
+                            f"get timed out waiting for {h[:16]}")
+                    time.sleep(0.005)
+                    continue
+                with self._lock:
+                    self.objects.setdefault(h, loc)
+            return self._materialize(h, loc)
+
+    def _materialize(self, oid_hex: str, loc: Tuple) -> Any:
+        tag = loc[0]
+        if tag == INLINE:
+            return ser.unpack(memoryview(loc[1]))
+        if tag == STORE:
+            _, store_addr, size = loc
+            store_addr = tuple(store_addr)
+            if store_addr == self.store.address:
+                bufs = self.store.get([oid_hex], timeout=60)
+            else:
+                self.store.pull(oid_hex, store_addr, size)
+                bufs = self.store.get([oid_hex], timeout=60)
+            if oid_hex not in bufs:
+                raise exc.ObjectLostError(f"object {oid_hex[:16]} lost in store")
+            return ser.unpack(bufs[oid_hex])
+        if tag == ERROR:
+            err = pickle.loads(loc[1])
+            if isinstance(err, exc.RayTaskError):
+                raise err.as_instanceof_cause()
+            raise err
+        if tag == FREED:
+            raise exc.ObjectFreedError(f"object {oid_hex[:16]} was freed")
+        raise exc.RaySystemError(f"bad object location {loc!r}")
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.time() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while True:
+            still = []
+            for r in pending:
+                (ready if self._ready_nowait(r) else still).append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+            time.sleep(0.005)
+        # preserve input order
+        ready_set = {r.hex() for r in ready}
+        ordered_ready = [r for r in refs if r.hex() in ready_set][:num_returns]
+        rest = [r for r in refs if r.hex() not in
+                {x.hex() for x in ordered_ready}]
+        return ordered_ready, rest
+
+    def free(self, refs: List[ObjectRef]) -> None:
+        with self._lock:
+            for r in refs:
+                if self._is_own(r):
+                    self._maybe_free_locked(r.hex())
+
+    # ------------------------------------------------------------------
+    # Function export/import (reference _private/function_manager.py)
+    # ------------------------------------------------------------------
+
+    def export_function(self, fn: Any) -> str:
+        blob = ser.dumps_function(fn)
+        import hashlib
+        key = f"fn:{self.job_id.hex()}:{hashlib.sha1(blob).hexdigest()}"
+        if key not in self._fn_cache:
+            self._gcs.call("kv_put", key=key, value=blob, overwrite=False)
+            self._fn_cache[key] = fn
+        return key
+
+    def import_function(self, key: str) -> Any:
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            blob = self._gcs.call("kv_get", key=key)
+            if blob is None:
+                raise exc.RaySystemError(f"function {key} not found in GCS")
+            fn = ser.loads_function(blob)
+            self._fn_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # Normal task submission
+    # ------------------------------------------------------------------
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
+                      for i in range(spec.num_returns)]
+        with self._lock:
+            for oid in return_ids:
+                self.objects[oid.hex()] = (PENDING,)
+                self.object_events[oid.hex()] = threading.Event()
+            self.tasks[spec.task_id.hex()] = _TaskEntry(
+                spec=spec, retries_left=spec.max_retries,
+                return_ids=return_ids)
+        self._pin_args(spec.arg_object_refs)
+        self._request_lease(spec)
+        return [ObjectRef(oid, self.address) for oid in return_ids]
+
+    def _request_lease(self, spec: TaskSpec) -> None:
+        """Lease a worker; follow spillback redirects (reference
+        direct_task_transport.cc:349,505)."""
+        nm = self._nm
+        for _ in range(16):
+            with self._lock:
+                entry = self.tasks.get(spec.task_id.hex())
+                if entry is not None:
+                    # Recorded BEFORE the request so the async grant callback
+                    # (which may arrive first) can find where to return it.
+                    entry.lease_node = nm.address
+            try:
+                kind, payload = nm.call("nm_request_lease", spec=spec,
+                                        reply_to=self.address)
+            except Exception as e:  # noqa: BLE001
+                self._fail_task(spec.task_id.hex(), "SCHEDULING_FAILED",
+                                f"lease request failed: {e}", retry=True)
+                return
+            if kind == "queued":
+                return
+            if kind == "infeasible":
+                self._fail_task(spec.task_id.hex(), "SCHEDULING_FAILED",
+                                str(payload), retry=False)
+                return
+            nm = self._pool.get(tuple(payload))  # spillback
+        self._fail_task(spec.task_id.hex(), "SCHEDULING_FAILED",
+                        "too many spillbacks", retry=False)
+
+    def _on_lease_granted(self, lease_id: str, task_id: TaskID,
+                          worker_address: Tuple[str, int],
+                          worker_id: str, node_id: str,
+                          nm_address: Optional[Tuple[str, int]] = None
+                          ) -> None:
+        with self._lock:
+            entry = self.tasks.get(task_id.hex())
+            if entry is not None and nm_address is not None:
+                entry.lease_node = tuple(nm_address)
+        if entry is None or entry.done:
+            self._return_lease(lease_id, entry, nm_address=nm_address)
+            return
+        try:
+            self._pool.get(tuple(worker_address)).call(
+                "w_push_task", spec=entry.spec, lease_id=lease_id)
+        except Exception as e:  # noqa: BLE001
+            self._return_lease(lease_id, entry)
+            self._fail_task(entry.spec.task_id.hex(), "WORKER_DIED",
+                            f"push to leased worker failed: {e}", retry=True)
+
+    def _return_lease(self, lease_id: str, entry: Optional[_TaskEntry],
+                      nm_address: Optional[Tuple[str, int]] = None) -> None:
+        if nm_address is not None:
+            nm_addr = tuple(nm_address)
+        elif entry is not None and entry.lease_node:
+            nm_addr = entry.lease_node
+        else:
+            nm_addr = self.nm_address
+        try:
+            self._pool.get(nm_addr).call("nm_return_worker", lease_id=lease_id)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _on_task_done(self, task_id: TaskID, results: List[Tuple],
+                      lease_id: Optional[str] = None) -> None:
+        h = task_id.hex()
+        with self._lock:
+            entry = self.tasks.get(h)
+            duplicate = entry is None or entry.done
+            if not duplicate:
+                entry.done = True
+        if duplicate:
+            # Late/duplicate completion (e.g. after cancel or retry): the
+            # first writer won; just hand back any lease that rode in.
+            if lease_id is not None:
+                self._return_lease(lease_id, entry)
+            return
+        for oid, loc in zip(entry.return_ids, results):
+            with self._lock:
+                # keep location unless already freed
+                if self.objects.get(oid.hex(), (PENDING,))[0] != FREED:
+                    self.objects[oid.hex()] = tuple(loc)
+                ev = self.object_events.get(oid.hex())
+                if ev is not None:
+                    ev.set()
+        self._unpin_args(entry.spec.arg_object_refs)
+        if lease_id is not None:
+            self._return_lease(lease_id, entry)
+
+    def _on_task_failed(self, task_id: TaskID, error_type: str,
+                        message: str) -> None:
+        self._fail_task(task_id.hex(), error_type, message, retry=True)
+
+    def _fail_task(self, task_hex: str, error_type: str, message: str,
+                   retry: bool) -> None:
+        with self._lock:
+            entry = self.tasks.get(task_hex)
+            if entry is None or entry.done:
+                return
+            will_retry = retry and entry.retries_left > 0
+            if will_retry:
+                entry.retries_left -= 1
+            else:
+                entry.done = True
+        if will_retry:
+            logger.warning("retrying task %s (%s: %s), %d retries left",
+                           entry.spec.function_name, error_type, message,
+                           entry.retries_left)
+            threading.Thread(target=self._request_lease, args=(entry.spec,),
+                             daemon=True).start()
+            return
+        if error_type == "WORKER_DIED":
+            err: Exception = exc.WorkerCrashedError(message)
+        elif error_type == "CANCELLED":
+            err = exc.TaskCancelledError(message)
+        else:
+            err = exc.RaySystemError(f"{error_type}: {message}")
+        blob = pickle.dumps(err)
+        for oid in entry.return_ids:
+            with self._lock:
+                self.objects[oid.hex()] = (ERROR, blob)
+                ev = self.object_events.get(oid.hex())
+                if ev is not None:
+                    ev.set()
+        self._unpin_args(entry.spec.arg_object_refs)
+
+    # ------------------------------------------------------------------
+    # Actor submission (reference direct_actor_task_submitter.h)
+    # ------------------------------------------------------------------
+
+    def create_actor(self, spec: TaskSpec, name: str = "",
+                     namespace: str = "") -> None:
+        self._pin_args(spec.arg_object_refs)
+        with self._lock:
+            self.actors[spec.actor_id.hex()] = _ActorState(
+                actor_id=spec.actor_id)
+        self._gcs.call("register_actor", spec=spec, name=name,
+                       namespace=namespace)
+
+    def attach_actor(self, actor_id: ActorID) -> None:
+        """Track an actor we only hold a handle to (named/deserialized)."""
+        with self._lock:
+            if actor_id.hex() not in self.actors:
+                self.actors[actor_id.hex()] = _ActorState(actor_id=actor_id)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          function_key: str, args_blob: bytes,
+                          arg_refs: List[ObjectID],
+                          num_returns: int) -> List[ObjectRef]:
+        spec = TaskSpec(
+            task_id=TaskID.of(self.job_id), job_id=self.job_id,
+            task_type=TaskType.ACTOR_TASK, function_key=function_key,
+            function_name=method_name, args=args_blob,
+            arg_object_refs=arg_refs, num_returns=num_returns,
+            resources={}, owner_address=self.address,
+            owner_worker_id=self.worker_id, actor_id=actor_id,
+            actor_method_name=method_name)
+        return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
+                      for i in range(num_returns)]
+        with self._lock:
+            state = self.actors.get(actor_id.hex())
+            if state is None:
+                state = _ActorState(actor_id=actor_id)
+                self.actors[actor_id.hex()] = state
+            if state.dead:
+                blob = pickle.dumps(
+                    exc.ActorDiedError(actor_id.hex(), state.death_cause))
+                for oid in return_ids:
+                    self.objects[oid.hex()] = (ERROR, blob)
+                return [ObjectRef(oid, self.address) for oid in return_ids]
+            spec.sequence_number = state.seq
+            state.seq += 1
+            for oid in return_ids:
+                self.objects[oid.hex()] = (PENDING,)
+                self.object_events[oid.hex()] = threading.Event()
+            self.tasks[spec.task_id.hex()] = _TaskEntry(
+                spec=spec, retries_left=0, return_ids=return_ids)
+            addr = state.address
+            if addr is None:
+                state.queue.append(spec)
+                need_resolve = not state.resolving
+                state.resolving = True
+            else:
+                need_resolve = False
+        self._pin_args(arg_refs)
+        if addr is not None:
+            self._push_actor_task(addr, spec)
+        elif need_resolve:
+            threading.Thread(target=self._resolve_actor,
+                             args=(actor_id,), daemon=True).start()
+        return [ObjectRef(oid, self.address) for oid in return_ids]
+
+    def _push_actor_task(self, addr: Optional[Tuple[str, int]],
+                         spec: TaskSpec) -> None:
+        try:
+            if addr is None:
+                raise rpc_lib.ConnectionLost("actor address unknown")
+            self._pool.get(addr).call("w_push_task", spec=spec)
+            with self._lock:
+                state = self.actors[spec.actor_id.hex()]
+                state.pushed[spec.task_id.hex()] = state.incarnation
+        except Exception:  # noqa: BLE001
+            # actor possibly restarting: invalidate and re-resolve
+            if addr is not None:
+                self._pool.invalidate(addr)
+            with self._lock:
+                state = self.actors[spec.actor_id.hex()]
+                if state.address == addr:
+                    state.address = None
+                state.queue.append(spec)
+                need = not state.resolving
+                state.resolving = True
+            if need:
+                threading.Thread(target=self._resolve_actor,
+                                 args=(spec.actor_id,), daemon=True).start()
+
+    def _resolve_actor(self, actor_id: ActorID) -> None:
+        deadline = time.time() + 300
+        while time.time() < deadline and not self._shutdown:
+            try:
+                info = self._gcs.call("get_actor_info",
+                                      actor_id_hex=actor_id.hex())
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+                continue
+            if info is None:
+                time.sleep(0.1)
+                continue
+            if info.state == "ALIVE" and info.address is not None:
+                lost: List[TaskSpec] = []
+                with self._lock:
+                    state = self.actors[actor_id.hex()]
+                    new_addr = tuple(info.address)
+                    restarted = (state.last_address is not None
+                                 and state.last_address != new_addr)
+                    state.address = new_addr
+                    state.last_address = new_addr
+                    state.resolving = False
+                    q, state.queue = state.queue, []
+                    q.sort(key=lambda s: s.sequence_number)
+                    if restarted:
+                        state.incarnation += 1
+                        # Tasks pushed to the dead incarnation are lost:
+                        # fail them (at-most-once actor task semantics).
+                        for thex, inc in list(state.pushed.items()):
+                            if inc < state.incarnation:
+                                entry = self.tasks.get(thex)
+                                state.pushed.pop(thex, None)
+                                if entry is not None and not entry.done:
+                                    lost.append(entry.spec)
+                        # Renumber the never-pushed queue from seq 0 for the
+                        # fresh incarnation's reordering buffer.
+                        for i, spec in enumerate(q):
+                            spec.sequence_number = i
+                        state.seq = len(q)
+                blob = pickle.dumps(exc.ActorUnavailableError(
+                    actor_id.hex(), "actor restarted; in-flight task lost"))
+                for spec in lost:
+                    self._on_task_done(spec.task_id,
+                                       [(ERROR, blob)] * spec.num_returns)
+                for spec in q:
+                    # push to the freshly-resolved address, not the mutable
+                    # state.address (a concurrent push failure may null it)
+                    self._push_actor_task(new_addr, spec)
+                return
+            if info.state == "DEAD":
+                self._mark_actor_dead(actor_id, info.death_cause)
+                return
+            time.sleep(0.1)
+        self._mark_actor_dead(actor_id, "timed out resolving actor address")
+
+    def _mark_actor_dead(self, actor_id: ActorID, cause: str) -> None:
+        with self._lock:
+            state = self.actors.get(actor_id.hex())
+            if state is None:
+                return
+            state.dead = True
+            state.death_cause = cause
+            state.resolving = False
+            q, state.queue = state.queue, []
+        err = exc.ActorDiedError(actor_id.hex(), cause)
+        blob = pickle.dumps(err)
+        for spec in q:
+            self._on_task_done(spec.task_id,
+                               [(ERROR, blob)] * spec.num_returns)
+        # fail any in-flight (pushed but unacked) tasks for this actor
+        with self._lock:
+            inflight = [e for e in self.tasks.values()
+                        if e.spec.actor_id == actor_id and not e.done]
+        for e in inflight:
+            self._on_task_done(e.spec.task_id,
+                               [(ERROR, blob)] * e.spec.num_returns)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._gcs.call("kill_actor", actor_id_hex=actor_id.hex(),
+                       no_restart=no_restart)
+
+    def cancel_task(self, ref: ObjectRef) -> None:
+        with self._lock:
+            entry = self.tasks.get(ref.task_id().hex())
+        if entry is None or entry.done:
+            return
+        self._fail_task(ref.task_id().hex(), "CANCELLED", "ray.cancel",
+                        retry=False)
+
+    # ------------------------------------------------------------------
+    # Owner-side handlers
+    # ------------------------------------------------------------------
+
+    def _on_get_object(self, oid_hex: str) -> Tuple:
+        with self._lock:
+            loc = self.objects.get(oid_hex)
+        if loc is None:
+            return ("unknown",)
+        if loc[0] == PENDING:
+            return (PENDING,)
+        return loc
+
+    def _on_add_ref(self, oid_hex: str) -> None:
+        with self._lock:
+            self.arg_pins[oid_hex] = self.arg_pins.get(oid_hex, 0) + 1
+
+    def _on_remove_ref(self, oid_hex: str) -> None:
+        with self._lock:
+            n = self.arg_pins.get(oid_hex, 0) - 1
+            if n <= 0:
+                self.arg_pins.pop(oid_hex, None)
+                if self.local_refs.get(oid_hex, 0) == 0:
+                    self._maybe_free_locked(oid_hex)
+            else:
+                self.arg_pins[oid_hex] = n
+
+    def _on_pubsub_push(self, channel: str, token: str, message: Any) -> None:
+        cb = self._subscriptions.get((channel, token))
+        if cb is not None:
+            try:
+                cb(message)
+            except Exception:  # noqa: BLE001
+                logger.exception("pubsub callback failed")
+
+    def subscribe(self, channel: str, callback: Any) -> None:
+        import uuid
+        token = uuid.uuid4().hex
+        self._subscriptions[(channel, token)] = callback
+        self._gcs.call("subscribe", channel=channel, address=self.address,
+                       token=token)
+
+    def _on_kill_self(self) -> str:
+        threading.Timer(0.05, lambda: os._exit(0)).start()
+        return "dying"
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self.server.stop()
+        self.store.close()
+        self._pool.close_all()
+        self._gcs.close()
+        self._nm.close()
+
+
+class _Executor:
+    """Task execution engine inside worker processes.
+
+    reference parity: CoreWorker::ExecuteTask (core_worker.cc:2598) +
+    scheduling queues (normal_scheduling_queue.h:32, actor_scheduling_queue
+    .h:40 for per-caller seq ordering) + ConcurrencyGroupManager thread pools
+    (thread_pool.h:36).
+    """
+
+    def __init__(self, cw: CoreWorker):
+        self.cw = cw
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+        self._lock = threading.Lock()
+        # per-owner seq reordering
+        self._next_seq: Dict[str, int] = {}
+        self._buffer: Dict[str, Dict[int, TaskSpec]] = {}
+        self._cancelled: set = set()
+        self._threads: List[threading.Thread] = []
+        self._spawn_exec_threads(1)
+
+    def _spawn_exec_threads(self, n: int) -> None:
+        while len(self._threads) < n:
+            t = threading.Thread(target=self._exec_loop, daemon=True,
+                                 name=f"exec-{len(self._threads)}")
+            t.start()
+            self._threads.append(t)
+
+    def push_task(self, spec: TaskSpec, lease_id: Optional[str] = None) -> str:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            owner = spec.owner_worker_id.hex()
+            with self._lock:
+                buf = self._buffer.setdefault(owner, {})
+                buf[spec.sequence_number] = spec
+                nxt = self._next_seq.setdefault(owner, 0)
+                while nxt in buf:
+                    s = buf.pop(nxt)
+                    s._lease_id = None  # type: ignore[attr-defined]
+                    self._queue.put(s)
+                    nxt += 1
+                self._next_seq[owner] = nxt
+        else:
+            spec._lease_id = lease_id  # type: ignore[attr-defined]
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                self._spawn_exec_threads(max(1, spec.max_concurrency))
+            self._queue.put(spec)
+        return "ok"
+
+    def cancel_task(self, task_id_hex: str) -> None:
+        self._cancelled.add(task_id_hex)
+
+    def _exec_loop(self) -> None:
+        while True:
+            spec = self._queue.get()
+            if spec is None:
+                return
+            try:
+                self._execute(spec)
+            except Exception:  # noqa: BLE001
+                logger.exception("executor crashed on %s", spec.function_name)
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:
+        args, kwargs = ser.unpack(memoryview(spec.args))
+        # Top-level ObjectRef args are resolved to values (reference
+        # semantics: only top-level args are awaited+inlined).
+        def resolve(x: Any) -> Any:
+            if isinstance(x, ObjectRef):
+                return self.cw.get([x], timeout=None)[0]
+            return x
+        return tuple(resolve(a) for a in args), \
+            {k: resolve(v) for k, v in kwargs.items()}
+
+    def _execute(self, spec: TaskSpec) -> None:
+        cw = self.cw
+        if spec.task_id.hex() in self._cancelled:
+            self._report_error(spec, exc.TaskCancelledError(spec.function_name))
+            return
+        cw.set_current_task(spec.task_id)
+        try:
+            results: List[Tuple] = []
+            try:
+                if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                    cls = cw.import_function(spec.function_key)
+                    args, kwargs = self._resolve_args(spec)
+                    self.actor_instance = cls(*args, **kwargs)
+                    self.actor_id = spec.actor_id
+                    cw._gcs.call("report_actor_alive",
+                                 actor_id_hex=spec.actor_id.hex(),
+                                 address=cw.address,
+                                 node_id_hex=cw.node_id_hex)
+                    values: List[Any] = [None] * spec.num_returns
+                elif spec.task_type == TaskType.ACTOR_TASK:
+                    if self.actor_instance is None:
+                        raise exc.RaySystemError("actor not initialized")
+                    method = getattr(self.actor_instance,
+                                     spec.actor_method_name)
+                    args, kwargs = self._resolve_args(spec)
+                    out = method(*args, **kwargs)
+                    values = self._split_returns(out, spec.num_returns)
+                else:
+                    fn = cw.import_function(spec.function_key)
+                    args, kwargs = self._resolve_args(spec)
+                    out = fn(*args, **kwargs)
+                    values = self._split_returns(out, spec.num_returns)
+            except Exception as e:  # noqa: BLE001 - app error
+                if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                    try:
+                        cw._gcs.call(
+                            "report_actor_death",
+                            actor_id_hex=spec.actor_id.hex(),
+                            reason=f"creation failed: {e}", restart=False)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._report_error(spec, exc.RayTaskError(
+                    spec.function_name, traceback.format_exc(), e))
+                return
+            for i, v in enumerate(values):
+                oid = ObjectID.for_task_return(spec.task_id, i + 1)
+                results.append(cw.store_blob(oid.hex(), ser.pack(v)))
+            self._report_done(spec, results)
+        finally:
+            cw.set_current_task(None)
+
+    @staticmethod
+    def _split_returns(out: Any, num_returns: int) -> List[Any]:
+        if num_returns == 1:
+            return [out]
+        if num_returns == 0:
+            return []
+        out_list = list(out)
+        if len(out_list) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{len(out_list)} values")
+        return out_list
+
+    def _report_done(self, spec: TaskSpec, results: List[Tuple]) -> None:
+        lease_id = getattr(spec, "_lease_id", None)
+        try:
+            self.cw._pool.get(spec.owner_address).call(
+                "cw_task_done", task_id=spec.task_id, results=results,
+                lease_id=lease_id)
+        except Exception:  # noqa: BLE001
+            logger.warning("owner %s unreachable for task result",
+                           spec.owner_address)
+
+    def _report_error(self, spec: TaskSpec, err: Exception) -> None:
+        blob = pickle.dumps(err)
+        self._report_done(spec, [(ERROR, blob)] * max(spec.num_returns, 1)
+                          if spec.num_returns else [])

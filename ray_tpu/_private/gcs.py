@@ -1,0 +1,368 @@
+"""Global Control Store: cluster control plane.
+
+reference parity: src/ray/gcs/gcs_server/ — GcsServer (gcs_server.h:78) with
+node membership (GcsNodeManager), actor directory + scheduling
+(GcsActorManager/GcsActorScheduler), internal KV (GcsInternalKVManager),
+function table (GcsFunctionManager), pub/sub (GcsPublisher), health checks
+(GcsHealthCheckManager) and job accounting (GcsJobManager). Storage is an
+in-process dict behind a small StoreClient-like interface so a persistent
+backend can be swapped in (reference gcs_table_storage.h).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import rpc as rpc_lib
+from ray_tpu._private.ids import ActorID, JobID, NodeID, WorkerID
+from ray_tpu._private.state import ActorInfo, NodeInfo, ResourceSet, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class InMemoryStore:
+    """Pluggable table storage (reference in_memory_store_client.h)."""
+
+    def __init__(self) -> None:
+        self._tables: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, table: str, key: str, value: Any) -> None:
+        with self._lock:
+            self._tables.setdefault(table, {})[key] = value
+
+    def get(self, table: str, key: str) -> Any:
+        with self._lock:
+            return self._tables.get(table, {}).get(key)
+
+    def delete(self, table: str, key: str) -> bool:
+        with self._lock:
+            return self._tables.get(table, {}).pop(key, None) is not None
+
+    def keys(self, table: str, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._tables.get(table, {}) if k.startswith(prefix)]
+
+    def items(self, table: str) -> List[Tuple[str, Any]]:
+        with self._lock:
+            return list(self._tables.get(table, {}).items())
+
+
+class GcsServer:
+    """The control-plane process (can be hosted in a thread or standalone)."""
+
+    HEALTH_CHECK_PERIOD_S = 2.0
+    HEALTH_CHECK_FAILURES_TO_DEAD = 3
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.store = InMemoryStore()
+        self._pool = rpc_lib.ClientPool(timeout=30)
+        self._lock = threading.Lock()
+        # node_id hex -> NodeInfo
+        self.nodes: Dict[str, NodeInfo] = {}
+        # node_id hex -> {resource: available} (synced by node managers)
+        self.node_available: Dict[str, Dict[str, float]] = {}
+        self.node_health_failures: Dict[str, int] = {}
+        # actor_id hex -> ActorInfo ; actor specs kept for restart
+        self.actors: Dict[str, ActorInfo] = {}
+        self.actor_specs: Dict[str, TaskSpec] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name)->id hex
+        # channel -> [(subscriber rpc address, token)]
+        self.subscribers: Dict[str, List[Tuple[Tuple[str, int], str]]] = {}
+        self.job_counter = 0
+        self._dead = False
+
+        self.server = rpc_lib.RpcServer({
+            # KV (reference InternalKVGcsService)
+            "kv_put": self.kv_put,
+            "kv_get": self.kv_get,
+            "kv_del": self.kv_del,
+            "kv_keys": self.kv_keys,
+            "kv_exists": self.kv_exists,
+            # nodes (reference NodeInfoGcsService / NodeResourceInfoGcsService)
+            "register_node": self.register_node,
+            "unregister_node": self.unregister_node,
+            "get_all_nodes": self.get_all_nodes,
+            "report_resources": self.report_resources,
+            "get_cluster_resources": self.get_cluster_resources,
+            # jobs
+            "next_job_id": self.next_job_id,
+            # actors (reference ActorInfoGcsService)
+            "register_actor": self.register_actor,
+            "get_actor_info": self.get_actor_info,
+            "get_named_actor": self.get_named_actor,
+            "list_named_actors": self.list_named_actors,
+            "report_actor_alive": self.report_actor_alive,
+            "report_actor_death": self.report_actor_death,
+            "kill_actor": self.kill_actor,
+            "list_actors": self.list_actors,
+            # pubsub (reference InternalPubSubGcsService)
+            "subscribe": self.subscribe,
+            "ping": lambda: "pong",
+        }, host=host, port=port)
+        self.address = self.server.address
+        self._health_thread = threading.Thread(
+            target=self._health_check_loop, daemon=True, name="gcs-health")
+        self._health_thread.start()
+
+    # ---- KV --------------------------------------------------------------
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        if not overwrite and self.store.get("kv", key) is not None:
+            return False
+        self.store.put("kv", key, value)
+        return True
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.store.get("kv", key)
+
+    def kv_del(self, key: str) -> bool:
+        return self.store.delete("kv", key)
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.store.keys("kv", prefix)
+
+    def kv_exists(self, key: str) -> bool:
+        return self.store.get("kv", key) is not None
+
+    # ---- nodes -----------------------------------------------------------
+
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[info.node_id.hex()] = info
+            self.node_available[info.node_id.hex()] = dict(info.resources_total)
+        self.publish("node", ("ALIVE", info))
+
+    def unregister_node(self, node_id_hex: str) -> None:
+        self._mark_node_dead(node_id_hex, "unregistered")
+
+    def _mark_node_dead(self, node_id_hex: str, reason: str) -> None:
+        with self._lock:
+            info = self.nodes.get(node_id_hex)
+            if info is None or not info.alive:
+                return
+            info.alive = False
+            self.node_available.pop(node_id_hex, None)
+            dead_actors = [a for a in self.actors.values()
+                           if a.node_id and a.node_id.hex() == node_id_hex
+                           and a.state in ("ALIVE", "PENDING", "RESTARTING")]
+        log = logger.info if reason == "unregistered" else logger.warning
+        log("GCS: node %s dead (%s)", node_id_hex[:12], reason)
+        self.publish("node", ("DEAD", info))
+        for a in dead_actors:
+            self.report_actor_death(a.actor_id.hex(),
+                                    f"node {node_id_hex[:12]} died", restart=True)
+
+    def get_all_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return list(self.nodes.values())
+
+    def report_resources(self, node_id_hex: str,
+                         available: Dict[str, float]) -> None:
+        with self._lock:
+            if node_id_hex in self.nodes and self.nodes[node_id_hex].alive:
+                self.node_available[node_id_hex] = dict(available)
+                self.node_health_failures[node_id_hex] = 0
+
+    def get_cluster_resources(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        with self._lock:
+            return {
+                nid: {"total": dict(self.nodes[nid].resources_total),
+                      "available": dict(avail)}
+                for nid, avail in self.node_available.items()
+                if self.nodes[nid].alive}
+
+    def _health_check_loop(self) -> None:
+        # reference: gcs_health_check_manager.h — active raylet health probes
+        while not self._dead:
+            time.sleep(self.HEALTH_CHECK_PERIOD_S)
+            with self._lock:
+                targets = [(nid, n.address) for nid, n in self.nodes.items()
+                           if n.alive]
+            for nid, addr in targets:
+                try:
+                    self._pool.get(addr).call("nm_ping")
+                    with self._lock:
+                        self.node_health_failures[nid] = 0
+                except Exception:  # noqa: BLE001
+                    with self._lock:
+                        self.node_health_failures[nid] = \
+                            self.node_health_failures.get(nid, 0) + 1
+                        failures = self.node_health_failures[nid]
+                    self._pool.invalidate(addr)
+                    if failures >= self.HEALTH_CHECK_FAILURES_TO_DEAD:
+                        self._mark_node_dead(nid, "health check failed")
+
+    # ---- jobs ------------------------------------------------------------
+
+    def next_job_id(self) -> JobID:
+        with self._lock:
+            self.job_counter += 1
+            return JobID(self.job_counter.to_bytes(4, "big"))
+
+    # ---- actors ----------------------------------------------------------
+
+    def register_actor(self, spec: TaskSpec, name: str = "",
+                       namespace: str = "") -> str:
+        """Register + schedule an actor creation (reference
+        GcsActorManager::HandleRegisterActor + GcsActorScheduler)."""
+        actor_id = spec.actor_id
+        assert actor_id is not None
+        key = (namespace, name)
+        with self._lock:
+            if name:
+                existing = self.named_actors.get(key)
+                if existing is not None and \
+                        self.actors[existing].state != "DEAD":
+                    raise ValueError(
+                        f"actor name '{name}' already taken in ns '{namespace}'")
+                self.named_actors[key] = actor_id.hex()
+            self.actors[actor_id.hex()] = ActorInfo(
+                actor_id=actor_id, name=name, namespace=namespace,
+                class_name=spec.function_name, state="PENDING", address=None,
+                node_id=None, max_restarts=spec.max_restarts)
+            self.actor_specs[actor_id.hex()] = spec
+        # Schedule asynchronously so registration returns immediately
+        # (reference: GcsActorManager registers then hands to the scheduler).
+        threading.Thread(target=self._schedule_actor,
+                         args=(actor_id.hex(),), daemon=True).start()
+        return actor_id.hex()
+
+    def _pick_node_for(self, required: ResourceSet,
+                       spec: TaskSpec) -> Optional[str]:
+        from ray_tpu._private.scheduler import pick_node
+        with self._lock:
+            view = {nid: dict(avail) for nid, avail in self.node_available.items()
+                    if self.nodes[nid].alive}
+        return pick_node(view, required, spec.scheduling_strategy,
+                         local_node_id=None)
+
+    def _schedule_actor(self, actor_id_hex: str) -> None:
+        spec = self.actor_specs[actor_id_hex]
+        required = spec.required_resources()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            node_id_hex = self._pick_node_for(required, spec)
+            if node_id_hex is None:
+                time.sleep(0.1)
+                continue
+            with self._lock:
+                node = self.nodes.get(node_id_hex)
+            if node is None or not node.alive:
+                continue
+            try:
+                ok = self._pool.get(node.address).call(
+                    "nm_schedule_actor_creation", spec=spec)
+            except Exception:  # noqa: BLE001
+                ok = False
+            if ok:
+                with self._lock:
+                    info = self.actors[actor_id_hex]
+                    info.node_id = node.node_id
+                return
+            time.sleep(0.05)
+        self.report_actor_death(actor_id_hex,
+                                "scheduling timed out (infeasible?)",
+                                restart=False)
+
+    def report_actor_alive(self, actor_id_hex: str,
+                           address: Tuple[str, int],
+                           node_id_hex: str) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id_hex)
+            if info is None:
+                return
+            info.state = "ALIVE"
+            info.address = tuple(address)
+            info.node_id = NodeID.from_hex(node_id_hex)
+        self.publish("actor", ("ALIVE", self.actors[actor_id_hex]))
+
+    def report_actor_death(self, actor_id_hex: str, reason: str,
+                           restart: bool = True) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id_hex)
+            if info is None or info.state == "DEAD":
+                return
+            can_restart = (restart and
+                           (info.max_restarts == -1
+                            or info.num_restarts < info.max_restarts))
+            if can_restart:
+                info.state = "RESTARTING"
+                info.num_restarts += 1
+                info.address = None
+            else:
+                info.state = "DEAD"
+                info.death_cause = reason
+                info.address = None
+        if can_restart:
+            logger.warning("GCS: restarting actor %s (%d/%s): %s",
+                           actor_id_hex[:12], info.num_restarts,
+                           info.max_restarts, reason)
+            self.publish("actor", ("RESTARTING", info))
+            threading.Thread(target=self._schedule_actor,
+                             args=(actor_id_hex,), daemon=True).start()
+        else:
+            self.publish("actor", ("DEAD", info))
+
+    def get_actor_info(self, actor_id_hex: str) -> Optional[ActorInfo]:
+        with self._lock:
+            return self.actors.get(actor_id_hex)
+
+    def get_named_actor(self, name: str, namespace: str = ""
+                        ) -> Optional[ActorInfo]:
+        with self._lock:
+            aid = self.named_actors.get((namespace, name))
+            return self.actors.get(aid) if aid else None
+
+    def list_named_actors(self, namespace: str = "", all_namespaces: bool = False
+                          ) -> List[Tuple[str, str]]:
+        with self._lock:
+            return [k for k, aid in self.named_actors.items()
+                    if (all_namespaces or k[0] == namespace)
+                    and self.actors[aid].state != "DEAD"]
+
+    def list_actors(self) -> List[ActorInfo]:
+        with self._lock:
+            return list(self.actors.values())
+
+    def kill_actor(self, actor_id_hex: str, no_restart: bool = True) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id_hex)
+            addr = info.address if info else None
+        if addr is not None:
+            try:
+                self._pool.get(addr).call("cw_kill_self")
+            except Exception:  # noqa: BLE001
+                pass
+        self.report_actor_death(actor_id_hex, "ray.kill", restart=not no_restart)
+
+    # ---- pubsub ----------------------------------------------------------
+
+    def subscribe(self, channel: str, address: Tuple[str, int],
+                  token: str) -> None:
+        with self._lock:
+            subs = self.subscribers.setdefault(channel, [])
+            if (tuple(address), token) not in subs:
+                subs.append((tuple(address), token))
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self.subscribers.get(channel, []))
+        for address, token in subs:
+            try:
+                self._pool.get(address).call("cw_pubsub_push", channel=channel,
+                                             token=token, message=message)
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    try:
+                        self.subscribers[channel].remove((address, token))
+                    except ValueError:
+                        pass
+
+    def shutdown(self) -> None:
+        self._dead = True
+        self.server.stop()
+        self._pool.close_all()

@@ -1,0 +1,578 @@
+"""Per-node manager daemon: worker pool, local scheduler, object store host.
+
+reference parity: src/ray/raylet/ — NodeManager (node_manager.h:125) with
+ClusterTaskManager/LocalTaskManager lease scheduling
+(scheduling/cluster_task_manager.cc:44, local_task_manager.cc:105),
+WorkerPool (worker_pool.cc:1150), placement-group bundle resources
+(placement_group_resource_manager.h), and the in-raylet plasma store host
+(object_manager/plasma/store_runner.h). Leases are granted asynchronously
+via a callback to the requesting core worker, mirroring the reference's
+RequestWorkerLease reply flow (node_manager.proto:361).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import rpc as rpc_lib
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_store import StoreServer
+from ray_tpu._private.scheduler import pick_node
+from ray_tpu._private.state import (NodeInfo, PlacementGroupSchedulingStrategy,
+                                    ResourceSet, TaskSpec, TaskType)
+
+logger = logging.getLogger(__name__)
+
+
+def pg_resource_name(resource: str, pg_id_hex: str, bundle_index: int = -1) -> str:
+    """Bundle-scoped resource names (reference bundle_spec.h: e.g.
+    CPU_group_0_<pgid> and CPU_group_<pgid>)."""
+    if bundle_index >= 0:
+        return f"{resource}_group_{bundle_index}_{pg_id_hex}"
+    return f"{resource}_group_{pg_id_hex}"
+
+
+def rewrite_resources_for_pg(resources: Dict[str, float], pg_id_hex: str,
+                             bundle_index: int) -> Dict[str, float]:
+    out = {}
+    for r, v in resources.items():
+        out[pg_resource_name(r, pg_id_hex, bundle_index)] = v
+    # Always require a sliver of the wildcard resource so tasks can only run
+    # on nodes holding a committed bundle of this group.
+    out.setdefault(pg_resource_name("bundle", pg_id_hex), 0.001)
+    return out
+
+
+@dataclass
+class _WorkerHandle:
+    worker_id: WorkerID
+    proc: Optional[subprocess.Popen]
+    address: Optional[Tuple[str, int]] = None
+    runtime_env_key: str = ""
+    idle_since: float = field(default_factory=time.time)
+    # Set while leased/executing
+    lease_id: Optional[str] = None
+    current_task: Optional[TaskSpec] = None
+    is_actor: bool = False
+    actor_id_hex: Optional[str] = None
+    registered: bool = False
+    blocked: bool = False  # released its resources while blocked in get
+
+
+@dataclass
+class _PendingLease:
+    lease_id: str
+    spec: TaskSpec
+    reply_to: Tuple[str, int]    # requesting core worker's RPC address
+    acquired: Optional[ResourceSet] = None
+    submitted_at: float = field(default_factory=time.time)
+
+
+class NodeManager:
+    def __init__(self, gcs_address: Tuple[str, int], session_dir: str,
+                 resources: Optional[Dict[str, float]] = None,
+                 is_head: bool = False, host: str = "127.0.0.1",
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_capacity: Optional[int] = None):
+        self.node_id = NodeID.from_random()
+        self.session_dir = session_dir
+        self.gcs_address = tuple(gcs_address)
+        self._pool = rpc_lib.ClientPool(timeout=60)
+        self._gcs = rpc_lib.RpcClient(self.gcs_address, timeout=60)
+        self._lock = threading.Lock()
+        self._dead = False
+
+        if resources is None:
+            resources = {}
+        resources.setdefault("CPU", float(os.cpu_count() or 1))
+        resources.setdefault("memory", float(64 << 30))
+        resources.setdefault("object_store_memory",
+                             float(Config.object_store_capacity_bytes))
+        # Accelerator autodetection (TPU chips as `TPU` resource).
+        from ray_tpu._private.accelerators import detect_node_accelerators
+        for k, v in detect_node_accelerators().items():
+            resources.setdefault(k, v)
+        self.resources_total = ResourceSet(resources)
+        self.available = ResourceSet(resources)
+
+        node_store_dir = os.path.join(session_dir, self.node_id.hex()[:12])
+        os.makedirs(node_store_dir, exist_ok=True)
+        self.store = StoreServer(
+            node_store_dir,
+            object_store_capacity or Config.object_store_capacity_bytes,
+            host=host)
+
+        self.workers: Dict[str, _WorkerHandle] = {}     # worker id hex -> handle
+        self.idle: Dict[str, List[str]] = {}            # runtime env key -> ids
+        self.pending: List[_PendingLease] = []
+        self.leases: Dict[str, str] = {}                # lease id -> worker id hex
+        self._starting = 0
+        self._starting_by_key: Dict[str, int] = {}
+        self._prepared: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._committed: Dict[Tuple[str, int], Tuple] = {}
+
+        self.server = rpc_lib.RpcServer({
+            "nm_ping": lambda: "pong",
+            "nm_register_worker": self.register_worker,
+            "nm_request_lease": self.request_lease,
+            "nm_cancel_lease": self.cancel_lease,
+            "nm_return_worker": self.return_worker,
+            "nm_schedule_actor_creation": self.schedule_actor_creation,
+            "nm_worker_blocked": self.worker_blocked,
+            "nm_worker_unblocked": self.worker_unblocked,
+            "nm_prepare_bundle": self.prepare_bundle,
+            "nm_commit_bundle": self.commit_bundle,
+            "nm_return_bundle": self.return_bundle,
+            "nm_get_info": self.get_info,
+            "nm_drain": self.drain,
+        }, host=host)
+        self.address = self.server.address
+
+        self.info = NodeInfo(
+            node_id=self.node_id, address=self.address,
+            store_address=self.store.address,
+            resources_total=self.resources_total.to_dict(),
+            labels=labels or {}, is_head=is_head)
+        self._gcs.call("register_node", info=self.info)
+        self._report_thread = threading.Thread(
+            target=self._resource_report_loop, daemon=True,
+            name=f"nm-report-{self.node_id.hex()[:6]}")
+        self._report_thread.start()
+
+    # ---- resource sync ---------------------------------------------------
+
+    def _resource_report_loop(self) -> None:
+        while not self._dead:
+            try:
+                with self._lock:
+                    avail = self.available.to_dict()
+                self._gcs.call("report_resources",
+                               node_id_hex=self.node_id.hex(), available=avail)
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(Config.resource_report_period_s)
+
+    def _cluster_view(self) -> Tuple[Dict[str, Dict[str, float]],
+                                     Dict[str, Dict[str, float]],
+                                     Dict[str, Tuple[str, int]]]:
+        try:
+            view = self._gcs.call("get_cluster_resources")
+            nodes = {n.node_id.hex(): n.address
+                     for n in self._gcs.call("get_all_nodes") if n.alive}
+        except Exception:  # noqa: BLE001
+            view, nodes = {}, {}
+        avail = {nid: v["available"] for nid, v in view.items()}
+        totals = {nid: v["total"] for nid, v in view.items()}
+        with self._lock:
+            avail[self.node_id.hex()] = self.available.to_dict()
+            totals[self.node_id.hex()] = self.resources_total.to_dict()
+        nodes.setdefault(self.node_id.hex(), self.address)
+        return avail, totals, nodes
+
+    # ---- worker pool (reference worker_pool.cc) -------------------------
+
+    def _runtime_env_key(self, spec: TaskSpec) -> str:
+        env_vars = (spec.runtime_env or {}).get("env_vars", {})
+        return repr(sorted(env_vars.items()))
+
+    def _spawn_worker(self, runtime_env_key: str,
+                      runtime_env: Optional[Dict[str, Any]]) -> _WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        # Make sure workers can import ray_tpu regardless of cwd.
+        import ray_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_NODE_MANAGER"] = f"{self.address[0]}:{self.address[1]}"
+        env["RAY_TPU_GCS"] = f"{self.gcs_address[0]}:{self.gcs_address[1]}"
+        env["RAY_TPU_STORE"] = f"{self.store.address[0]}:{self.store.address[1]}"
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+            env[str(k)] = str(v)
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"),
+                   "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            cwd=(runtime_env or {}).get("working_dir") or None)
+        handle = _WorkerHandle(worker_id=worker_id, proc=proc,
+                               runtime_env_key=runtime_env_key)
+        with self._lock:
+            self.workers[worker_id.hex()] = handle
+        threading.Thread(target=self._monitor_worker, args=(handle,),
+                         daemon=True).start()
+        return handle
+
+    def _monitor_worker(self, handle: _WorkerHandle) -> None:
+        proc = handle.proc
+        if proc is None:
+            return
+        proc.wait()
+        if self._dead:
+            return
+        self._on_worker_death(handle, f"worker process exited "
+                                      f"with code {proc.returncode}")
+
+    def _on_worker_death(self, handle: _WorkerHandle, reason: str) -> None:
+        with self._lock:
+            wid = handle.worker_id.hex()
+            if wid not in self.workers:
+                return
+            del self.workers[wid]
+            if not handle.registered:
+                self._starting = max(0, self._starting - 1)
+                key = handle.runtime_env_key
+                self._starting_by_key[key] = max(
+                    0, self._starting_by_key.get(key, 1) - 1)
+            for ids in self.idle.values():
+                if wid in ids:
+                    ids.remove(wid)
+            running = handle.current_task
+            lease_id = handle.lease_id
+            if lease_id is not None and lease_id in self.leases:
+                del self.leases[lease_id]
+            if running is not None and not handle.blocked:
+                # blocked workers already released their resources
+                self.available.add(self._effective_resources(running))
+        if handle.is_actor and handle.actor_id_hex:
+            try:
+                self._gcs.call("report_actor_death",
+                               actor_id_hex=handle.actor_id_hex,
+                               reason=reason, restart=True)
+            except Exception:  # noqa: BLE001
+                pass
+        if running is not None and not handle.is_actor:
+            try:
+                self._pool.get(running.owner_address).call(
+                    "cw_task_failed", task_id=running.task_id,
+                    error_type="WORKER_DIED", message=reason)
+            except Exception:  # noqa: BLE001
+                pass
+        self._dispatch()
+
+    def register_worker(self, worker_id_hex: str,
+                        address: Tuple[str, int]) -> Dict[str, Any]:
+        with self._lock:
+            handle = self.workers.get(worker_id_hex)
+            if handle is None:
+                raise KeyError(f"unknown worker {worker_id_hex}")
+            handle.address = tuple(address)
+            handle.registered = True
+            handle.idle_since = time.time()
+            self._starting = max(0, self._starting - 1)
+            key = handle.runtime_env_key
+            self._starting_by_key[key] = max(
+                0, self._starting_by_key.get(key, 1) - 1)
+            self.idle.setdefault(key, []).append(worker_id_hex)
+        self._dispatch()
+        return {"node_id": self.node_id.hex()}
+
+    def _pop_worker_locked(self, key: str) -> Optional[_WorkerHandle]:
+        """Reference WorkerPool::PopWorker: reuse idle w/ same runtime env."""
+        ids = self.idle.get(key, [])
+        while ids:
+            wid = ids.pop()
+            handle = self.workers.get(wid)
+            if handle is not None and handle.address is not None:
+                return handle
+        return None
+
+    def _pop_worker(self, spec: TaskSpec,
+                    spawn_if_needed: bool = True) -> Optional[_WorkerHandle]:
+        key = self._runtime_env_key(spec)
+        with self._lock:
+            handle = self._pop_worker_locked(key)
+            if handle is not None:
+                return handle
+            can_spawn = (spawn_if_needed
+                         and self._starting_by_key.get(key, 0) == 0
+                         and len(self.workers) + self._starting
+                         < Config.max_workers_per_node)
+            if can_spawn:
+                self._starting += 1
+                self._starting_by_key[key] = \
+                    self._starting_by_key.get(key, 0) + 1
+        if can_spawn:
+            self._spawn_worker(key, spec.runtime_env)
+        return None
+
+    # ---- leases (reference lease protocol, node_manager.proto:361) ------
+
+    def request_lease(self, spec: TaskSpec,
+                      reply_to: Tuple[str, int]) -> Tuple[str, Any]:
+        """Returns ("spill", node_mgr_addr) | ("queued", lease_id) |
+        ("infeasible", message)."""
+        from ray_tpu._private.state import NodeAffinitySchedulingStrategy
+        required = self._effective_resources(spec)
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, NodeAffinitySchedulingStrategy) \
+                and not strategy.soft \
+                and strategy.node_id != self.node_id.hex():
+            # Hard affinity to another node: route there; it queues or
+            # rejects. Never silently run elsewhere (reference
+            # node_affinity_scheduling_policy.h semantics).
+            _, _, nodes = self._cluster_view()
+            target = nodes.get(strategy.node_id)
+            if target is None:
+                return ("infeasible",
+                        f"hard-affinity node {strategy.node_id[:12]} is dead")
+            return ("spill", target)
+        avail, totals, nodes = self._cluster_view()
+        chosen = pick_node(avail, required, strategy,
+                           local_node_id=self.node_id.hex(), totals=totals)
+        if isinstance(strategy, NodeAffinitySchedulingStrategy) \
+                and not strategy.soft:
+            chosen = self.node_id.hex()  # queue here (we are the target)
+        if chosen is not None and chosen != self.node_id.hex():
+            return ("spill", nodes[chosen])
+        lease_id = uuid.uuid4().hex
+        pl = _PendingLease(lease_id=lease_id, spec=spec,
+                           reply_to=tuple(reply_to))
+        with self._lock:
+            self.pending.append(pl)
+        self._dispatch()
+        return ("queued", lease_id)
+
+    def _effective_resources(self, spec: TaskSpec) -> ResourceSet:
+        strategy = spec.scheduling_strategy
+        if (isinstance(strategy, PlacementGroupSchedulingStrategy)
+                and spec.placement_group_id is not None):
+            return ResourceSet(rewrite_resources_for_pg(
+                spec.resources, spec.placement_group_id.hex(),
+                spec.placement_group_bundle_index))
+        return spec.required_resources()
+
+    def _dispatch(self) -> None:
+        """Grant queued leases while resources + workers allow (reference
+        LocalTaskManager::DispatchScheduledTasksToWorkers)."""
+        granted: List[Tuple[_PendingLease, _WorkerHandle]] = []
+        spawns: List[Tuple[str, Optional[Dict[str, Any]]]] = []
+        with self._lock:
+            remaining: List[_PendingLease] = []
+            want_spawn: Dict[str, int] = {}
+            for pl in self.pending:
+                if pl.acquired is None:
+                    required = self._effective_resources(pl.spec)
+                    if required.is_subset_of(self.available):
+                        self.available.subtract(required)
+                        pl.acquired = required
+                    else:
+                        remaining.append(pl)
+                        continue
+                key = self._runtime_env_key(pl.spec)
+                handle = self._pop_worker_locked(key)
+                if handle is None:
+                    remaining.append(pl)
+                    want_spawn[key] = want_spawn.get(key, 0) + 1
+                    if want_spawn[key] > self._starting_by_key.get(key, 0) \
+                            and len(self.workers) + self._starting \
+                            < Config.max_workers_per_node:
+                        self._starting += 1
+                        self._starting_by_key[key] = \
+                            self._starting_by_key.get(key, 0) + 1
+                        spawns.append((key, pl.spec.runtime_env))
+                    continue
+                handle.lease_id = pl.lease_id
+                handle.current_task = pl.spec
+                self.leases[pl.lease_id] = handle.worker_id.hex()
+                granted.append((pl, handle))
+            self.pending = remaining
+        for key, renv in spawns:
+            self._spawn_worker(key, renv)
+        for pl, handle in granted:
+            try:
+                self._pool.get(pl.reply_to).call(
+                    "cw_lease_granted", lease_id=pl.lease_id,
+                    task_id=pl.spec.task_id,
+                    worker_address=handle.address,
+                    worker_id=handle.worker_id.hex(),
+                    node_id=self.node_id.hex(),
+                    nm_address=self.address)
+            except Exception:  # noqa: BLE001
+                logger.warning("lease reply to %s failed; reclaiming",
+                               pl.reply_to)
+                self.return_worker(pl.lease_id)
+
+    def cancel_lease(self, lease_id: str) -> None:
+        with self._lock:
+            for pl in list(self.pending):
+                if pl.lease_id == lease_id:
+                    self.pending.remove(pl)
+                    if pl.acquired is not None:
+                        self.available.add(pl.acquired)
+                    return
+        self.return_worker(lease_id)
+
+    def return_worker(self, lease_id: str, reuse: bool = True) -> None:
+        with self._lock:
+            wid = self.leases.pop(lease_id, None)
+            if wid is None:
+                return
+            handle = self.workers.get(wid)
+            if handle is None:
+                return
+            if handle.current_task is not None and not handle.blocked:
+                self.available.add(
+                    self._effective_resources(handle.current_task))
+            handle.blocked = False
+            handle.current_task = None
+            handle.lease_id = None
+            handle.idle_since = time.time()
+            if reuse:
+                self.idle.setdefault(handle.runtime_env_key, []).append(wid)
+        if not reuse and handle.proc is not None:
+            handle.proc.terminate()
+        self._dispatch()
+
+    # ---- actors ----------------------------------------------------------
+
+    def schedule_actor_creation(self, spec: TaskSpec) -> bool:
+        """Called by GCS actor scheduler. Reserves resources for actor
+        lifetime and pushes the creation task to a dedicated worker."""
+        required = self._effective_resources(spec)
+        with self._lock:
+            if not required.is_subset_of(self.available):
+                return False
+            self.available.subtract(required)
+        deadline = time.time() + Config.worker_register_timeout_s
+        handle: Optional[_WorkerHandle] = None
+        while handle is None and time.time() < deadline:
+            handle = self._pop_worker(spec)
+            if handle is None:
+                time.sleep(0.02)
+        if handle is None:
+            with self._lock:
+                self.available.add(required)
+            return False
+        with self._lock:
+            handle.is_actor = True
+            handle.actor_id_hex = spec.actor_id.hex()
+            handle.current_task = spec
+        try:
+            self._pool.get(handle.address).call("w_push_task", spec=spec)
+            return True
+        except Exception:  # noqa: BLE001
+            self._on_worker_death(handle, "actor creation push failed")
+            return False
+
+    def worker_blocked(self, worker_id_hex: str) -> None:
+        """Worker blocked in ray.get: release its cpu-ish resources so other
+        work can run (reference NotifyDirectCallTaskBlocked)."""
+        with self._lock:
+            handle = self.workers.get(worker_id_hex)
+            if handle is not None and handle.current_task is not None \
+                    and not handle.is_actor and not handle.blocked:
+                handle.blocked = True
+                self.available.add(self._effective_resources(
+                    handle.current_task))
+        self._dispatch()
+
+    def worker_unblocked(self, worker_id_hex: str) -> None:
+        with self._lock:
+            handle = self.workers.get(worker_id_hex)
+            if handle is not None and handle.current_task is not None \
+                    and not handle.is_actor and handle.blocked:
+                handle.blocked = False
+                # may oversubscribe transiently; reference re-acquires
+                self.available.subtract(self._effective_resources(
+                    handle.current_task))
+
+    # ---- placement group bundles (2-phase; reference
+    #      placement_group_resource_manager.h) ---------------------------
+
+    def prepare_bundle(self, pg_id_hex: str, bundle_index: int,
+                       resources: Dict[str, float]) -> bool:
+        required = ResourceSet(resources)
+        with self._lock:
+            if not required.is_subset_of(self.available):
+                return False
+            self.available.subtract(required)
+            self._prepared[(pg_id_hex, bundle_index)] = resources
+            return True
+
+    def commit_bundle(self, pg_id_hex: str, bundle_index: int) -> bool:
+        with self._lock:
+            resources = self._prepared.pop((pg_id_hex, bundle_index), None)
+            if resources is None:
+                return False
+            add: Dict[str, float] = {}
+            for r, v in resources.items():
+                add[pg_resource_name(r, pg_id_hex, bundle_index)] = v
+                add[pg_resource_name(r, pg_id_hex)] = v
+            add[pg_resource_name("bundle", pg_id_hex, bundle_index)] = 1000
+            add[pg_resource_name("bundle", pg_id_hex)] = 1000
+            self.resources_total.add(ResourceSet(add))
+            self.available.add(ResourceSet(add))
+            self._committed[(pg_id_hex, bundle_index)] = (resources, add)
+            return True
+
+    def return_bundle(self, pg_id_hex: str, bundle_index: int) -> None:
+        with self._lock:
+            resources = self._prepared.pop((pg_id_hex, bundle_index), None)
+            if resources is not None:
+                self.available.add(ResourceSet(resources))
+                return
+            entry = self._committed.pop((pg_id_hex, bundle_index), None)
+            if entry is not None:
+                resources, add = entry
+                self.resources_total.subtract(ResourceSet(add))
+                self.available.subtract(ResourceSet(add))
+                self.available.add(ResourceSet(resources))
+
+    # ---- misc ------------------------------------------------------------
+
+    def get_info(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "node_id": self.node_id.hex(),
+                "address": self.address,
+                "store_address": self.store.address,
+                "resources_total": self.resources_total.to_dict(),
+                "available": self.available.to_dict(),
+                "num_workers": len(self.workers),
+                "num_pending_leases": len(self.pending),
+            }
+
+    def drain(self) -> None:
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        if self._dead:
+            return
+        self._dead = True
+        with self._lock:
+            workers = list(self.workers.values())
+        for handle in workers:
+            if handle.proc is not None:
+                try:
+                    handle.proc.terminate()
+                except OSError:
+                    pass
+        for handle in workers:
+            if handle.proc is not None:
+                try:
+                    handle.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    handle.proc.kill()
+        try:
+            self._gcs.call("unregister_node", node_id_hex=self.node_id.hex())
+        except Exception:  # noqa: BLE001
+            pass
+        self.store.shutdown()
+        self.server.stop()
+        self._pool.close_all()
+        self._gcs.close()

@@ -1,0 +1,92 @@
+"""ObjectRef: a distributed future with ownership metadata.
+
+reference parity: ObjectRef in python/ray/includes/object_ref.pxi — carries
+the object id plus the owner's address so any holder can resolve the value,
+and participates in reference counting via __del__.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_address", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID,
+                 owner_address: Optional[Tuple[str, int]] = None,
+                 _register: bool = True):
+        self._id = object_id
+        self._owner_address = tuple(owner_address) if owner_address else None
+        self._registered = False
+        if _register:
+            from ray_tpu._private import worker as worker_mod
+            w = worker_mod.global_worker_or_none()
+            if w is not None:
+                w.core_worker.add_local_ref(self)
+                self._registered = True
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    @property
+    def owner_address(self) -> Optional[Tuple[str, int]]:
+        return self._owner_address
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self) -> None:
+        if self._registered:
+            try:
+                from ray_tpu._private import worker as worker_mod
+                w = worker_mod.global_worker_or_none()
+                if w is not None:
+                    w.core_worker.remove_local_ref(self)
+            except Exception:  # noqa: BLE001 - interpreter shutdown
+                pass
+
+    def __reduce__(self):
+        # Serialized refs re-register on the receiving process; the sender's
+        # core worker pins the object for in-flight arg refs separately.
+        return (_deserialize_ref, (self._id, self._owner_address))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from ray_tpu._private import worker as worker_mod
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        w = worker_mod.global_worker()
+
+        def _wait() -> None:
+            try:
+                fut.set_result(w.core_worker.get([self], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+        threading.Thread(target=_wait, daemon=True).start()
+        return fut
+
+
+def _deserialize_ref(object_id: ObjectID,
+                     owner_address: Optional[Tuple[str, int]]) -> ObjectRef:
+    return ObjectRef(object_id, owner_address)

@@ -1,0 +1,303 @@
+"""Per-node shared-memory object store (plasma equivalent).
+
+reference parity: src/ray/object_manager/plasma/store.h (PlasmaStore),
+object_lifecycle_manager.h, eviction_policy.h (LRU), plus the node-to-node
+chunked transfer of src/ray/object_manager/{push,pull}_manager.h.
+
+Design: every node manager hosts a StoreServer. Object payloads live as
+mmap-able files under /dev/shm/<session>/ so any process on the node maps
+them zero-copy; the server coordinates create/seal/wait/delete metadata,
+LRU-evicts unpinned sealed objects under memory pressure, and serves chunked
+reads so a peer store can pull objects across nodes. A later C++ arena
+allocator can replace the file-per-object layout behind the same client API.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import rpc as rpc_lib
+
+CHUNK_SIZE = 8 << 20  # 8 MiB transfer chunks (reference object_buffer_pool)
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+@dataclass
+class _Entry:
+    path: str
+    size: int
+    sealed: bool = False
+    pinned: int = 0          # pin count (owner pins while referenced)
+    last_access: float = field(default_factory=time.time)
+    creating: bool = True
+
+
+class StoreServer:
+    """Metadata + lifecycle authority for one node's shared-memory objects."""
+
+    def __init__(self, session_dir: str, capacity_bytes: int,
+                 host: str = "127.0.0.1"):
+        self.dir = os.path.join(session_dir, "objects")
+        os.makedirs(self.dir, exist_ok=True)
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._objects: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+        self._sealed_cv = threading.Condition(self._lock)
+        self._pool = rpc_lib.ClientPool(timeout=60)
+        self.server = rpc_lib.RpcServer({
+            "store_create": self.create,
+            "store_seal": self.seal,
+            "store_wait": self.wait,
+            "store_contains": self.contains,
+            "store_delete": self.delete,
+            "store_pin": self.pin,
+            "store_unpin": self.unpin,
+            "store_read_chunk": self.read_chunk,
+            "store_pull": self.pull,
+            "store_put_raw": self.put_raw,
+            "store_stats": self.stats,
+        }, host=host)
+        self.address = self.server.address
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _evict_until(self, needed: int) -> None:
+        """LRU-evict sealed, unpinned objects (reference eviction_policy.h)."""
+        if self.used + needed <= self.capacity:
+            return
+        victims = sorted(
+            ((e.last_access, oid) for oid, e in self._objects.items()
+             if e.sealed and e.pinned == 0),
+            key=lambda t: t[0])
+        for _, oid in victims:
+            if self.used + needed <= self.capacity:
+                return
+            self._delete_locked(oid)
+        if self.used + needed > self.capacity:
+            raise ObjectStoreFullError(
+                f"object store full: need {needed}, used {self.used}/{self.capacity}")
+
+    def _delete_locked(self, object_id: str) -> None:
+        e = self._objects.pop(object_id, None)
+        if e is None:
+            return
+        self.used -= e.size
+        try:
+            os.unlink(e.path)
+        except OSError:
+            pass
+
+    def create(self, object_id: str, size: int, pin: bool = True) -> str:
+        """Allocate backing file; returns its path for the client to mmap.
+
+        Primary (owner-written) copies are created pinned so LRU eviction
+        can't drop an object the owner still references; delete() (driven by
+        the owner's refcount) removes them. Pulled replica copies are created
+        unpinned and evictable (the primary still exists elsewhere).
+        """
+        with self._lock:
+            if object_id in self._objects:
+                e = self._objects[object_id]
+                return e.path
+            self._evict_until(size)
+            path = os.path.join(self.dir, object_id)
+            with open(path, "wb") as f:
+                f.truncate(max(size, 1))
+            self._objects[object_id] = _Entry(path=path, size=size,
+                                              pinned=1 if pin else 0)
+            self.used += size
+            return path
+
+    def put_raw(self, object_id: str, data: bytes, pin: bool = False) -> None:
+        """Create + write + seal in one RPC (remote pushes, small writers)."""
+        path = self.create(object_id, len(data), pin=pin)
+        with open(path, "r+b") as f:
+            f.write(data)
+        self.seal(object_id)
+
+    def seal(self, object_id: str) -> None:
+        with self._sealed_cv:
+            e = self._objects.get(object_id)
+            if e is None:
+                raise KeyError(f"seal of unknown object {object_id}")
+            e.sealed = True
+            e.creating = False
+            e.last_access = time.time()
+            self._sealed_cv.notify_all()
+
+    def wait(self, object_ids: List[str], timeout: Optional[float] = None,
+             num_required: Optional[int] = None) -> Dict[str, Tuple[str, int]]:
+        """Block until objects are sealed locally; returns {id: (path, size)}.
+        Objects not present locally are NOT fetched here (see pull)."""
+        deadline = None if timeout is None else time.time() + timeout
+        num_required = len(object_ids) if num_required is None else num_required
+        with self._sealed_cv:
+            while True:
+                ready = {}
+                for oid in object_ids:
+                    e = self._objects.get(oid)
+                    if e is not None and e.sealed:
+                        e.last_access = time.time()
+                        ready[oid] = (e.path, e.size)
+                if len(ready) >= num_required:
+                    return ready
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                self._sealed_cv.wait(timeout=min(remaining or 1.0, 1.0))
+
+    def contains(self, object_id: str) -> bool:
+        with self._lock:
+            e = self._objects.get(object_id)
+            return e is not None and e.sealed
+
+    def delete(self, object_ids: List[str]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                self._delete_locked(oid)
+
+    def pin(self, object_id: str) -> None:
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is not None:
+                e.pinned += 1
+
+    def unpin(self, object_id: str) -> None:
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is not None and e.pinned > 0:
+                e.pinned -= 1
+
+    # -- node-to-node transfer --------------------------------------------
+
+    def read_chunk(self, object_id: str, offset: int, length: int) -> bytes:
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is None or not e.sealed:
+                raise KeyError(f"read_chunk: {object_id} not sealed here")
+            path, size = e.path, e.size
+            e.last_access = time.time()
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(min(length, size - offset))
+
+    def pull(self, object_id: str, from_store: Tuple[str, int],
+             size: int) -> Tuple[str, int]:
+        """Pull an object from a peer store into this one (chunked).
+        reference parity: pull_manager.h / push_manager.h chunk streaming."""
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is not None and e.sealed:
+                return e.path, e.size
+        path = self.create(object_id, size, pin=False)
+        client = self._pool.get(tuple(from_store))
+        with open(path, "r+b") as f:
+            off = 0
+            while off < size:
+                chunk = client.call("store_read_chunk", object_id=object_id,
+                                    offset=off, length=CHUNK_SIZE)
+                f.write(chunk)
+                off += len(chunk)
+                if not chunk:
+                    raise IOError(f"short read pulling {object_id}")
+        self.seal(object_id)
+        return path, size
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {"used": self.used, "capacity": self.capacity,
+                    "num_objects": len(self._objects)}
+
+    def shutdown(self) -> None:
+        self.server.stop()
+        with self._lock:
+            for oid in list(self._objects):
+                self._delete_locked(oid)
+
+
+class StoreClient:
+    """Per-process client: RPC for metadata, direct mmap for payload."""
+
+    def __init__(self, store_address: Tuple[str, int]):
+        self.address = tuple(store_address)
+        self._rpc = rpc_lib.RpcClient(self.address, timeout=None)
+        self._maps: Dict[str, Tuple[mmap.mmap, memoryview]] = {}
+        self._lock = threading.Lock()
+
+    def create(self, object_id: str, size: int) -> memoryview:
+        path = self._rpc.call("store_create", object_id=object_id, size=size)
+        return self._map(object_id, path, size, writable=True)
+
+    def _map(self, object_id: str, path: str, size: int,
+             writable: bool = False) -> memoryview:
+        with self._lock:
+            cached = self._maps.get(object_id)
+            if cached is not None:
+                return cached[1]
+            fd = os.open(path, os.O_RDWR if writable else os.O_RDONLY)
+            try:
+                mm = mmap.mmap(fd, max(size, 1),
+                               prot=(mmap.PROT_READ | mmap.PROT_WRITE)
+                               if writable else mmap.PROT_READ)
+            finally:
+                os.close(fd)
+            view = memoryview(mm)[:size]
+            self._maps[object_id] = (mm, view)
+            return view
+
+    def seal(self, object_id: str) -> None:
+        self._rpc.call("store_seal", object_id=object_id)
+
+    def put_raw(self, object_id: str, data: bytes) -> None:
+        if len(data) > CHUNK_SIZE:
+            buf = self.create(object_id, len(data))
+            buf[:] = data
+            self.seal(object_id)
+        else:
+            self._rpc.call("store_put_raw", object_id=object_id, data=data)
+
+    def get(self, object_ids: List[str], timeout: Optional[float] = None
+            ) -> Dict[str, memoryview]:
+        meta = self._rpc.call("store_wait", object_ids=object_ids,
+                              timeout=timeout)
+        return {oid: self._map(oid, path, size)
+                for oid, (path, size) in meta.items()}
+
+    def contains(self, object_id: str) -> bool:
+        return self._rpc.call("store_contains", object_id=object_id)
+
+    def pull(self, object_id: str, from_store: Tuple[str, int], size: int
+             ) -> memoryview:
+        path, size = self._rpc.call("store_pull", object_id=object_id,
+                                    from_store=tuple(from_store), size=size)
+        return self._map(object_id, path, size)
+
+    def delete(self, object_ids: List[str]) -> None:
+        self._release(object_ids)
+        self._rpc.call("store_delete", object_ids=object_ids)
+
+    def _release(self, object_ids: List[str]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                m = self._maps.pop(oid, None)
+                if m is not None:
+                    try:
+                        m[1].release()
+                        m[0].close()
+                    except (BufferError, ValueError):
+                        pass  # a live numpy view still references the map
+
+    def stats(self) -> Dict[str, float]:
+        return self._rpc.call("store_stats")
+
+    def close(self) -> None:
+        self._rpc.close()

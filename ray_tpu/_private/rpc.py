@@ -1,0 +1,193 @@
+"""Tiny length-prefixed RPC layer over TCP sockets.
+
+TPU-native rebuild of the reference's gRPC control plane (reference:
+src/ray/rpc/grpc_server.h, grpc_client.h). The reference wraps gRPC services;
+we use a minimal framed-pickle protocol: every process that serves RPCs hosts
+an RpcServer with named handlers; clients hold pooled persistent connections.
+
+Wire format: 8-byte big-endian length | pickled (method, kwargs) request,
+same framing for the pickled (status, payload) reply.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct(">Q")
+
+
+class RpcError(Exception):
+    """Remote handler raised; carries the remote traceback string."""
+
+
+class ConnectionLost(Exception):
+    """Peer went away mid-call."""
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 4 << 20))
+        if not chunk:
+            raise ConnectionLost("socket closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_exact(sock, n)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: RpcServer = self.server.rpc_server  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                req = _recv_frame(sock)
+                method, kwargs = pickle.loads(req)
+                try:
+                    handler = server.handlers[method]
+                except KeyError:
+                    reply = ("err", f"no such rpc method: {method}")
+                else:
+                    try:
+                        result = handler(**kwargs)
+                        reply = ("ok", result)
+                    except Exception:  # noqa: BLE001 - ship traceback to caller
+                        reply = ("err", traceback.format_exc())
+                _send_frame(sock, pickle.dumps(reply, protocol=5))
+        except (ConnectionLost, ConnectionResetError, BrokenPipeError, OSError):
+            return
+
+
+class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RpcServer:
+    """Threaded RPC server; one thread per client connection."""
+
+    def __init__(self, handlers: Dict[str, Callable], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.handlers = dict(handlers)
+        self._server = _ThreadingTCPServer((host, port), _Handler)
+        self._server.rpc_server = self  # type: ignore[attr-defined]
+        self.address: Tuple[str, int] = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name=f"rpc-server-{self.address[1]}")
+        self._thread.start()
+
+    def register(self, method: str, fn: Callable) -> None:
+        self.handlers[method] = fn
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class RpcClient:
+    """Client with one persistent connection, thread-safe via a lock.
+
+    For concurrent calls from many threads use one client per thread or a
+    ClientPool; a single in-flight call holds the lock end-to-end (the
+    protocol is strictly request/reply per connection).
+    """
+
+    def __init__(self, address: Tuple[str, int], timeout: Optional[float] = None):
+        self.address = tuple(address)
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.address, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def call(self, method: str, **kwargs: Any) -> Any:
+        payload = pickle.dumps((method, kwargs), protocol=5)
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                sent = False
+                try:
+                    _send_frame(self._sock, payload)
+                    sent = True
+                    reply = _recv_frame(self._sock)
+                    break
+                except (ConnectionLost, ConnectionResetError, BrokenPipeError,
+                        OSError):
+                    self.close_locked()
+                    # Only retry when the request never left this client
+                    # (stale pooled connection died on send). After a
+                    # successful send the handler may have executed —
+                    # re-sending would duplicate a non-idempotent RPC.
+                    if sent or attempt == 1:
+                        raise ConnectionLost(
+                            f"rpc to {self.address} failed: {method}")
+        status, result = pickle.loads(reply)
+        if status != "ok":
+            raise RpcError(f"remote error from {self.address}.{method}:\n{result}")
+        return result
+
+    def close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self.close_locked()
+
+
+class ClientPool:
+    """Cache of RpcClients keyed by address."""
+
+    def __init__(self, timeout: Optional[float] = None):
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._lock = threading.Lock()
+        self._timeout = timeout
+
+    def get(self, address: Tuple[str, int]) -> RpcClient:
+        address = tuple(address)
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None:
+                client = RpcClient(address, timeout=self._timeout)
+                self._clients[address] = client
+            return client
+
+    def invalidate(self, address: Tuple[str, int]) -> None:
+        with self._lock:
+            client = self._clients.pop(tuple(address), None)
+        if client is not None:
+            client.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()

@@ -1,0 +1,77 @@
+"""Serialization: cloudpickle for code, pickle5 + out-of-band buffers for data.
+
+reference parity: python/ray/_private/serialization.py (SerializationContext).
+Values are serialized to a (meta, buffers) envelope so large numpy/jax arrays
+travel as raw buffers that can land in (and be read zero-copy out of) the
+shared-memory object store.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+
+def dumps_function(fn: Any) -> bytes:
+    """Serialize a function/class by value (for export to the GCS fn table)."""
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(blob: bytes) -> Any:
+    return cloudpickle.loads(blob)
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Pickle5 with out-of-band buffers. Falls back to cloudpickle (in-band)
+    when the value graph contains code objects pickle can't handle."""
+    buffers: List[pickle.PickleBuffer] = []
+    try:
+        meta = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+        return b"P" + meta, buffers
+    except Exception:  # noqa: BLE001 - lambdas/local classes etc.
+        buffers = []
+        f = io.BytesIO()
+        cloudpickle.CloudPickler(
+            f, protocol=5, buffer_callback=buffers.append).dump(value)
+        return b"C" + f.getvalue(), buffers
+
+
+def deserialize(meta: bytes, buffers: List[Any]) -> Any:
+    tag, body = meta[:1], meta[1:]
+    if tag in (b"P", b"C"):
+        return pickle.loads(body, buffers=buffers)
+    raise ValueError(f"bad serialization tag {tag!r}")
+
+
+def pack(value: Any) -> bytes:
+    """Serialize into one contiguous blob: u32 meta_len | meta | u32 nbuf |
+    (u64 len | bytes)*  — the on-disk/shm layout of a stored object."""
+    import struct
+    meta, buffers = serialize(value)
+    parts = [struct.pack(">I", len(meta)), meta, struct.pack(">I", len(buffers))]
+    for b in buffers:
+        raw = b.raw()
+        parts.append(struct.pack(">Q", raw.nbytes))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def unpack(buf: memoryview) -> Any:
+    """Zero-copy deserialize from a packed blob (buffers view into `buf`)."""
+    import struct
+    (meta_len,) = struct.unpack_from(">I", buf, 0)
+    off = 4
+    meta = bytes(buf[off:off + meta_len])
+    off += meta_len
+    (nbuf,) = struct.unpack_from(">I", buf, off)
+    off += 4
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = struct.unpack_from(">Q", buf, off)
+        off += 8
+        buffers.append(buf[off:off + blen])
+        off += blen
+    return deserialize(meta, buffers)

@@ -1,0 +1,180 @@
+"""Shared runtime data structures: task specs, resources, node info.
+
+TaskSpecification equivalent of reference src/ray/common/task/task_spec.h —
+but as plain dataclasses shipped over the framed-pickle RPC instead of
+protobuf. Resource accounting mirrors reference
+src/ray/common/scheduling/resource_set.h (fixed-point there; floats with an
+epsilon here, quantized to 1e-4 like the reference's FixedPoint).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
+                                  PlacementGroupID, TaskID, WorkerID)
+
+RESOURCE_EPS = 1e-4
+
+
+def quantize(v: float) -> float:
+    """Quantize to 1e-4 granularity (reference FixedPoint precision)."""
+    return round(v / RESOURCE_EPS) * RESOURCE_EPS
+
+
+class ResourceSet:
+    """A bag of named resource quantities with fixed-point-ish arithmetic."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None):
+        self._r = {k: quantize(float(v)) for k, v in (resources or {}).items()
+                   if v and float(v) > 0}
+
+    def get(self, name: str) -> float:
+        return self._r.get(name, 0.0)
+
+    def to_dict(self) -> Dict[str, float]:
+        return dict(self._r)
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other.get(k) + RESOURCE_EPS / 2 >= v for k, v in self._r.items())
+
+    def subtract(self, other: "ResourceSet") -> None:
+        for k, v in other._r.items():
+            self._r[k] = quantize(self._r.get(k, 0.0) - v)
+
+    def add(self, other: "ResourceSet") -> None:
+        for k, v in other._r.items():
+            self._r[k] = quantize(self._r.get(k, 0.0) + v)
+
+    def is_empty(self) -> bool:
+        return not any(v > RESOURCE_EPS / 2 for v in self._r.values())
+
+    def __repr__(self) -> str:
+        return f"ResourceSet({self._r})"
+
+
+class SchedulingStrategy:
+    """Base for scheduling strategies (reference util/scheduling_strategies.py)."""
+
+
+@dataclass
+class DefaultSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id: str = ""
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group: Any = None  # PlacementGroup handle
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+class TaskType(Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class TaskSpec:
+    """Everything an executor needs to run a task.
+
+    reference parity: src/ray/common/task/task_spec.h TaskSpecification.
+    `function_key` points at the exported function/class blob in the GCS
+    function table (reference: _private/function_manager.py export keys).
+    """
+
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    function_key: str                  # GCS KV key of the pickled function/class
+    function_name: str                 # human-readable, for errors/state API
+    args: bytes                        # serialized (args, kwargs) envelope
+    arg_object_refs: List[ObjectID]    # top-level ObjectRef deps to resolve
+    num_returns: int
+    resources: Dict[str, float]
+    owner_address: Tuple[str, int]     # core-worker RPC addr of the submitter
+    owner_worker_id: WorkerID
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    actor_method_name: str = ""
+    sequence_number: int = -1          # ordering for actor tasks
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    # Normal-task fields
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # Scheduling
+    scheduling_strategy: SchedulingStrategy = field(
+        default_factory=DefaultSchedulingStrategy)
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    # Runtime env (dict: env_vars, working_dir, ...)
+    runtime_env: Optional[Dict[str, Any]] = None
+    # Misc
+    name: str = ""
+    namespace: str = ""
+    detached: bool = False
+    submitted_at: float = field(default_factory=time.time)
+
+    def required_resources(self) -> ResourceSet:
+        return ResourceSet(self.resources)
+
+    def scheduling_key(self) -> Tuple:
+        """Tasks with the same key can reuse a leased worker (reference:
+        direct_task_transport lease reuse, SchedulingKey)."""
+        return (self.function_key, tuple(sorted(self.resources.items())),
+                type(self.scheduling_strategy).__name__,
+                self.placement_group_id.hex() if self.placement_group_id else "",
+                self.placement_group_bundle_index,
+                repr(sorted((self.runtime_env or {}).get("env_vars", {}).items())))
+
+
+class WorkerExitType(Enum):
+    IDLE = 0
+    INTENDED = 1
+    CRASH = 2
+    NODE_DEATH = 3
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: Tuple[str, int]            # node manager RPC address
+    store_address: Tuple[str, int]      # object store server RPC address
+    resources_total: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    is_head: bool = False
+    start_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: str
+    namespace: str
+    class_name: str
+    state: str                           # PENDING/ALIVE/RESTARTING/DEAD
+    address: Optional[Tuple[str, int]]   # worker core RPC address when ALIVE
+    node_id: Optional[NodeID]
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""

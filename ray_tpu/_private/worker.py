@@ -1,0 +1,168 @@
+"""Global per-process worker state + driver bootstrap.
+
+reference parity: python/ray/_private/worker.py — the module-level Worker
+singleton (`global_worker`, worker.py:411), `init` (worker.py:1165) and
+`connect`/`shutdown` (worker.py:2122, :1742). Head bring-up hosts the GCS and
+a node manager in-process (the reference spawns separate gcs_server/raylet
+binaries via _private/services.py; a standalone-process mode exists via
+`ray_tpu._private.node_main` for the multi-node test harness).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private.ids import JobID
+
+
+@dataclass
+class Worker:
+    core_worker: Any
+    mode: str                      # "driver" | "worker"
+    gcs_address: Tuple[str, int]
+    node_manager_address: Tuple[str, int]
+    node: Any = None               # head Node (driver-embedded services)
+    namespace: str = ""
+
+    @property
+    def connected(self) -> bool:
+        return self.core_worker is not None
+
+
+_global_worker: Optional[Worker] = None
+
+
+def global_worker() -> Worker:
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu.init() has not been called in this process")
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional[Worker]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional[Worker]) -> None:
+    global _global_worker
+    _global_worker = w
+
+
+class HeadNode:
+    """Driver-embedded head services: GCS + node manager + session dir.
+
+    reference parity: python/ray/_private/node.py Node(head=True) →
+    start_head_processes (node.py:1300).
+    """
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None,
+                 num_cpus: Optional[float] = None,
+                 object_store_memory: Optional[int] = None,
+                 session_root: Optional[str] = None):
+        from ray_tpu._private.gcs import GcsServer
+        from ray_tpu._private.node_manager import NodeManager
+
+        base = session_root or (
+            "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir())
+        self.session_dir = os.path.join(
+            base, f"ray_tpu_session_{int(time.time() * 1000)}_{os.getpid()}")
+        os.makedirs(self.session_dir, exist_ok=True)
+
+        self.gcs = GcsServer()
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        self.node_manager = NodeManager(
+            gcs_address=self.gcs.address, session_dir=self.session_dir,
+            resources=res, is_head=True,
+            object_store_capacity=object_store_memory)
+
+    def shutdown(self) -> None:
+        self.node_manager.shutdown()
+        self.gcs.shutdown()
+        shutil.rmtree(self.session_dir, ignore_errors=True)
+
+
+def init(address: Optional[str] = None, *,
+         resources: Optional[Dict[str, float]] = None,
+         num_cpus: Optional[float] = None,
+         object_store_memory: Optional[int] = None,
+         namespace: str = "",
+         ignore_reinit_error: bool = False,
+         _session_root: Optional[str] = None) -> Worker:
+    """Connect this process as a driver; bootstrap a head if no address."""
+    global _global_worker
+    if _global_worker is not None:
+        if ignore_reinit_error:
+            return _global_worker
+        raise RuntimeError("ray_tpu.init() called twice "
+                           "(use ignore_reinit_error=True)")
+
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.rpc import RpcClient
+
+    node = None
+    if address is None:
+        node = HeadNode(resources=resources, num_cpus=num_cpus,
+                        object_store_memory=object_store_memory,
+                        session_root=_session_root)
+    if node is not None:
+        gcs_address = node.gcs.address
+        nm_address = node.node_manager.address
+        store_address = node.node_manager.store.address
+        node_id_hex = node.node_manager.node_id.hex()
+    else:
+        host, port = address.rsplit(":", 1)
+        gcs_address = (host, int(port))
+        gcs = RpcClient(gcs_address, timeout=30)
+        nodes = [n for n in gcs.call("get_all_nodes") if n.alive]
+        if not nodes:
+            raise RuntimeError(f"no alive nodes at {address}")
+        head = next((n for n in nodes if n.is_head), nodes[0])
+        nm_address = head.address
+        store_address = head.store_address
+        node_id_hex = head.node_id.hex()
+        gcs.close()
+
+    gcs = RpcClient(gcs_address, timeout=30)
+    job_id: JobID = gcs.call("next_job_id")
+    gcs.close()
+
+    cw = CoreWorker(mode="driver", job_id=job_id, gcs_address=gcs_address,
+                    node_manager_address=nm_address,
+                    store_address=store_address, node_id_hex=node_id_hex)
+    _global_worker = Worker(core_worker=cw, mode="driver",
+                            gcs_address=gcs_address,
+                            node_manager_address=nm_address, node=node,
+                            namespace=namespace)
+    atexit.register(shutdown)
+    return _global_worker
+
+
+def shutdown() -> None:
+    global _global_worker
+    w = _global_worker
+    if w is None:
+        return
+    _global_worker = None
+    try:
+        w.core_worker._shutdown = True
+        if w.node is not None:
+            w.node.shutdown()
+        w.core_worker.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        atexit.unregister(shutdown)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None

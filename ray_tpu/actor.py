@@ -1,0 +1,188 @@
+"""ActorClass / ActorHandle / ActorMethod.
+
+reference parity: python/ray/actor.py — ActorClass (:425), ActorClass._remote
+(:708), ActorHandle (:1067), ActorMethod (:107). Actor-only options per
+_private/ray_option_utils.py: max_restarts, max_task_retries,
+max_concurrency, lifetime, name, namespace, get_if_exists, max_pending_calls,
+concurrency_groups.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ActorID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.state import (DefaultSchedulingStrategy, TaskSpec,
+                                    TaskType)
+from ray_tpu.remote_function import build_resources, pack_args, _extract_pg
+
+_ACTOR_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "memory", "name",
+    "namespace", "lifetime", "max_restarts", "max_task_retries",
+    "max_concurrency", "max_pending_calls", "get_if_exists",
+    "scheduling_strategy", "runtime_env", "accelerator_type",
+    "placement_group", "placement_group_bundle_index",
+    "placement_group_capture_child_tasks", "object_store_memory",
+    "concurrency_groups", "_metadata",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **kwargs: Any) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name,
+                           kwargs.get("num_returns", self._num_returns))
+
+    def remote(self, *args: Any, **kwargs: Any) -> Any:
+        return self._handle._submit(self._method_name, args, kwargs,
+                                    self._num_returns)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        raise TypeError(
+            f"actor method '{self._method_name}' cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 method_names: List[str], fn_key: str):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_names = list(method_names)
+        self._fn_key = fn_key
+        w = worker_mod.global_worker_or_none()
+        if w is not None:
+            w.core_worker.attach_actor(actor_id)
+
+    @property
+    def _actor_id_hex(self) -> str:
+        return self._actor_id.hex()
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name} has no method '{name}'")
+        return ActorMethod(self, name)
+
+    def _submit(self, method_name: str, args: tuple, kwargs: dict,
+                num_returns: int) -> Any:
+        w = worker_mod.global_worker()
+        args_blob, arg_refs = pack_args(args, kwargs)
+        refs = w.core_worker.submit_actor_task(
+            self._actor_id, method_name, self._fn_key, args_blob, arg_refs,
+            num_returns)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_names, self._fn_key))
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        bad = set(self._options) - _ACTOR_OPTIONS
+        if bad:
+            raise ValueError(f"invalid actor options: {sorted(bad)}")
+        self._fn_key: Optional[str] = None
+
+    def options(self, **kwargs: Any) -> "ActorClass":
+        ac = ActorClass(self._cls, {**self._options, **kwargs})
+        ac._fn_key = self._fn_key
+        return ac
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        raise TypeError(
+            f"actor class '{self._cls.__name__}' cannot be instantiated "
+            f"directly; use .remote()")
+
+    def _method_names(self) -> List[str]:
+        return [m for m in dir(self._cls)
+                if not m.startswith("_") and callable(getattr(self._cls, m))]
+
+    def remote(self, *args: Any, **kwargs: Any) -> ActorHandle:
+        w = worker_mod.global_worker()
+        cw = w.core_worker
+        opts = self._options
+        name = opts.get("name") or ""
+        namespace = opts.get("namespace") or w.namespace
+
+        if name and opts.get("get_if_exists"):
+            info = cw._gcs.call("get_named_actor", name=name,
+                                namespace=namespace)
+            if info is not None and info.state != "DEAD":
+                if self._fn_key is None:
+                    self._fn_key = cw.export_function(self._cls)
+                return ActorHandle(info.actor_id, self._cls.__name__,
+                                   self._method_names(), self._fn_key)
+
+        if self._fn_key is None:
+            self._fn_key = cw.export_function(self._cls)
+        actor_id = ActorID.of(cw.job_id)
+        args_blob, arg_refs = pack_args(args, kwargs)
+        strategy = opts.get("scheduling_strategy") or \
+            DefaultSchedulingStrategy()
+        pg_id, bundle_idx = _extract_pg(opts, strategy)
+        lifetime = opts.get("lifetime")
+        max_restarts = int(opts.get("max_restarts", 0))
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id), job_id=cw.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function_key=self._fn_key, function_name=self._cls.__name__,
+            args=args_blob, arg_object_refs=arg_refs, num_returns=0,
+            # reference semantics: actors default to 0 CPU for their
+            # lifetime (ray_option_utils: num_cpus default 1 for creation,
+            # 0 held) — we hold what's requested, defaulting to 0.
+            resources=build_resources(opts, default_num_cpus=0.0),
+            owner_address=cw.address, owner_worker_id=cw.worker_id,
+            actor_id=actor_id, max_restarts=max_restarts,
+            max_task_retries=int(opts.get("max_task_retries", 0)),
+            max_concurrency=int(opts.get("max_concurrency", 1)),
+            scheduling_strategy=strategy, placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_idx,
+            runtime_env=opts.get("runtime_env"),
+            name=name, namespace=namespace,
+            detached=(lifetime == "detached"))
+        import pickle
+        cw._gcs.call("kv_put", key=f"__actor_spec_meta:{actor_id.hex()}",
+                     value=pickle.dumps((self._fn_key, self._method_names())))
+        cw.create_actor(spec, name=name, namespace=namespace)
+        return ActorHandle(actor_id, self._cls.__name__,
+                           self._method_names(), self._fn_key)
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    """Look up a named actor (reference ray.get_actor)."""
+    w = worker_mod.global_worker()
+    info = w.core_worker._gcs.call("get_named_actor", name=name,
+                                   namespace=namespace or w.namespace)
+    if info is None or info.state == "DEAD":
+        raise ValueError(f"no live actor named '{name}'")
+    fn_key, methods = _actor_class_meta(w, info.actor_id.hex())
+    return ActorHandle(info.actor_id, info.class_name, methods, fn_key)
+
+
+def _actor_class_meta(w: Any, actor_id_hex: str):
+    """Fetch the actor's exported class key + method names via GCS."""
+    spec: TaskSpec = w.core_worker._gcs.call(
+        "kv_get", key=f"__actor_spec_meta:{actor_id_hex}")
+    if spec is None:
+        raise ValueError(f"actor {actor_id_hex[:12]} metadata missing")
+    import pickle
+    fn_key, methods = pickle.loads(spec)
+    return fn_key, methods

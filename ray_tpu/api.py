@@ -1,0 +1,155 @@
+"""Public core API: init/remote/get/put/wait/kill/cancel and cluster info.
+
+reference parity: python/ray/_private/worker.py — ray.get (:2506), ray.put
+(:2621), ray.wait (:2684), ray.kill (:2850), ray.cancel (:2881), @ray.remote
+(:3157); cluster info helpers from python/ray/_private/state.py.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+
+
+def init(address: Optional[str] = None, **kwargs: Any):
+    """Start/connect the runtime (reference worker.py:1165)."""
+    return worker_mod.init(address, **kwargs)
+
+
+def shutdown() -> None:
+    worker_mod.shutdown()
+
+
+def is_initialized() -> bool:
+    return worker_mod.is_initialized()
+
+
+def remote(*args: Any, **options: Any):
+    """@remote decorator for functions and classes (reference worker.py:3157)."""
+    def make(target: Any):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not options and callable(args[0]):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+    return make
+
+
+def put(value: Any) -> ObjectRef:
+    return worker_mod.global_worker().core_worker.put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    cw = worker_mod.global_worker().core_worker
+    if isinstance(refs, ObjectRef):
+        return cw.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"ray_tpu.get takes an ObjectRef or a list, "
+                        f"got {type(refs)}")
+    return cw.get(list(refs), timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("ray_tpu.wait takes a list of ObjectRefs")
+    cw = worker_mod.global_worker().core_worker
+    return cw.wait(list(refs), num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    cw = worker_mod.global_worker().core_worker
+    cw.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = True) -> None:
+    cw = worker_mod.global_worker().core_worker
+    cw.cancel_task(ref)
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    worker_mod.global_worker().core_worker.free(list(refs))
+
+
+# ---- cluster introspection ------------------------------------------------
+
+def nodes() -> List[Dict[str, Any]]:
+    w = worker_mod.global_worker()
+    infos = w.core_worker._gcs.call("get_all_nodes")
+    return [{
+        "NodeID": n.node_id.hex(), "Alive": n.alive,
+        "NodeManagerAddress": n.address[0], "NodeManagerPort": n.address[1],
+        "Resources": dict(n.resources_total), "Labels": dict(n.labels),
+        "IsHead": n.is_head,
+    } for n in infos]
+
+
+def cluster_resources() -> Dict[str, float]:
+    w = worker_mod.global_worker()
+    view = w.core_worker._gcs.call("get_cluster_resources")
+    total: Dict[str, float] = {}
+    for entry in view.values():
+        for k, v in entry["total"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    w = worker_mod.global_worker()
+    view = w.core_worker._gcs.call("get_cluster_resources")
+    avail: Dict[str, float] = {}
+    for entry in view.values():
+        for k, v in entry["available"].items():
+            avail[k] = avail.get(k, 0.0) + v
+    return avail
+
+
+def get_gcs_address() -> str:
+    w = worker_mod.global_worker()
+    host, port = w.gcs_address
+    return f"{host}:{port}"
+
+
+class _RuntimeContext:
+    """reference parity: ray.runtime_context.RuntimeContext."""
+
+    @property
+    def worker(self):
+        return worker_mod.global_worker()
+
+    def get_job_id(self) -> str:
+        return self.worker.core_worker.job_id.hex()
+
+    def get_node_id(self) -> str:
+        return self.worker.core_worker.node_id_hex
+
+    def get_worker_id(self) -> str:
+        return self.worker.core_worker.worker_id.hex()
+
+    def get_task_id(self) -> str:
+        return self.worker.core_worker.current_task_id().hex()
+
+    def get_actor_id(self) -> Optional[str]:
+        cw = self.worker.core_worker
+        if cw.executor is not None and cw.executor.actor_id is not None:
+            return cw.executor.actor_id.hex()
+        return None
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+
+def get_runtime_context() -> _RuntimeContext:
+    return _RuntimeContext()

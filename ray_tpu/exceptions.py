@@ -1,0 +1,92 @@
+"""User-facing exceptions (reference parity: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayError(Exception):
+    """Base for all framework errors."""
+
+
+class RayTaskError(RayError):
+    """A task raised; carries the remote traceback. Re-raised on ray.get."""
+
+    def __init__(self, function_name: str = "", traceback_str: str = "",
+                 cause: BaseException | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"task {function_name} failed:\n{traceback_str or cause}")
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Best effort: raise something isinstance-compatible with the
+        original exception (reference RayTaskError.as_instanceof_cause)."""
+        if self.cause is None:
+            return self
+        cause_cls = type(self.cause)
+        if issubclass(cause_cls, RayError):
+            return self.cause
+        try:
+            class _Wrapped(RayTaskError, cause_cls):  # type: ignore[misc]
+                def __init__(self, inner: RayTaskError):
+                    self.__dict__.update(inner.__dict__)
+
+                def __str__(self) -> str:
+                    return RayTaskError.__str__(self)
+            _Wrapped.__name__ = f"RayTaskError({cause_cls.__name__})"
+            _Wrapped.__qualname__ = _Wrapped.__name__
+            return _Wrapped(self)
+        except TypeError:
+            return self
+
+
+class WorkerCrashedError(RayError):
+    """The worker executing the task died (reference WorkerCrashedError)."""
+
+
+class RayActorError(RayError):
+    """The actor is dead; calls can't be delivered."""
+
+    def __init__(self, actor_id: str = "", cause: str = ""):
+        self.actor_id = actor_id
+        super().__init__(f"actor {actor_id[:12]} died: {cause}")
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    """Actor temporarily unreachable (restarting)."""
+
+
+class TaskCancelledError(RayError):
+    pass
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayError):
+    """Object can't be found / reconstructed."""
+
+
+class ObjectFreedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class RaySystemError(RayError):
+    pass
+
+
+class RuntimeEnvSetupError(RayError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayError):
+    pass

@@ -1,0 +1,113 @@
+"""RemoteFunction: @ray_tpu.remote on a function.
+
+reference parity: python/ray/remote_function.py (RemoteFunction._remote at
+:261, submit at :420) and the option surface of
+python/ray/_private/ray_option_utils.py:120-238.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.state import (DefaultSchedulingStrategy, TaskSpec,
+                                    TaskType)
+
+_TASK_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "scheduling_strategy", "runtime_env",
+    "name", "memory", "accelerator_type", "max_calls", "_metadata",
+    "placement_group", "placement_group_bundle_index",
+    "placement_group_capture_child_tasks", "object_store_memory",
+}
+
+
+def build_resources(options: Dict[str, Any],
+                    default_num_cpus: float = 1.0) -> Dict[str, float]:
+    resources = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    resources["CPU"] = float(default_num_cpus if num_cpus is None else num_cpus)
+    if options.get("num_gpus"):
+        resources["GPU"] = float(options["num_gpus"])
+    if options.get("num_tpus"):
+        resources["TPU"] = float(options["num_tpus"])
+    if options.get("memory"):
+        resources["memory"] = float(options["memory"])
+    return {k: v for k, v in resources.items() if v}
+
+
+def pack_args(args: tuple, kwargs: dict) -> "tuple[bytes, List[ObjectID]]":
+    refs = [a.id for a in args if isinstance(a, ObjectRef)]
+    refs += [v.id for v in kwargs.values() if isinstance(v, ObjectRef)]
+    return ser.pack((args, kwargs)), refs
+
+
+class RemoteFunction:
+    def __init__(self, fn: Any, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(options or {})
+        bad = set(self._options) - _TASK_OPTIONS
+        if bad:
+            raise ValueError(f"invalid task options: {sorted(bad)}")
+        self._fn_key: Optional[str] = None
+        functools.update_wrapper(self, fn)
+
+    def options(self, **kwargs: Any) -> "RemoteFunction":
+        merged = {**self._options, **kwargs}
+        rf = RemoteFunction(self._fn, merged)
+        rf._fn_key = self._fn_key
+        return rf
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        raise TypeError(
+            f"remote function '{self._fn.__name__}' cannot be called "
+            f"directly; use .remote()")
+
+    def remote(self, *args: Any, **kwargs: Any) -> Any:
+        w = worker_mod.global_worker()
+        cw = w.core_worker
+        if self._fn_key is None:
+            self._fn_key = cw.export_function(self._fn)
+        opts = self._options
+        args_blob, arg_refs = pack_args(args, kwargs)
+        strategy = opts.get("scheduling_strategy") or \
+            DefaultSchedulingStrategy()
+        pg_id, bundle_idx = _extract_pg(opts, strategy)
+        num_returns = opts.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=TaskID.of(cw.job_id), job_id=cw.job_id,
+            task_type=TaskType.NORMAL_TASK, function_key=self._fn_key,
+            function_name=self._fn.__name__, args=args_blob,
+            arg_object_refs=arg_refs, num_returns=num_returns,
+            resources=build_resources(opts),
+            owner_address=cw.address, owner_worker_id=cw.worker_id,
+            max_retries=opts.get("max_retries",
+                                 Config.default_task_max_retries),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            scheduling_strategy=strategy,
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_idx,
+            runtime_env=opts.get("runtime_env"),
+            name=opts.get("name") or self._fn.__name__)
+        refs = cw.submit_task(spec)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+
+def _extract_pg(opts: Dict[str, Any], strategy: Any):
+    from ray_tpu._private.state import PlacementGroupSchedulingStrategy
+    pg = opts.get("placement_group")
+    bundle_idx = opts.get("placement_group_bundle_index", -1)
+    if isinstance(strategy, PlacementGroupSchedulingStrategy) \
+            and strategy.placement_group is not None:
+        pg = strategy.placement_group
+        bundle_idx = strategy.placement_group_bundle_index
+    if pg is None:
+        return None, -1
+    return pg.id, bundle_idx

@@ -1,0 +1,36 @@
+"""Test config: chip-free TPU fake ladder (jax on CPU, 8 virtual devices).
+
+reference parity for the testing idea: SURVEY.md §4 — every process boundary
+has an in-process fake; jax runs on an 8-device virtual CPU mesh so all
+sharding/collective code paths compile and execute without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Pretend there are no TPU chips so the runtime under test doesn't claim the
+# real device tunnel during unit tests.
+os.environ.setdefault("RAY_TPU_FAKE_NUM_CHIPS", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ray_session():
+    """One shared local cluster for the whole test session (worker spawn is
+    expensive on small CI machines)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def ray_start(ray_session):
+    """Per-test alias; the session cluster is reused."""
+    return ray_session

@@ -1,0 +1,287 @@
+"""Core task/actor/object API tests.
+
+reference parity: python/ray/tests/test_basic.py, test_actor.py semantics.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_simple_task(ray_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_many_tasks(ray_start):
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    refs = [square.remote(i) for i in range(25)]
+    assert ray_tpu.get(refs) == [i * i for i in range(25)]
+
+
+def test_task_kwargs_and_multiple_returns(ray_start):
+    @ray_tpu.remote(num_returns=2)
+    def divmod_(a, b=3):
+        return a // b, a % b
+
+    q, r = divmod_.remote(10, b=4)
+    assert ray_tpu.get(q) == 2
+    assert ray_tpu.get(r) == 2
+
+
+def test_direct_call_raises(ray_start):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_put_get_roundtrip(ray_start):
+    for value in [1, "x", {"a": [1, 2]}, None, np.arange(10)]:
+        out = ray_tpu.get(ray_tpu.put(value))
+        if isinstance(value, np.ndarray):
+            np.testing.assert_array_equal(out, value)
+        else:
+            assert out == value
+
+
+def test_large_object_store_path(ray_start):
+    x = np.random.RandomState(0).randn(1 << 18)  # 2 MiB > inline threshold
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_object_ref_as_arg(ray_start):
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    big = ray_tpu.put(np.ones(300_000))
+    assert ray_tpu.get(total.remote(big)) == 300_000.0
+
+
+def test_chained_dependencies(ray_start):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 5
+
+
+def test_error_propagation(ray_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom-xyz")
+
+    with pytest.raises(ValueError, match="boom-xyz"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_is_ray_task_error_too(ray_start):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("k")
+
+    with pytest.raises(exc.RayTaskError):
+        ray_tpu.get(boom.remote())
+
+
+def test_wait(ray_start):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(1.2)
+    ready, rest = ray_tpu.wait([fast, slow], num_returns=1, timeout=10)
+    assert ready == [fast]
+    assert rest == [slow]
+    ready, rest = ray_tpu.wait([slow], num_returns=1, timeout=0.01)
+    assert ready == [] or ready == [slow]
+
+
+def test_get_timeout(ray_start):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=0.2)
+
+
+def test_nested_tasks(ray_start):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_options_override(ray_start):
+    @ray_tpu.remote
+    def whoami():
+        return 1
+
+    assert ray_tpu.get(whoami.options(num_cpus=2).remote()) == 1
+
+
+def test_basic_actor(ray_start):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    refs = [c.incr.remote() for _ in range(5)]
+    assert ray_tpu.get(refs) == [11, 12, 13, 14, 15]  # ordered execution
+    assert ray_tpu.get(c.value.remote()) == 15
+    ray_tpu.kill(c)
+
+
+def test_actor_state_isolation(ray_start):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.v = []
+
+        def add(self, x):
+            self.v.append(x)
+            return len(self.v)
+
+    a = Holder.remote()
+    b = Holder.remote()
+    assert ray_tpu.get(a.add.remote(1)) == 1
+    assert ray_tpu.get(b.add.remote(1)) == 1
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+
+
+def test_actor_error(ray_start):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor-err")
+
+        def ok(self):
+            return 1
+
+    a = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor-err"):
+        ray_tpu.get(a.boom.remote())
+    # actor survives method errors
+    assert ray_tpu.get(a.ok.remote()) == 1
+    ray_tpu.kill(a)
+
+
+def test_named_actor(ray_start):
+    @ray_tpu.remote
+    class Reg:
+        def ping(self):
+            return "pong"
+
+    Reg.options(name="reg1").remote()
+    h = ray_tpu.get_actor("reg1")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    ray_tpu.kill(h)
+
+
+def test_get_if_exists(ray_start):
+    @ray_tpu.remote
+    class Singleton:
+        def __init__(self):
+            self.token = time.time()
+
+        def get_token(self):
+            return self.token
+
+    a = Singleton.options(name="sing", get_if_exists=True).remote()
+    b = Singleton.options(name="sing", get_if_exists=True).remote()
+    assert ray_tpu.get(a.get_token.remote()) == ray_tpu.get(b.get_token.remote())
+    ray_tpu.kill(a)
+
+
+def test_kill_actor(ray_start):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return 1
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == 1
+    ray_tpu.kill(v)
+    time.sleep(0.3)
+    with pytest.raises(exc.RayActorError):
+        ray_tpu.get(v.ping.remote(), timeout=30)
+
+
+def test_actor_handle_passing(ray_start):
+    @ray_tpu.remote
+    class Counter2:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.incr.remote())
+
+    c = Counter2.remote()
+    assert ray_tpu.get(use.remote(c)) == 1
+    assert ray_tpu.get(c.incr.remote()) == 2
+    ray_tpu.kill(c)
+
+
+def test_cluster_resources(ray_start):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 4
+    avail = ray_tpu.available_resources()
+    assert set(avail) <= set(total) | set(avail)
+
+
+def test_runtime_context(ray_start):
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.get_job_id()) == 8
+    assert len(ctx.get_node_id()) == 32
+
+
+def test_runtime_env_env_vars(ray_start):
+    @ray_tpu.remote
+    def read_env():
+        import os
+        return os.environ.get("MY_TEST_VAR")
+
+    out = ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"MY_TEST_VAR": "hello"}}).remote())
+    assert out == "hello"
