@@ -1,0 +1,77 @@
+"""Transformer configurations.
+
+Scales match the BASELINE.json north-star configs: GPT-2 125M for the
+data-parallel benchmark, Llama-2 7B for the FSDP benchmark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None      # None -> = n_heads (MHA)
+    d_ff: Optional[int] = None            # None -> 4*d_model (8/3 for swiglu
+                                          # users should set explicitly)
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    # "dense" | "ring" | "ulysses" — how attention is computed when the
+    # sequence axis is sharded. dense = all-gather-free local compute with
+    # GSPMD-managed layout; ring/ulysses = explicit shard_map SP.
+    attention_impl: str = "dense"
+    # dtypes: params kept in param_dtype, compute runs in dtype (bf16 on
+    # TPU keeps the MXU fed; accumulation is f32 via preferred_element_type)
+    dtype: Any = "bfloat16"
+    param_dtype: Any = "float32"
+    remat: bool = False                   # jax.checkpoint each layer
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def num_params(self) -> int:
+        d, l, f, v = self.d_model, self.n_layers, self.ff_dim, self.vocab_size
+        hd, nh, nkv = self.head_dim, self.n_heads, self.kv_heads
+        attn = d * nh * hd + 2 * d * nkv * hd + nh * hd * d
+        mlp = 3 * d * f
+        norms = 2 * d
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + l * (attn + mlp + norms) + d + head
+
+
+TINY = TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128)
+
+# GPT-2 small scale (125M): 12L/768d/12H, 50k vocab, learned-pos in the
+# original — here RoPE (TPU-first redesign, not a port).
+GPT2_125M = TransformerConfig(
+    vocab_size=50304,  # 50257 padded to a multiple of 128 for the MXU
+    d_model=768, n_layers=12, n_heads=12, d_ff=3072, max_seq_len=1024,
+    tie_embeddings=True)
+
+LLAMA2_7B = TransformerConfig(
+    vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+    n_kv_heads=32, d_ff=11008, max_seq_len=4096, norm_eps=1e-5,
+    remat=True)

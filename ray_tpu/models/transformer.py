@@ -1,0 +1,244 @@
+"""Decoder-only transformer, TPU-first.
+
+Pure pytree params + jax functions (no framework objects cross the jit
+boundary). One definition covers every parallelism mode: params carry
+logical axis names (ray_tpu.parallel.sharding) so the same apply() runs
+replicated, FSDP ("embed"->fsdp), tensor-parallel ("heads"/"mlp"->tensor),
+and sequence-parallel (ring/Ulysses attention over the "seq" axis) — XLA
+inserts the collectives. Layers are stacked and iterated with `lax.scan`
+(one compiled layer body regardless of depth — fast compiles, and the
+stacked leading dim is the natural pipeline-parallel axis).
+
+Reference parity note: the reference has no in-tree LM (SURVEY.md §2.3,
+§5.7); its model math arrives via user torch code over NCCL groups. This
+module is the TPU-native replacement for that entire delegated stack.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu.models.configs import TransformerConfig
+from ray_tpu.parallel.mesh import AXIS_SEQ
+from ray_tpu.parallel.sharding import ShardingRules, with_logical_constraint
+
+
+def _rope(x, positions, theta):
+    """Rotary position embedding on [..., T, H, D] with explicit positions
+    (global positions keep RoPE exact when the sequence axis is sharded)."""
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads: [...,T,1,half]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _rmsnorm(x, w, eps):
+    import jax.numpy as jnp
+    x32 = x.astype(jnp.float32)
+    scale = jnp.reciprocal(
+        jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps))
+    return (x32 * scale).astype(x.dtype) * w.astype(x.dtype)
+
+
+class Transformer:
+    """Namespace for init / param_specs / apply / loss."""
+
+    # ---- parameter construction ------------------------------------
+    @staticmethod
+    def init(key, cfg: TransformerConfig) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        pdt = jnp.dtype(cfg.param_dtype)
+        d, hd = cfg.d_model, cfg.head_dim
+        nh, nkv, f, l = cfg.n_heads, cfg.kv_heads, cfg.ff_dim, cfg.n_layers
+        keys = jax.random.split(key, 8)
+
+        def norm_init(stddev, k, shape):
+            return (jax.random.normal(k, shape, jnp.float32)
+                    * stddev).astype(pdt)
+
+        params = {
+            "embed": norm_init(0.02, keys[0], (cfg.vocab_size, d)),
+            "layers": {
+                "attn_norm": jnp.ones((l, d), pdt),
+                "wq": norm_init(d ** -0.5, keys[1], (l, d, nh, hd)),
+                "wk": norm_init(d ** -0.5, keys[2], (l, d, nkv, hd)),
+                "wv": norm_init(d ** -0.5, keys[3], (l, d, nkv, hd)),
+                "wo": norm_init((nh * hd) ** -0.5, keys[4], (l, nh, hd, d)),
+                "mlp_norm": jnp.ones((l, d), pdt),
+                "w_gate": norm_init(d ** -0.5, keys[5], (l, d, f)),
+                "w_up": norm_init(d ** -0.5, keys[6], (l, d, f)),
+                "w_down": norm_init(f ** -0.5, keys[7], (l, f, d)),
+            },
+            "final_norm": jnp.ones((d,), pdt),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = norm_init(
+                d ** -0.5, jax.random.fold_in(key, 99), (d, cfg.vocab_size))
+        return params
+
+    @staticmethod
+    def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+        """Logical sharding spec tree, same structure as init()'s output."""
+        specs = {
+            "embed": ("vocab", "embed"),
+            "layers": {
+                "attn_norm": ("layers", "norm"),
+                "wq": ("layers", "embed", "heads", "head_dim"),
+                "wk": ("layers", "embed", "kv_heads", "head_dim"),
+                "wv": ("layers", "embed", "kv_heads", "head_dim"),
+                "wo": ("layers", "heads", "head_dim", "embed"),
+                "mlp_norm": ("layers", "norm"),
+                "w_gate": ("layers", "embed", "mlp"),
+                "w_up": ("layers", "embed", "mlp"),
+                "w_down": ("layers", "mlp", "embed"),
+            },
+            "final_norm": ("norm",),
+        }
+        if not cfg.tie_embeddings:
+            specs["lm_head"] = ("embed", "vocab")
+        return specs
+
+    # ---- forward ----------------------------------------------------
+    @staticmethod
+    def apply(params, tokens, cfg: TransformerConfig, *,
+              mesh=None, rules: Optional[ShardingRules] = None,
+              positions=None):
+        """tokens [B, T] int32 -> logits [B, T, vocab] (compute dtype).
+
+        When `mesh` is provided and cfg.attention_impl is ring/ulysses, the
+        attention op runs inside shard_map over the "seq" axis; everything
+        else is GSPMD via logical sharding constraints.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        rules = rules or ShardingRules()
+        cdt = jnp.dtype(cfg.dtype)
+        b, t = tokens.shape
+        if positions is None:
+            positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+
+        constrain = functools.partial(
+            with_logical_constraint, mesh=mesh, rules=rules)
+
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+        x = constrain(x, ("batch", "seq", "act_embed"))
+
+        attn_fn = Transformer._make_attention(cfg, mesh, rules)
+        scale = cfg.head_dim ** -0.5
+
+        def layer(x, lp):
+            h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+            q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cdt))
+            k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(cdt))
+            v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(cdt))
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            if cfg.kv_heads != cfg.n_heads:
+                rep = cfg.n_heads // cfg.kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            q = constrain(q, ("batch", "seq", "heads", "head_dim"))
+            k = constrain(k, ("batch", "seq", "heads", "head_dim"))
+            v = constrain(v, ("batch", "seq", "heads", "head_dim"))
+            o = attn_fn(q, k, v, scale)
+            o = constrain(o, ("batch", "seq", "heads", "head_dim"))
+            o = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(cdt))
+            x = x + constrain(o, ("batch", "seq", "act_embed"))
+
+            h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+            gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(cdt))
+            up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cdt))
+            ff = jax.nn.silu(gate) * up
+            ff = constrain(ff, ("batch", "seq", "act_mlp"))
+            down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(cdt))
+            x = x + constrain(down, ("batch", "seq", "act_embed"))
+            return x
+
+        if cfg.remat:
+            layer = jax.checkpoint(layer)
+
+        def scan_body(x, lp):
+            return layer(x, lp), None
+
+        x, _ = lax.scan(scan_body, x, params["layers"])
+
+        x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("btd,dv->btv", x, head.astype(cdt),
+                            preferred_element_type=jnp.float32)
+        return constrain(logits, ("batch", "seq", "act_vocab"))
+
+    @staticmethod
+    def _make_attention(cfg: TransformerConfig, mesh, rules: ShardingRules):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.ops.attention import dense_attention
+
+        impl = cfg.attention_impl
+        if impl == "dense" or mesh is None or mesh.shape.get(AXIS_SEQ, 1) == 1:
+            return lambda q, k, v, scale: dense_attention(
+                q, k, v, causal=True, scale=scale)
+
+        from ray_tpu.parallel.ring import ring_attention
+        from ray_tpu.parallel.ulysses import ulysses_attention
+
+        batch_axes = rules.mesh_axes("batch")
+        qkv_spec = P(batch_axes, AXIS_SEQ, None, None)
+
+        if impl == "ring":
+            body = lambda q, k, v, scale: ring_attention(  # noqa: E731
+                q, k, v, causal=True, scale=scale)
+        elif impl == "ulysses":
+            body = lambda q, k, v, scale: ulysses_attention(  # noqa: E731
+                q, k, v, causal=True, scale=scale)
+        else:
+            raise ValueError(f"unknown attention_impl {impl!r}")
+
+        def sharded(q, k, v, scale):
+            fn = jax.shard_map(
+                functools.partial(body, scale=scale), mesh=mesh,
+                in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                out_specs=qkv_spec)
+            return fn(q, k, v)
+
+        return sharded
+
+    # ---- loss -------------------------------------------------------
+    @staticmethod
+    def loss(params, batch, cfg: TransformerConfig, *,
+             mesh=None, rules: Optional[ShardingRules] = None):
+        """Next-token cross-entropy. batch = {"tokens": [B,T+1] or
+        ("tokens","targets") pair}; returns scalar mean loss (f32)."""
+        import jax.numpy as jnp
+
+        if "targets" in batch:
+            tokens, targets = batch["tokens"], batch["targets"]
+        else:
+            tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+        logits = Transformer.apply(params, tokens, cfg, mesh=mesh,
+                                   rules=rules)
+        import jax
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("mask")
+        nll = logz - gold
+        if mask is not None:
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.mean(nll)

@@ -1,0 +1,11 @@
+"""ray_tpu.ops: TPU compute primitives.
+
+Shared attention/normalization ops used by the model zoo and the
+sequence-parallel layer; pallas TPU kernels live here as they land
+(flash attention, fused rmsnorm), each with a pure-jax reference
+implementation that runs on the chip-free CPU test ladder.
+"""
+
+from ray_tpu.ops.attention import dense_attention  # noqa: F401
+
+__all__ = ["dense_attention"]

@@ -1,0 +1,30 @@
+"""Attention ops.
+
+Single source of truth for the dense (fully local) attention used by the
+transformer, by ulysses_attention's inner computation, and by tests.
+Accumulates scores and the probs@V contraction in f32 regardless of the
+compute dtype (bf16 on TPU) via preferred_element_type.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def dense_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None):
+    """Multi-head attention on [batch, seq, heads, head_dim] arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

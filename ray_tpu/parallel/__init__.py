@@ -1,0 +1,51 @@
+"""ray_tpu.parallel: first-class model parallelism over TPU meshes.
+
+The reference (see SURVEY.md §2.4) is an orchestration framework whose
+model-math parallelism lives in third-party libs (torch DDP/FSDP, DeepSpeed,
+Horovod) wired up over NCCL process groups
+(reference: python/ray/train/torch/config.py:148-200,
+python/ray/util/collective/collective.py:120-651). On TPU the parallelism
+itself is a first-class, in-framework capability: a named ICI mesh with
+axes for data/fsdp/tensor/sequence/expert/pipeline parallelism, sharding
+rules that map logical array axes onto mesh axes, and XLA collectives
+(psum/all_gather/reduce_scatter/ppermute/all_to_all) emitted by
+pjit/shard_map — no NCCL, no process-group objects.
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MeshConfig,
+    get_abstract_mesh,
+    make_mesh,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    logical_sharding,
+    shard_pytree,
+    with_logical_constraint,
+)
+from ray_tpu.parallel.ring import ring_attention  # noqa: F401
+from ray_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "get_abstract_mesh",
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_TENSOR",
+    "AXIS_SEQ",
+    "AXIS_EXPERT",
+    "AXIS_PIPE",
+    "ShardingRules",
+    "logical_sharding",
+    "shard_pytree",
+    "with_logical_constraint",
+    "ring_attention",
+    "ulysses_attention",
+]

@@ -1,0 +1,116 @@
+"""Ring attention: exact attention over sequences sharded across the mesh.
+
+The reference has no sequence/context parallelism at all (SURVEY.md §5.7:
+"Not present in the reference" — no ring attention, blockwise, Ulysses or
+sequence sharding anywhere in the tree). This module provides it as a
+first-class mesh axis: Q/K/V arrive sharded over the "seq" axis; each
+device computes blockwise attention of its local queries against the K/V
+block it currently holds, then rotates K/V one hop around the ICI ring
+with `lax.ppermute`, accumulating with an online (streaming) softmax.
+After `seq`-many hops every query has seen every key exactly once —
+attention is exact, memory per chip is O(T/seq * T/seq), and the K/V
+rotation overlaps with compute on TPU since ppermute rides ICI DMA.
+
+Designed for use inside `shard_map` over the standard mesh
+(ray_tpu.parallel.mesh); `ring_attention` below is the per-shard body.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+from ray_tpu.parallel.mesh import AXIS_SEQ
+
+
+def _block_attention(q, k, v, bias, causal, q_offset, k_offset, scale):
+    """One blockwise attention contribution with running-max bookkeeping.
+
+    Returns (unnormalized_out, row_max, row_sumexp) for online-softmax
+    merging across blocks. Shapes: q [B, Tq, H, D]; k, v [B, Tk, H, D].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # [B, H, Tq, Tk] scores on the MXU; accumulate in f32.
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(tq)
+        k_pos = k_offset + jnp.arange(tk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1)                       # [B, H, Tq]
+    # Guard fully-masked rows (all -inf): exp(-inf - -inf) would be NaN.
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])            # [B, H, Tq, Tk]
+    l = jnp.sum(p, axis=-1)                       # [B, H, Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m_safe, l, jnp.isneginf(m)
+
+
+def ring_attention(q, k, v, *,
+                   axis_name: str = AXIS_SEQ,
+                   causal: bool = True,
+                   scale: Optional[float] = None):
+    """Exact multi-head attention with K/V rotating around `axis_name`.
+
+    Per-shard function: call inside `shard_map` (or `pmap`) where the
+    sequence dimension of q/k/v is already the local shard. Layout is
+    [batch, seq_local, heads, head_dim]. Supports causal masking with
+    correct global positions (each shard knows its ring index via
+    `lax.axis_index`). GQA is handled by the caller repeating K/V heads
+    (cheap: K/V are small) or by ulysses_attention.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    ring_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def step(carry, _):
+        kk, vv, src_idx, o_acc, m_acc, l_acc = carry
+        k_offset = src_idx * t_local
+        q_offset = my_idx * t_local
+        o_blk, m_blk, l_blk, dead = _block_attention(
+            q, kk, vv, None, causal, q_offset, k_offset, scale)
+        # online softmax merge: rescale both accumulators to the new max
+        m_new = jnp.maximum(m_acc, jnp.where(dead, m_acc, m_blk))
+        # alpha rescales old accumulator; beta rescales this block.
+        # Guard -inf - -inf = nan on rows that have seen no live block yet.
+        alpha = jnp.where(jnp.isneginf(m_acc), 0.0, jnp.exp(m_acc - m_new))
+        beta = jnp.where(dead, 0.0, jnp.exp(m_blk - m_new))
+        l_new = l_acc * alpha + l_blk * beta
+        o_new = (o_acc * alpha[..., None].transpose(0, 2, 1, 3)
+                 + o_blk * beta[..., None].transpose(0, 2, 1, 3))
+        # rotate K/V and the block-origin index one hop around the ring
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        src_idx = (src_idx - 1) % ring_size
+        return (kk, vv, src_idx, o_new, m_new, l_new), None
+
+    # Zero-init accumulators are derived arithmetically from q so they
+    # inherit q's varying-manual-axes (shard_map VMA checking requires the
+    # scan carry to vary over every axis the per-step values vary over —
+    # including batch axes when called under batch-sharded specs).
+    qf = q.astype(jnp.float32)
+    o0 = qf * 0.0                                       # [B, T, H, D]
+    m0 = jnp.swapaxes(qf[..., 0], 1, 2) * 0.0 - jnp.inf  # [B, H, T]
+    l0 = jnp.swapaxes(qf[..., 0], 1, 2) * 0.0            # [B, H, T]
+    (_, _, _, o, m, l), _ = lax.scan(
+        step, (k, v, my_idx, o0, m0, l0), None, length=ring_size)
+    # normalize; fully-masked rows (shouldn't happen with causal self-attn
+    # over the full ring) produce 0 rather than NaN
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l[..., None].transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

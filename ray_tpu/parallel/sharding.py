@@ -1,0 +1,140 @@
+"""Logical-axis sharding rules.
+
+The reference has no in-tree tensor/sequence/expert parallelism (SURVEY.md
+§2.4: TP/PP/SP/EP are "Absent"); sharded data parallelism is delegated to
+DeepSpeed/FSDP via user code over the NCCL group Ray establishes
+(reference: train/examples/deepspeed/deepspeed_torch_trainer.py). Here
+sharding is declarative: arrays carry *logical* axis names
+("batch", "embed", "heads", …) and a `ShardingRules` table maps each
+logical name to a mesh axis (or None = replicated). XLA then inserts the
+collectives — this is the GSPMD programming model, the TPU-native
+equivalent of all of ZeRO-1/2/3 + Megatron TP in one mechanism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple, Union
+
+from ray_tpu.parallel.mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_FSDP,
+                                   AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR)
+
+# A logical spec is a tuple of logical axis names (or None) per array dim.
+LogicalSpec = Tuple[Optional[str], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """logical axis name -> mesh axis (str | tuple of str | None).
+
+    The default table implements, in one place:
+      - DP:    "batch"  -> ("data", "fsdp")  (batch split over both)
+      - FSDP:  "embed"  -> "fsdp"            (params reduce-scattered, ZeRO-3)
+      - TP:    "heads"/"mlp"/"vocab" -> "tensor" (Megatron-style column/row)
+      - SP:    "seq"    -> "seq"             (context parallelism / ring)
+      - EP:    "expert" -> "expert"
+      - PP:    "layers" -> "pipe"            (stage-stacked scan)
+    """
+
+    rules: Dict[str, Union[str, Tuple[str, ...], None]] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_RULES))
+
+    def mesh_axes(self, logical: Optional[str]):
+        if logical is None:
+            return None
+        if logical not in self.rules:
+            raise KeyError(f"unknown logical axis {logical!r}")
+        return self.rules[logical]
+
+    def spec(self, logical_spec: LogicalSpec):
+        """Build a jax PartitionSpec from a tuple of logical names."""
+        import jax
+        return jax.sharding.PartitionSpec(
+            *[self.mesh_axes(name) for name in logical_spec])
+
+    def replace(self, **updates) -> "ShardingRules":
+        new = dict(self.rules)
+        new.update(updates)
+        return ShardingRules(rules=new)
+
+
+DEFAULT_RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
+    "batch": (AXIS_DATA, AXIS_FSDP),
+    "seq": AXIS_SEQ,
+    "embed": AXIS_FSDP,
+    "heads": AXIS_TENSOR,
+    "kv_heads": AXIS_TENSOR,
+    "head_dim": None,
+    "mlp": AXIS_TENSOR,
+    "vocab": AXIS_TENSOR,
+    "layers": AXIS_PIPE,
+    "expert": AXIS_EXPERT,
+    "norm": None,
+    # Activation axes (distinct from param axes: activations keep their
+    # feature dims replicated/tensor-sharded even when params are
+    # fsdp-sharded — that's what makes it FSDP rather than naive TP).
+    "act_embed": None,
+    "act_mlp": AXIS_TENSOR,
+    "act_vocab": AXIS_TENSOR,
+}
+
+
+def logical_sharding(logical_spec: LogicalSpec, mesh,
+                     rules: Optional[ShardingRules] = None):
+    """NamedSharding for one array given its logical spec."""
+    import jax
+    rules = rules or ShardingRules()
+    # Drop mesh axes of size 1 from specs: XLA treats them as replicated
+    # anyway, and it keeps specs valid on degenerate meshes (e.g. 1 chip).
+    spec = rules.spec(logical_spec)
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if mesh.shape.get(entry, 1) > 1 else None)
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(*cleaned))
+
+
+def shard_pytree(tree: Any, spec_tree: Any, mesh,
+                 rules: Optional[ShardingRules] = None):
+    """Map a pytree of logical specs to a pytree of NamedShardings.
+
+    `spec_tree` must be a pytree-prefix-compatible tree whose leaves are
+    LogicalSpec tuples (tuple of str|None per dim).
+    """
+    import jax
+    rules = rules or ShardingRules()
+
+    def is_spec(x):
+        return isinstance(x, tuple) and all(
+            isinstance(e, str) or e is None for e in x)
+
+    return jax.tree.map(
+        lambda s: logical_sharding(s, mesh, rules), spec_tree,
+        is_leaf=is_spec)
+
+
+def with_logical_constraint(x: Any, logical_spec: LogicalSpec,
+                            mesh=None,
+                            rules: Optional[ShardingRules] = None):
+    """`lax.with_sharding_constraint` by logical names; no-op outside jit
+    or when no mesh is available (keeps model code runnable un-sharded)."""
+    import jax
+    rules = rules or ShardingRules()
+    spec = rules.spec(logical_spec)  # KeyError on typo'd names: propagate
+    if mesh is None:
+        try:
+            env_mesh = jax.sharding.get_abstract_mesh()
+        except AttributeError:
+            return x
+        if env_mesh is None or not env_mesh.shape:
+            return x
+        sharding = jax.sharding.NamedSharding(env_mesh, spec)
+    else:
+        sharding = logical_sharding(logical_spec, mesh, rules)
+    return jax.lax.with_sharding_constraint(x, sharding)

@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+Absent from the reference (SURVEY.md §5.7). DeepSpeed-Ulysses reshards
+activations around attention: outside attention, arrays are sharded over
+the sequence axis; for attention itself an all-to-all converts to
+head-sharding so each device computes full-sequence attention for a subset
+of heads, then a second all-to-all converts back. On TPU both all-to-alls
+are single XLA `lax.all_to_all` ops over the ICI "seq" axis.
+
+Tradeoff vs ring attention: Ulysses needs heads % seq_parallel == 0 and
+moves activations twice, but each device then runs a dense, fully-local
+attention (best MXU utilization, any attention kernel works inside);
+ring attention keeps activations put and streams K/V instead (better for
+very long sequences / flash-style kernels). Both are exposed; the trainer
+picks per layer via config.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.parallel.mesh import AXIS_SEQ
+
+
+def _default_inner(q, k, v, causal, scale):
+    # q,k,v: [B, T, h_local, D] with the FULL sequence locally.
+    from ray_tpu.ops.attention import dense_attention
+    return dense_attention(q, k, v, causal=causal, scale=scale)
+
+
+def ulysses_attention(q, k, v, *,
+                      axis_name: str = AXIS_SEQ,
+                      causal: bool = True,
+                      scale: Optional[float] = None,
+                      inner: Optional[Callable] = None):
+    """Per-shard attention with all-to-all head<->seq resharding.
+
+    Call inside shard_map; q/k/v are [batch, seq_local, heads, head_dim].
+    Requires heads divisible by the size of `axis_name`. `inner` lets the
+    caller swap in a fused/pallas attention for the local computation.
+    """
+    import jax
+    from jax import lax
+
+    sp = lax.axis_size(axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if q.shape[2] % sp != 0:
+        raise ValueError(
+            f"heads ({q.shape[2]}) must be divisible by seq-parallel "
+            f"size {sp}; "
+            "use ring_attention for head counts below the seq axis size")
+
+    # [B, T/sp, H, D] -> [B, T, H/sp, D]: split heads (axis 2), gather seq
+    # (axis 1).
+    def to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    inner = inner or _default_inner
+    oh = inner(qh, kh, vh, causal, scale)
+    return to_seq(oh).astype(q.dtype)

@@ -7,8 +7,10 @@ sharding/collective code paths compile and execute without TPU hardware.
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process. Force,
+# don't setdefault: the dev environment pre-sets JAX_PLATFORMS to the real
+# TPU tunnel, and unit tests must stay on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +20,12 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("RAY_TPU_FAKE_NUM_CHIPS", "0")
 
 import pytest  # noqa: E402
+
+# The env var alone is not reliable here (the dev image's axon TPU tunnel
+# re-asserts JAX_PLATFORMS); pin the platform through jax.config as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")
