@@ -1,0 +1,85 @@
+"""Headline benchmark: GPT-2-125M-scale train-step throughput (tokens/sec).
+
+Matches BASELINE.json north-star config #4 ("Ray Train JaxTrainer: GPT-2
+125M data-parallel"): a full forward/backward/adamw train step of the
+flagship decoder on the available TPU chip(s), bf16 compute / f32 params.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec", "vs_baseline": N}
+
+vs_baseline anchor: 100k tokens/sec/chip ~= GPU-parity for 125M-class
+models (A100-80G class at ~40% MFU); the reference publishes no headline
+number of its own (SURVEY.md §6, BASELINE.json "published": {}).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_TOKENS_PER_SEC = 100_000.0
+BATCH = 16     # per-device; remat keeps activations off HBM so batch can
+WARMUP = 3     # be large enough to feed the MXU
+STEPS = 10
+
+
+def main() -> None:
+    import jax
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+
+    import optax
+
+    from ray_tpu.models import GPT2_125M, Transformer
+    from ray_tpu.parallel import MeshConfig, make_mesh
+    from ray_tpu.parallel.train_step import make_train_step
+
+    cfg = GPT2_125M.replace(remat=True)
+    seq = cfg.max_seq_len
+    mesh = make_mesh(MeshConfig(data=-1), devices=devices)
+
+    params = Transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (BATCH * len(devices), seq + 1),
+        0, cfg.vocab_size)
+
+    init_state, train_step = make_train_step(
+        lambda p, b: Transformer.loss(p, b, cfg, mesh=mesh),
+        Transformer.param_specs(cfg), mesh,
+        optimizer=optax.adamw(1e-4, weight_decay=0.01))
+    state = init_state(params)
+    batch = {"tokens": tokens}
+
+    for _ in range(WARMUP):
+        state, metrics = train_step(state, batch)
+    # device_get (not block_until_ready): over the remote-device tunnel the
+    # latter can resolve before the computation drains; a host transfer of
+    # the last loss — data-dependent on every step via donation chaining —
+    # is an unambiguous fence.
+    jax.device_get(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = train_step(state, batch)
+    final_loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = BATCH * len(devices) * seq
+    value = tokens_per_step * STEPS / dt
+    per_chip = value / len(devices)
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec"
+                  + ("" if on_tpu else "_cpu_fallback"),
+        "value": round(value, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(per_chip / BASELINE_TOKENS_PER_SEC, 4),
+        "n_devices": len(devices),
+        "platform": devices[0].platform,
+        "loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,6 +190,8 @@ class Transformer:
         from ray_tpu.ops.attention import dense_attention
 
         impl = cfg.attention_impl
+        if impl not in ("dense", "ring", "ulysses"):
+            raise ValueError(f"unknown attention_impl {impl!r}")
         if impl == "dense" or mesh is None or mesh.shape.get(AXIS_SEQ, 1) == 1:
             return lambda q, k, v, scale: dense_attention(
                 q, k, v, causal=True, scale=scale)
@@ -197,17 +199,18 @@ class Transformer:
         from ray_tpu.parallel.ring import ring_attention
         from ray_tpu.parallel.ulysses import ulysses_attention
 
+        # Heads stay sharded over the tensor axis inside the shard_map —
+        # SP composes with TP instead of all-gathering Q/K/V heads.
         batch_axes = rules.mesh_axes("batch")
-        qkv_spec = P(batch_axes, AXIS_SEQ, None, None)
+        heads_axes = rules.mesh_axes("heads")
+        qkv_spec = P(batch_axes, AXIS_SEQ, heads_axes, None)
 
         if impl == "ring":
             body = lambda q, k, v, scale: ring_attention(  # noqa: E731
                 q, k, v, causal=True, scale=scale)
-        elif impl == "ulysses":
+        else:
             body = lambda q, k, v, scale: ulysses_attention(  # noqa: E731
                 q, k, v, causal=True, scale=scale)
-        else:
-            raise ValueError(f"unknown attention_impl {impl!r}")
 
         def sharded(q, k, v, scale):
             fn = jax.shard_map(

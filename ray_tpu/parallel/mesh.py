@@ -108,7 +108,12 @@ def make_mesh(config: Optional[MeshConfig] = None,
             mesh_devices = mesh_utils.create_device_mesh(
                 shape, devices=list(devices),
                 allow_split_physical_axes=allow_split_physical_axes)
-        except Exception:
+        except Exception as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "ICI-aware device mesh construction failed (%s); falling "
+                "back to flat device order — inner-axis collectives may "
+                "cross slow links", e)
             mesh_devices = np.asarray(devices).reshape(shape)
     else:
         mesh_devices = np.asarray(devices).reshape(shape)
@@ -125,7 +130,3 @@ def get_abstract_mesh(config: MeshConfig, n_devices: int):
     return jax.sharding.AbstractMesh(shape, MESH_AXIS_ORDER)
 
 
-def batch_shard_axes(mesh) -> Tuple[str, ...]:
-    """Mesh axes the global batch dimension is sharded over."""
-    return tuple(a for a in (AXIS_DATA, AXIS_FSDP)
-                 if mesh.shape.get(a, 1) > 1) or (AXIS_DATA,)

@@ -17,7 +17,6 @@ Designed for use inside `shard_map` over the standard mesh
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 from ray_tpu.parallel.mesh import AXIS_SEQ

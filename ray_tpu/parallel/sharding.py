@@ -100,12 +100,12 @@ def logical_sharding(logical_spec: LogicalSpec, mesh,
         mesh, jax.sharding.PartitionSpec(*cleaned))
 
 
-def shard_pytree(tree: Any, spec_tree: Any, mesh,
+def shard_pytree(spec_tree: Any, mesh,
                  rules: Optional[ShardingRules] = None):
     """Map a pytree of logical specs to a pytree of NamedShardings.
 
-    `spec_tree` must be a pytree-prefix-compatible tree whose leaves are
-    LogicalSpec tuples (tuple of str|None per dim).
+    `spec_tree` leaves are LogicalSpec tuples (tuple of str|None per dim);
+    the result has the same structure with NamedSharding leaves.
     """
     import jax
     rules = rules or ShardingRules()

@@ -12,7 +12,6 @@ reduce-scatter, automatically.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu.parallel.sharding import ShardingRules, shard_pytree
@@ -47,7 +46,7 @@ def make_train_step(
     if optimizer is None:
         optimizer = optax.adamw(3e-4, weight_decay=0.01)
 
-    p_shardings = shard_pytree(param_specs, param_specs, mesh, rules)
+    p_shardings = shard_pytree(param_specs, mesh, rules)
     replicated = jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec())
     batch_sharding = jax.sharding.NamedSharding(
@@ -131,12 +130,7 @@ def make_train_step(
         key = jax.tree.structure(state)
         fn = _cache.get(key)
         if fn is None:
-            state_shardings = {
-                "params": p_shardings,
-                "opt_state": _opt_shardings(
-                    jax.eval_shape(lambda x: x, state["params"])),
-                "step": replicated,
-            }
+            state_shardings = make_state_shardings(state["params"])
             fn = jax.jit(
                 _step,
                 in_shardings=(state_shardings, batch_sharding),

@@ -18,6 +18,9 @@ from ray_tpu.parallel.mesh import AXIS_SEQ
 
 
 def dense_attention(q, k, v, causal=True):
+    # Intentionally independent oracle: re-derives attention from scratch
+    # rather than importing ray_tpu.ops.attention, so a bug in the shared
+    # op cannot mask itself in the ring/ulysses parity tests.
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
