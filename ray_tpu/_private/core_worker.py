@@ -82,6 +82,8 @@ class CoreWorker:
         self._nm = rpc_lib.RpcClient(self.nm_address, timeout=120)
         self._pool = rpc_lib.ClientPool(timeout=120)
         self.store = StoreClient(store_address)
+        # placement group of the currently-executing task/actor, if any
+        self.current_placement_group_id = None
 
         self._lock = threading.RLock()
         # Owner-side object directory: oid hex -> (tag, ...) location
@@ -104,6 +106,7 @@ class CoreWorker:
 
         handlers = {
             "cw_lease_granted": self._on_lease_granted,
+            "cw_lease_respill": self._on_lease_respill,
             "cw_task_done": self._on_task_done,
             "cw_task_failed": self._on_task_failed,
             "cw_get_object": self._on_get_object,
@@ -399,10 +402,24 @@ class CoreWorker:
         self._request_lease(spec)
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
-    def _request_lease(self, spec: TaskSpec) -> None:
+    def _on_lease_respill(self, task_id: TaskID,
+                          nm_address: Tuple[str, int]) -> None:
+        """Our local raylet re-routed a queued lease to another node that
+        became feasible (e.g. a PG bundle committed there)."""
+        with self._lock:
+            entry = self.tasks.get(task_id.hex())
+        if entry is None or entry.done:
+            return
+        threading.Thread(
+            target=self._request_lease,
+            args=(entry.spec, self._pool.get(tuple(nm_address))),
+            daemon=True, name="lease-respill").start()
+
+    def _request_lease(self, spec: TaskSpec, nm=None) -> None:
         """Lease a worker; follow spillback redirects (reference
         direct_task_transport.cc:349,505)."""
-        nm = self._nm
+        if nm is None:
+            nm = self._nm
         for _ in range(16):
             with self._lock:
                 entry = self.tasks.get(spec.task_id.hex())
@@ -838,6 +855,11 @@ class _Executor:
             self._report_error(spec, exc.TaskCancelledError(spec.function_name))
             return
         cw.set_current_task(spec.task_id)
+        # expose the task's placement group for get_current_placement_group
+        # (reference: worker.placement_group_id via TaskSpec capture); an
+        # actor keeps its creation PG for all subsequent method calls
+        if spec.placement_group_id is not None:
+            cw.current_placement_group_id = spec.placement_group_id
         try:
             results: List[Tuple] = []
             try:
@@ -882,6 +904,8 @@ class _Executor:
             self._report_done(spec, results)
         finally:
             cw.set_current_task(None)
+            if spec.task_type == TaskType.NORMAL_TASK:
+                cw.current_placement_group_id = None
 
     @staticmethod
     def _split_returns(out: Any, num_returns: int) -> List[Any]:

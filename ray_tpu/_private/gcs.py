@@ -18,7 +18,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private.ids import ActorID, JobID, NodeID, WorkerID
-from ray_tpu._private.state import ActorInfo, NodeInfo, ResourceSet, TaskSpec
+from ray_tpu._private.state import (ActorInfo, NodeInfo, PlacementGroupInfo,
+                                    ResourceSet, TaskSpec)
 
 logger = logging.getLogger(__name__)
 
@@ -73,6 +74,8 @@ class GcsServer:
         # channel -> [(subscriber rpc address, token)]
         self.subscribers: Dict[str, List[Tuple[Tuple[str, int], str]]] = {}
         self.job_counter = 0
+        # pg_id hex -> PlacementGroupInfo
+        self.placement_groups: Dict[str, "PlacementGroupInfo"] = {}
         self._dead = False
 
         self.server = rpc_lib.RpcServer({
@@ -99,6 +102,11 @@ class GcsServer:
             "report_actor_death": self.report_actor_death,
             "kill_actor": self.kill_actor,
             "list_actors": self.list_actors,
+            # placement groups (reference PlacementGroupInfoGcsService)
+            "create_placement_group": self.create_placement_group,
+            "remove_placement_group": self.remove_placement_group,
+            "get_placement_group": self.get_placement_group,
+            "list_placement_groups": self.list_placement_groups,
             # pubsub (reference InternalPubSubGcsService)
             "subscribe": self.subscribe,
             "ping": lambda: "pong",
@@ -340,6 +348,145 @@ class GcsServer:
         self.report_actor_death(actor_id_hex, "ray.kill", restart=not no_restart)
 
     # ---- pubsub ----------------------------------------------------------
+
+    # ---- placement groups (reference GcsPlacementGroupManager,
+    #      gcs_placement_group_scheduler.h: 2-phase prepare/commit) -------
+
+    def create_placement_group(self, pg_id_hex: str, bundles, strategy: str,
+                               name: str = "", detached: bool = False,
+                               creator_job_id: str = "") -> str:
+        from ray_tpu._private.ids import PlacementGroupID
+        info = PlacementGroupInfo(
+            pg_id=PlacementGroupID.from_hex(pg_id_hex), name=name,
+            bundles=list(bundles), strategy=strategy,
+            creator_job_id=creator_job_id, detached=detached)
+        with self._lock:
+            self.placement_groups[pg_id_hex] = info
+        threading.Thread(target=self._schedule_placement_group,
+                         args=(pg_id_hex,), daemon=True,
+                         name=f"gcs-pg-{pg_id_hex[:8]}").start()
+        return pg_id_hex
+
+    def _schedule_placement_group(self, pg_id_hex: str,
+                                  deadline_s: float = 120.0) -> None:
+        from ray_tpu._private.scheduler import pack_bundles
+        info = self.placement_groups[pg_id_hex]
+        deadline = time.time() + deadline_s
+        while time.time() < deadline and not self._dead:
+            if info.state == "REMOVED":
+                return
+            with self._lock:
+                view = {nid: dict(avail)
+                        for nid, avail in self.node_available.items()
+                        if self.nodes[nid].alive}
+            placement = pack_bundles(view, info.bundles, info.strategy)
+            if placement is None:
+                time.sleep(0.1)
+                continue
+            # Phase 1: prepare every bundle on its node; roll back all on
+            # any failure (reference PrepareBundleResources,
+            # node_manager.proto:378).
+            prepared = []
+            ok = True
+            for idx, (nid, bundle) in enumerate(
+                    zip(placement, info.bundles)):
+                node = self.nodes.get(nid)
+                try:
+                    good = node is not None and node.alive and \
+                        self._pool.get(node.address).call(
+                            "nm_prepare_bundle", pg_id_hex=pg_id_hex,
+                            bundle_index=idx, resources=bundle)
+                except Exception:  # noqa: BLE001
+                    good = False
+                if not good:
+                    ok = False
+                    break
+                prepared.append((node, idx))
+            if not ok:
+                for node, idx in prepared:
+                    try:
+                        self._pool.get(node.address).call(
+                            "nm_return_bundle", pg_id_hex=pg_id_hex,
+                            bundle_index=idx)
+                    except Exception:  # noqa: BLE001
+                        pass
+                time.sleep(0.1)
+                continue
+            # Phase 2: commit (reference CommitBundleResources,
+            # node_manager.proto:382).
+            for node, idx in prepared:
+                try:
+                    self._pool.get(node.address).call(
+                        "nm_commit_bundle", pg_id_hex=pg_id_hex,
+                        bundle_index=idx)
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._lock:
+                # remove_placement_group may have raced us between the
+                # top-of-loop check and the commit: it saw PENDING and
+                # returned no bundles, so we must release them here rather
+                # than resurrect a removed group.
+                if info.state == "REMOVED":
+                    removed_while_scheduling = True
+                else:
+                    removed_while_scheduling = False
+                    info.bundle_nodes = list(placement)
+                    info.state = "CREATED"
+            if removed_while_scheduling:
+                for node, idx in prepared:
+                    try:
+                        self._pool.get(node.address).call(
+                            "nm_return_bundle", pg_id_hex=pg_id_hex,
+                            bundle_index=idx)
+                    except Exception:  # noqa: BLE001
+                        pass
+                return
+            self.publish("placement_group", ("CREATED", info))
+            return
+        with self._lock:
+            if info.state == "PENDING":
+                info.state = "INFEASIBLE"
+        self.publish("placement_group", ("INFEASIBLE", info))
+
+    def remove_placement_group(self, pg_id_hex: str) -> bool:
+        with self._lock:
+            info = self.placement_groups.get(pg_id_hex)
+            if info is None or info.state == "REMOVED":
+                return False
+            prev_state = info.state
+            info.state = "REMOVED"
+            # kill actors scheduled into this group (reference
+            # GcsPlacementGroupManager::RemovePlacementGroup cleans up
+            # dependent actors)
+            doomed = [aid for aid, spec in self.actor_specs.items()
+                      if spec.placement_group_id is not None
+                      and spec.placement_group_id.hex() == pg_id_hex]
+        for aid in doomed:
+            try:
+                self.kill_actor(aid, no_restart=True)
+            except Exception:  # noqa: BLE001
+                pass
+        if prev_state == "CREATED":
+            for idx, nid in enumerate(info.bundle_nodes):
+                node = self.nodes.get(nid)
+                if node is None:
+                    continue
+                try:
+                    self._pool.get(node.address).call(
+                        "nm_return_bundle", pg_id_hex=pg_id_hex,
+                        bundle_index=idx)
+                except Exception:  # noqa: BLE001
+                    pass
+        self.publish("placement_group", ("REMOVED", info))
+        return True
+
+    def get_placement_group(self, pg_id_hex: str):
+        with self._lock:
+            return self.placement_groups.get(pg_id_hex)
+
+    def list_placement_groups(self):
+        with self._lock:
+            return list(self.placement_groups.values())
 
     def subscribe(self, channel: str, address: Tuple[str, int],
                   token: str) -> None:

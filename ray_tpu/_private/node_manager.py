@@ -47,8 +47,14 @@ def rewrite_resources_for_pg(resources: Dict[str, float], pg_id_hex: str,
     for r, v in resources.items():
         out[pg_resource_name(r, pg_id_hex, bundle_index)] = v
     # Always require a sliver of the wildcard resource so tasks can only run
-    # on nodes holding a committed bundle of this group.
+    # on nodes holding a committed bundle of this group — and of the
+    # *indexed* bundle resource when a bundle index was requested, so
+    # zero-resource tasks/actors still pin to their bundle's node
+    # (reference bundle_spec.h adds the indexed `bundle` resource too).
     out.setdefault(pg_resource_name("bundle", pg_id_hex), 0.001)
+    if bundle_index >= 0:
+        out.setdefault(pg_resource_name("bundle", pg_id_hex, bundle_index),
+                       0.001)
     return out
 
 
@@ -159,7 +165,46 @@ class NodeManager:
                                node_id_hex=self.node_id.hex(), available=avail)
             except Exception:  # noqa: BLE001
                 pass
+            try:
+                self._respill_pending()
+            except Exception:  # noqa: BLE001
+                pass
             time.sleep(Config.resource_report_period_s)
+
+    def _respill_pending(self) -> None:
+        """Re-route queued leases that became feasible on another node
+        (reference: ClusterTaskManager::ScheduleAndDispatchTasks re-runs
+        cluster scheduling for queued work each round; without this, a
+        lease queued before e.g. a PG bundle committed elsewhere would
+        wait forever)."""
+        with self._lock:
+            candidates = [pl for pl in self.pending if pl.acquired is None]
+        if not candidates:
+            return
+        avail, totals, nodes = self._cluster_view()
+        for pl in candidates:
+            strategy = pl.spec.scheduling_strategy
+            if isinstance(strategy, NodeAffinitySchedulingStrategy) \
+                    and not strategy.soft:
+                continue  # hard affinity: must stay here
+            required = self._effective_resources(pl.spec)
+            chosen = pick_node(avail, required, strategy,
+                               local_node_id=self.node_id.hex(),
+                               totals=totals)
+            if chosen is None or chosen == self.node_id.hex() \
+                    or chosen not in nodes:
+                continue
+            with self._lock:
+                if pl not in self.pending or pl.acquired is not None:
+                    continue
+                self.pending.remove(pl)
+            try:
+                self._pool.get(pl.reply_to).call(
+                    "cw_lease_respill", task_id=pl.spec.task_id,
+                    nm_address=nodes[chosen])
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    self.pending.append(pl)
 
     def _cluster_view(self) -> Tuple[Dict[str, Dict[str, float]],
                                      Dict[str, Dict[str, float]],

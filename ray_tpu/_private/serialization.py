@@ -25,18 +25,21 @@ def loads_function(blob: bytes) -> Any:
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
-    """Pickle5 with out-of-band buffers. Falls back to cloudpickle (in-band)
-    when the value graph contains code objects pickle can't handle."""
+    """CloudPickler with pickle5 out-of-band buffers.
+
+    Always cloudpickle, never plain pickle: plain pickle serializes
+    driver-script (__main__) functions *by reference* without error, and
+    the reference breaks only at deserialization time inside a worker
+    whose __main__ is worker_main. CloudPickler pickles unimportable
+    objects (closures, __main__ functions, lambdas) by value and
+    everything else by reference, and for plain data is the same C
+    pickler underneath.
+    """
     buffers: List[pickle.PickleBuffer] = []
-    try:
-        meta = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
-        return b"P" + meta, buffers
-    except Exception:  # noqa: BLE001 - lambdas/local classes etc.
-        buffers = []
-        f = io.BytesIO()
-        cloudpickle.CloudPickler(
-            f, protocol=5, buffer_callback=buffers.append).dump(value)
-        return b"C" + f.getvalue(), buffers
+    f = io.BytesIO()
+    cloudpickle.CloudPickler(
+        f, protocol=5, buffer_callback=buffers.append).dump(value)
+    return b"C" + f.getvalue(), buffers
 
 
 def deserialize(meta: bytes, buffers: List[Any]) -> Any:

@@ -167,6 +167,22 @@ class NodeInfo:
 
 
 @dataclass
+class PlacementGroupInfo:
+    """GCS placement-group table entry (reference:
+    gcs_placement_group_manager.h GcsPlacementGroup; states per
+    gcs.proto PlacementGroupTableData)."""
+    pg_id: PlacementGroupID
+    name: str
+    bundles: List[Dict[str, float]]
+    strategy: str                        # PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
+    state: str = "PENDING"               # PENDING/CREATED/REMOVED/RESCHEDULING
+    # node id hex per bundle once committed
+    bundle_nodes: List[str] = field(default_factory=list)
+    creator_job_id: str = ""
+    detached: bool = False
+
+
+@dataclass
 class ActorInfo:
     actor_id: ActorID
     name: str

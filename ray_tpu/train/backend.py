@@ -1,0 +1,45 @@
+"""Backend plugin contract.
+
+reference parity: python/ray/train/backend.py:15,27 — BackendConfig /
+Backend ABC with on_start / on_training_start / on_shutdown hooks run by
+the BackendExecutor around worker-group lifecycle. The reference's
+_TorchBackend does NCCL process-group setup here
+(train/torch/config.py:148-200); the TPU build's JaxBackend instead wires
+jax.distributed coordinator env + TPU slice visibility
+(ray_tpu/train/jax_backend.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Type
+
+if TYPE_CHECKING:
+    from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    """Base config; subclasses carry framework-specific knobs."""
+
+    @property
+    def backend_cls(self) -> Type["Backend"]:
+        return Backend
+
+
+class Backend:
+    """Framework setup hooks (all optional)."""
+
+    share_cuda_visible_devices: bool = False
+
+    def on_start(self, worker_group: "WorkerGroup",
+                 backend_config: BackendConfig) -> None:
+        pass
+
+    def on_training_start(self, worker_group: "WorkerGroup",
+                          backend_config: BackendConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: "WorkerGroup",
+                    backend_config: BackendConfig) -> None:
+        pass

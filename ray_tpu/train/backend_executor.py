@@ -1,0 +1,202 @@
+"""BackendExecutor: drives the worker group through a training run.
+
+reference parity: python/ray/train/_internal/backend_executor.py:65 —
+start (:124, placement group at :200), _share_resource_ids (:258,286:
+CUDA/neuron visibility sharing → here TPU chip visibility), rank mappings
+(:358), start_training (:438), get_next_results (:552),
+get_with_failure_handling (:640) and restart-on-failure (:701,712) bounded
+by FailureConfig.max_failures (air/config.py:377).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainContext, TrainingResult
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(RuntimeError):
+    """A worker's train loop raised; wraps the original error."""
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig,
+                 max_failures: int = 0):
+        self._backend_config = backend_config
+        self._backend: Backend = backend_config.backend_cls()
+        self._scaling = scaling_config
+        self._max_failures = max_failures
+        self._num_failures = 0
+        self.worker_group: Optional[WorkerGroup] = None
+        self._contexts: List[TrainContext] = []
+        # stashed so restarts can re-enter training transparently
+        self._train_args: Optional[Dict[str, Any]] = None
+        self._latest_checkpoint_dir: Optional[str] = None
+
+    # ---- lifecycle --------------------------------------------------
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self._scaling.num_workers,
+            self._scaling._resources_per_worker_not_none,
+            self._scaling.placement_strategy)
+        self._contexts = self._build_contexts(self.worker_group)
+        if self._scaling.num_tpus_per_worker:
+            self._share_tpu_visibility(self.worker_group)
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def _build_contexts(self, wg: WorkerGroup) -> List[TrainContext]:
+        """World/local/node ranks from the sorted gang (reference
+        backend_executor.py:358 _create_rank_world_size_mappings)."""
+        node_to_workers: Dict[str, List[int]] = defaultdict(list)
+        for rank, node_id in enumerate(wg.node_ids):
+            node_to_workers[node_id].append(rank)
+        node_rank = {nid: i for i, nid in enumerate(
+            dict.fromkeys(wg.node_ids))}
+        contexts = []
+        for rank, node_id in enumerate(wg.node_ids):
+            peers = node_to_workers[node_id]
+            contexts.append(TrainContext(
+                world_rank=rank,
+                world_size=len(wg),
+                local_rank=peers.index(rank),
+                local_world_size=len(peers),
+                node_rank=node_rank[node_id],
+            ))
+        return contexts
+
+    def _share_tpu_visibility(self, wg: WorkerGroup) -> None:
+        """Split the node's TPU chips among co-located workers
+        (reference backend_executor.py:258 shares CUDA_VISIBLE_DEVICES;
+        TPU env contract per _private/accelerators/tpu.py:157-196)."""
+        from ray_tpu._private.accelerators.tpu import (
+            TPU_CHIPS_PER_HOST_BOUNDS_ENV, TPU_HOST_BOUNDS_ENV,
+            TPU_SINGLE_HOST_BOUNDS, TPU_VISIBLE_CHIPS_ENV)
+
+        per_worker = int(self._scaling.num_tpus_per_worker)
+        env_per_worker: List[Dict[str, str]] = []
+        next_chip: Dict[str, int] = defaultdict(int)
+        for ctx, node_id in zip(self._contexts, wg.node_ids):
+            start = next_chip[node_id]
+            chips = list(range(start, start + per_worker))
+            next_chip[node_id] += per_worker
+            env = {TPU_VISIBLE_CHIPS_ENV:
+                   ",".join(str(c) for c in chips)}
+            # sub-host slicing bounds (1/2/4-chip topologies)
+            if per_worker == 1:
+                env[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = "1,1,1"
+                env[TPU_HOST_BOUNDS_ENV] = TPU_SINGLE_HOST_BOUNDS
+            elif per_worker == 2:
+                env[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = "1,2,1"
+                env[TPU_HOST_BOUNDS_ENV] = TPU_SINGLE_HOST_BOUNDS
+            env_per_worker.append(env)
+        wg.setup_env(env_per_worker)
+
+    # ---- training ---------------------------------------------------
+    def start_training(self, train_loop: Callable,
+                       config: Optional[Dict[str, Any]],
+                       checkpoint_dir: Optional[str] = None,
+                       experiment_name: str = "",
+                       trial_dir: str = "") -> None:
+        assert self.worker_group is not None, "call start() first"
+        self._train_args = {
+            "train_loop": train_loop, "config": config,
+            "experiment_name": experiment_name, "trial_dir": trial_dir,
+        }
+        self._latest_checkpoint_dir = checkpoint_dir
+        self._backend.on_training_start(self.worker_group,
+                                        self._backend_config)
+        import ray_tpu
+        refs = []
+        for rank, w in enumerate(self.worker_group.workers):
+            ctx = self._contexts[rank]
+            ctx.experiment_name = experiment_name
+            ctx.trial_dir = trial_dir
+            refs.append(w.init_session.remote(
+                train_loop, config, ctx, checkpoint_dir))
+        ray_tpu.get(refs, timeout=120)
+        ray_tpu.get([w.start_training_session.remote()
+                     for w in self.worker_group.workers], timeout=120)
+
+    def get_next_results(self, timeout: float = 600.0
+                         ) -> Optional[List[TrainingResult]]:
+        """One result per worker, or None when all loops finished.
+
+        Worker failures raise TrainingWorkerError after restart budget is
+        exhausted; otherwise the group is restarted from the latest
+        checkpoint and training resumes (reference
+        backend_executor.py:552,640-712)."""
+        import ray_tpu
+        assert self.worker_group is not None
+        while True:
+            try:
+                results = ray_tpu.get(
+                    [w.next_result.remote(timeout=timeout)
+                     for w in self.worker_group.workers],
+                    timeout=timeout + 60)
+            except Exception as e:  # noqa: BLE001 - actor death etc.
+                self._handle_failure(e)
+                continue
+            errors = [r.error for r in results
+                      if r is not None and r.error is not None]
+            if errors:
+                self._handle_failure(errors[0])
+                continue
+            finals = [r is not None and r.final for r in results]
+            if all(finals):
+                return None
+            if any(finals):
+                # Uneven report() counts across ranks is a train-loop bug;
+                # surface it instead of mixing empty final results into a
+                # live round (reference backend_executor.py:581 raises
+                # RuntimeError on partial completion).
+                done = [i for i, f in enumerate(finals) if f]
+                raise TrainingWorkerError(
+                    f"workers {done} finished while others are still "
+                    "reporting — all ranks must call report() the same "
+                    "number of times")
+            return [r for r in results if r is not None]
+
+    def _handle_failure(self, error: BaseException) -> None:
+        self._num_failures += 1
+        if self._max_failures >= 0 and self._num_failures > self._max_failures:
+            raise TrainingWorkerError(
+                f"training failed after {self._num_failures - 1} "
+                f"restart(s): {error!r}") from error
+        logger.warning(
+            "train worker failure %d/%s (%r); restarting group from "
+            "latest checkpoint", self._num_failures,
+            self._max_failures if self._max_failures >= 0 else "inf", error)
+        self._restart()
+
+    def _restart(self) -> None:
+        assert self._train_args is not None, "no training to restart"
+        self.shutdown()
+        self.start()
+        self.start_training(
+            self._train_args["train_loop"], self._train_args["config"],
+            checkpoint_dir=self._latest_checkpoint_dir,
+            experiment_name=self._train_args["experiment_name"],
+            trial_dir=self._train_args["trial_dir"])
+
+    def note_checkpoint(self, checkpoint_dir: str) -> None:
+        """Driver tells the executor where the latest persisted checkpoint
+        lives so restarts resume from it."""
+        self._latest_checkpoint_dir = checkpoint_dir
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group,
+                                          self._backend_config)
+            except Exception:  # noqa: BLE001
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,87 @@
+"""Checkpoint: a directory-of-files abstraction.
+
+reference parity: python/ray/train/_checkpoint.py:55 — Checkpoint with
+from_directory/to_directory/as_directory over a storage URI. Storage here
+is a filesystem path (local or NFS); jax pytrees ride orbax inside the
+directory when the caller uses JaxTrainer's save helpers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    """A reference to a directory containing a checkpoint."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Materialize into `path` (copy); returns the path."""
+        dest = path or os.path.join(
+            tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) == self.path:
+            return self.path
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Context manager view; local paths are yielded directly without
+        copying (reference _checkpoint.py as_directory fast path)."""
+        yield self.path
+
+    # -- convenience for jax pytrees ---------------------------------
+    def save_pytree(self, tree: Any, name: str = "state") -> None:
+        """Write a jax pytree via orbax into this checkpoint dir."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        dest = os.path.join(self.path, name)
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        ckptr.save(dest, jax.device_get(tree))
+        ckptr.wait_until_finished()
+
+    def load_pytree(self, name: str = "state",
+                    target: Optional[Any] = None) -> Any:
+        import jax
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        src = os.path.join(self.path, name)
+        if target is not None:
+            shapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), target)
+            return ckptr.restore(src, shapes)
+        return ckptr.restore(src)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        import json
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        import json
+        p = os.path.join(self.path, ".metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self) -> str:
+        return f"Checkpoint(path={self.path!r})"

@@ -1,0 +1,86 @@
+"""Checkpoint bookkeeping: persist, rank, prune.
+
+reference parity: python/ray/train/_internal/checkpoint_manager.py:43
+(_CheckpointManager) honoring CheckpointConfig (air/config.py:428 —
+num_to_keep, checkpoint_score_attribute/order).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+@dataclass
+class _TrackedCheckpoint:
+    checkpoint: Checkpoint
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    index: int = 0
+    time: float = field(default_factory=time.time)
+
+
+class CheckpointManager:
+    def __init__(self, run_dir: str,
+                 config: Optional[CheckpointConfig] = None):
+        self.run_dir = run_dir
+        self.config = config or CheckpointConfig()
+        self._checkpoints: List[_TrackedCheckpoint] = []
+        self._counter = 0
+        os.makedirs(run_dir, exist_ok=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        return self._checkpoints[-1].checkpoint if self._checkpoints else None
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        ranked = self._ranked()
+        return ranked[0].checkpoint if ranked else None
+
+    def list(self) -> List[Checkpoint]:
+        return [t.checkpoint for t in self._checkpoints]
+
+    def register(self, worker_dir: str,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        """Persist a worker-reported checkpoint dir into the run dir."""
+        self._counter += 1
+        dest = os.path.join(self.run_dir,
+                            f"checkpoint_{self._counter:06d}")
+        if os.path.abspath(worker_dir) != dest:
+            shutil.copytree(worker_dir, dest, dirs_exist_ok=True)
+        ckpt = Checkpoint(dest)
+        self._checkpoints.append(_TrackedCheckpoint(
+            checkpoint=ckpt, metrics=dict(metrics), index=self._counter))
+        self._prune()
+        return ckpt
+
+    def _ranked(self) -> List[_TrackedCheckpoint]:
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            return list(reversed(self._checkpoints))  # newest first
+
+        def score(t: _TrackedCheckpoint):
+            return t.metrics.get(attr, float("-inf"))
+
+        return sorted(self._checkpoints, key=score,
+                      reverse=self.config.checkpoint_score_order == "max")
+
+    def _prune(self) -> None:
+        keep = self.config.num_to_keep
+        if keep is None or len(self._checkpoints) <= keep:
+            return
+        ranked = self._ranked()
+        doomed = ranked[keep:]
+        # never delete the most recent checkpoint: restarts resume from it
+        latest = self._checkpoints[-1]
+        for t in doomed:
+            if t is latest:
+                continue
+            self._checkpoints.remove(t)
+            shutil.rmtree(t.checkpoint.path, ignore_errors=True)

@@ -1,0 +1,85 @@
+"""Train/AIR config dataclasses.
+
+reference parity: python/ray/air/config.py — ScalingConfig (:101),
+FailureConfig (:377), CheckpointConfig (:428), RunConfig (:577).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what resources each holds (reference
+    air/config.py:101). For TPU workers set
+    ``resources_per_worker={"TPU": 4}`` and ``use_tpu=True``; the trainer
+    gang-schedules one worker per TPU-VM host of the slice."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    @property
+    def _resources_per_worker_not_none(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        return {"CPU": 1, "TPU": 4} if self.use_tpu else {"CPU": 1}
+
+    def as_placement_group_factory(self) -> List[Dict[str, float]]:
+        """Bundle list for the worker gang (reference
+        ScalingConfig.as_placement_group_factory)."""
+        return [self._resources_per_worker_not_none
+                for _ in range(self.num_workers)]
+
+    @property
+    def num_tpus_per_worker(self) -> float:
+        return self._resources_per_worker_not_none.get("TPU", 0)
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """reference air/config.py:377. max_failures: retries of the whole
+    worker group from the last checkpoint; -1 = infinite."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """reference air/config.py:428."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be max|min")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """reference air/config.py:577."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.path.expanduser("~/ray_tpu_results")
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()

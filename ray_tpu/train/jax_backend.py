@@ -1,0 +1,86 @@
+"""JaxBackend: the TPU-native replacement for _TorchBackend.
+
+reference parity: python/ray/train/torch/config.py:22,148-200 —
+_TorchBackend.on_start broadcasts rank-0's address and runs
+dist.init_process_group(nccl|gloo) on every worker, plus torchelastic env
+(:129-145). Here the "process group" is jax's distributed runtime: worker
+0 hosts the coordinator, every worker calls jax.distributed.initialize
+(coordinator_address, num_processes=world_size, process_id=rank), after
+which jax.devices() spans the whole slice and pjit/shard_map collectives
+ride ICI. (SURVEY.md §7.1 translation table, row 1.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Type
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class JaxConfig(BackendConfig):
+    """distributed=None (default): initialize jax.distributed only when
+    the gang spans more than one process AND TPU chips are attached —
+    single-worker and chip-free CI runs skip the coordinator entirely."""
+
+    distributed: Optional[bool] = None
+    coordinator_port: int = 8476
+
+    @property
+    def backend_cls(self) -> Type["Backend"]:
+        return _JaxBackend
+
+
+def _get_node_ip() -> str:
+    import socket
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _init_jax_distributed(coordinator_address: str, num_processes: int,
+                          process_id: int) -> None:
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: JaxConfig) -> None:
+        distributed = backend_config.distributed
+        if distributed is None:
+            # Probe on worker 0, not the driver: the driver may sit on a
+            # CPU-only head node while workers hold the TPU slice.
+            distributed = len(worker_group) > 1 and \
+                worker_group.execute_single(0, _worker_has_tpu)
+        if not distributed:
+            logger.debug("JaxBackend: single-process mode, no coordinator")
+            return
+        # Rank 0's node hosts the coordinator (reference
+        # torch/config.py:106-112 picks MASTER_ADDR from worker 0).
+        ip = worker_group.execute_single(0, _get_node_ip)
+        coordinator = f"{ip}:{backend_config.coordinator_port}"
+        import ray_tpu
+        ray_tpu.get([
+            w.apply.remote(_init_jax_distributed, coordinator,
+                              len(worker_group), rank)
+            for rank, w in enumerate(worker_group.workers)
+        ], timeout=300)
+
+
+def _worker_has_tpu() -> bool:
+    from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+    return TPUAcceleratorManager.get_current_node_num_accelerators() > 0

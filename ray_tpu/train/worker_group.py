@@ -1,0 +1,132 @@
+"""Worker group: N train-worker actors gang-scheduled in a placement group.
+
+reference parity: python/ray/train/_internal/worker_group.py:19,102,365 —
+RayTrainWorker actor + WorkerGroup with node/accelerator-sorted stable
+ranks; placement group creation mirrors BackendExecutor.start
+(_internal/backend_executor.py:200).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import (TrainContext, TrainingResult,
+                                   _set_session, _TrainSession)
+
+
+class RayTrainWorker:
+    """The per-rank actor (reference worker_group.py:19). Hosts the
+    session; also a generic `_execute` escape hatch used by backends."""
+
+    def __init__(self) -> None:
+        self._session: Optional[_TrainSession] = None
+
+    def apply(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        return fn(*args, **kwargs)
+
+    def setup_env(self, env: Dict[str, str]) -> None:
+        os.environ.update(env)
+
+    def node_info(self) -> Tuple[str, int]:
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_node_id(), os.getpid()
+
+    def init_session(self, train_loop: Callable, config: Optional[Dict],
+                      context: TrainContext,
+                      checkpoint_dir: Optional[str]) -> None:
+        ckpt = Checkpoint(checkpoint_dir) if checkpoint_dir else None
+        self._session = _TrainSession(train_loop, config, context, ckpt)
+        _set_session(self._session)
+
+    def start_training_session(self) -> None:
+        assert self._session is not None
+        self._session.start()
+
+    def next_result(self, timeout: Optional[float] = None):
+        assert self._session is not None
+        return self._session.next_result(timeout=timeout)
+
+    def shutdown_session(self) -> None:
+        self._session = None
+        _set_session(None)
+
+
+class WorkerGroup:
+    """Creates/holds the actor gang (reference worker_group.py:102)."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK"):
+        from ray_tpu.util import (PlacementGroupSchedulingStrategy,
+                                  placement_group)
+
+        self.num_workers = num_workers
+        self._pg = placement_group(
+            [dict(resources_per_worker) for _ in range(num_workers)],
+            strategy=placement_strategy)
+        if not self._pg.wait(120):
+            from ray_tpu.util import remove_placement_group
+            remove_placement_group(self._pg)
+            raise TimeoutError(
+                f"placement group for {num_workers} x "
+                f"{resources_per_worker} not schedulable within 120s")
+
+        cls = ray_tpu.remote(RayTrainWorker)
+        self.workers = [
+            cls.options(
+                num_cpus=0,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=i)).remote()
+            for i in range(num_workers)
+        ]
+        # Stable rank order: sort by node id then pid (reference
+        # worker_group.py:365 sorts by node + GPU ids for deterministic
+        # rank assignment).
+        infos = ray_tpu.get(
+            [w.node_info.remote() for w in self.workers], timeout=120)
+        order = sorted(range(num_workers),
+                       key=lambda i: (infos[i][0], infos[i][1]))
+        self.workers = [self.workers[i] for i in order]
+        self.node_ids = [infos[i][0] for i in order]
+
+    @property
+    def placement_group(self):
+        return self._pg
+
+    def execute(self, fn: Callable, *args: Any, **kwargs: Any) -> List[Any]:
+        """Run fn on every worker, gather results (reference
+        WorkerGroup.execute)."""
+        return ray_tpu.get(
+            [w.apply.remote(fn, *args, **kwargs) for w in self.workers],
+            timeout=300)
+
+    def execute_single(self, rank: int, fn: Callable, *args: Any,
+                       **kwargs: Any) -> Any:
+        return ray_tpu.get(
+            self.workers[rank].apply.remote(fn, *args, **kwargs),
+            timeout=300)
+
+    def setup_env(self, env_per_worker: List[Dict[str, str]]) -> None:
+        ray_tpu.get([w.setup_env.remote(env)
+                     for w, env in zip(self.workers, env_per_worker)],
+                    timeout=120)
+
+    def shutdown(self) -> None:
+        from ray_tpu.util import remove_placement_group
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            remove_placement_group(self._pg)
+        except Exception:  # noqa: BLE001
+            pass
+        self.workers = []
+
+    def __len__(self) -> int:
+        return len(self.workers)

@@ -1,0 +1,102 @@
+"""Placement group API tests (reference test model:
+python/ray/tests/test_placement_group*.py over cluster_utils fakes)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (PlacementGroup, PlacementGroupSchedulingStrategy,
+                          get_current_placement_group, placement_group,
+                          placement_group_table, remove_placement_group)
+
+
+class TestPlacementGroup:
+    def test_create_wait_ready(self, ray_start):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+        assert pg.wait(30)
+        assert pg.is_ready()
+        table = placement_group_table()[pg.id.hex()]
+        assert table["state"] == "CREATED"
+        assert len(table["bundle_nodes"]) == 2
+        remove_placement_group(pg)
+
+    def test_ready_object_ref(self, ray_start):
+        pg = placement_group([{"CPU": 1}], strategy="STRICT_PACK")
+        assert ray_tpu.get(pg.ready(), timeout=60)
+        remove_placement_group(pg)
+
+    def test_infeasible_strict_spread(self, ray_start):
+        # single node: STRICT_SPREAD of 2 bundles can never commit
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_SPREAD")
+        assert not pg.wait(2)
+        remove_placement_group(pg)
+
+    def test_invalid_args(self, ray_start):
+        with pytest.raises(ValueError):
+            placement_group([{"CPU": 1}], strategy="DIAGONAL")
+        with pytest.raises(ValueError):
+            placement_group([])
+
+    def test_task_in_pg_and_capture(self, ray_start):
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(30)
+
+        @ray_tpu.remote
+        def where_am_i():
+            cur = get_current_placement_group()
+            return cur.id.hex() if cur else None
+
+        inside = ray_tpu.get(where_am_i.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_bundle_index=0)).remote(), timeout=60)
+        assert inside == pg.id.hex()
+        outside = ray_tpu.get(where_am_i.remote(), timeout=60)
+        assert outside is None
+        remove_placement_group(pg)
+
+    def test_actor_in_pg(self, ray_start):
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(30)
+
+        @ray_tpu.remote
+        class A:
+            def pg(self):
+                cur = get_current_placement_group()
+                return cur.id.hex() if cur else None
+
+        a = A.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_bundle_index=0)).remote()
+        assert ray_tpu.get(a.pg.remote(), timeout=60) == pg.id.hex()
+        ray_tpu.kill(a)
+        remove_placement_group(pg)
+
+    def test_remove_releases_resources(self, ray_start):
+        import time
+        # quiesce: prior tests' PG teardown is async — wait until the
+        # full CPU capacity is visible again before measuring
+        total = ray_tpu.cluster_resources().get("CPU", 0)
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                ray_tpu.available_resources().get("CPU", 0) < total:
+            time.sleep(0.1)
+        before = ray_tpu.available_resources().get("CPU", 0)
+        assert before == total, "cluster did not quiesce"
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg.wait(30)
+        # resource views reach the GCS on the periodic report; poll
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if ray_tpu.available_resources().get("CPU", 0) <= before - 2:
+                break
+            time.sleep(0.1)
+        assert ray_tpu.available_resources().get("CPU", 0) <= before - 2
+        remove_placement_group(pg)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if ray_tpu.available_resources().get("CPU", 0) >= before:
+                break
+            time.sleep(0.1)
+        assert ray_tpu.available_resources().get("CPU", 0) >= before
