@@ -1,0 +1,28 @@
+"""ray_tpu.rllib: reinforcement learning (RLlib parity, jax-native).
+
+reference: python/ray/rllib — Algorithm/Learner/RLModule/EnvRunner stack
+(SURVEY.md §2.3). Learners are JIT'd XLA programs; EnvRunners stay CPU
+actors streaming trajectories through the object store (BASELINE.json
+north star). Algorithms shipped this round: PPO, IMPALA (the north-star
+set; the reference's 34-algo registry is tracked in SURVEY.md §8.3).
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala.impala import (Impala,  # noqa: F401
+                                                    ImpalaConfig)
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.core.catalog import DiscreteMLPModule  # noqa: F401
+from ray_tpu.rllib.core.learner import Learner  # noqa: F401
+from ray_tpu.rllib.core.learner_group import LearnerGroup  # noqa: F401
+from ray_tpu.rllib.core.rl_module import RLModule  # noqa: F401
+from ray_tpu.rllib.env.base import Env, make_env, register_env  # noqa: F401
+from ray_tpu.rllib.env import cartpole  # noqa: F401  (registers CartPole-v1)
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner  # noqa: F401
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
+    "ImpalaConfig", "Learner", "LearnerGroup", "RLModule",
+    "DiscreteMLPModule", "Env", "register_env", "make_env",
+    "SingleAgentEnvRunner",
+]

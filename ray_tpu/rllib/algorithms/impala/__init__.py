@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.impala.impala import (Impala, ImpalaConfig,  # noqa: F401
+                                                    ImpalaLearner)
+from ray_tpu.rllib.algorithms.impala.vtrace import from_importance_weights  # noqa: F401

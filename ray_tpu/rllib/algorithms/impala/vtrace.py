@@ -1,0 +1,60 @@
+"""V-trace off-policy correction (Espeholt et al. 2018), in jax.
+
+reference parity: rllib/algorithms/impala/vtrace_torch.py:251
+(from_importance_weights) / :87 (from_logits). Time-major [T, B] arrays;
+the backward recursion is a `lax.scan` in reverse — one XLA program, no
+Python loop.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class VTraceReturns(NamedTuple):
+    vs: object             # [T, B] value targets
+    pg_advantages: object  # [T, B] policy-gradient advantages
+
+
+def from_importance_weights(log_rhos, discounts, rewards, values,
+                            bootstrap_value,
+                            clip_rho_threshold: float = 1.0,
+                            clip_pg_rho_threshold: float = 1.0
+                            ) -> VTraceReturns:
+    """All inputs time-major [T, B]; bootstrap_value [B].
+
+    discounts must already include termination masking
+    (gamma * (1 - done)).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(rhos, clip_rho_threshold)
+    cs = jnp.minimum(rhos, 1.0)
+
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + discounts * values_t_plus_1 - values)
+
+    def backward(acc, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+
+    vs_t_plus_1 = jnp.concatenate(
+        [vs[1:], bootstrap_value[None]], axis=0)
+    clipped_pg_rhos = jnp.minimum(rhos, clip_pg_rho_threshold)
+    pg_advantages = clipped_pg_rhos * (
+        rewards + discounts * vs_t_plus_1 - values)
+
+    return VTraceReturns(vs=jax.lax.stop_gradient(vs),
+                         pg_advantages=jax.lax.stop_gradient(
+                             pg_advantages))

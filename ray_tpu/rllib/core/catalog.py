@@ -1,0 +1,86 @@
+"""Catalog: default network builders per observation/action space.
+
+reference parity: rllib/core/models/catalog.py:33 (Catalog builds
+encoders/heads per space) and the legacy ModelCatalog
+(rllib/models/catalog.py:205). Default here: shared MLP torso with policy
++ value heads — the standard PPO/IMPALA CartPole/control net.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+from ray_tpu.rllib.core.rl_module import Categorical, RLModule
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+
+def _mlp_init(key, sizes, scale_last: float = 0.01):
+    import jax
+    import jax.numpy as jnp
+
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        w_scale = (2.0 / fan_in) ** 0.5
+        if i == len(sizes) - 2 and scale_last is not None:
+            w_scale = scale_last
+        params.append({
+            "w": (jax.random.normal(keys[i], (fan_in, fan_out), jnp.float32)
+                  * w_scale),
+            "b": jnp.zeros((fan_out,), jnp.float32),
+        })
+    return params
+
+
+def _mlp_apply(params, x):
+    import jax
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.tanh(x)
+    return x
+
+
+class DiscreteMLPModule(RLModule):
+    """Actor-critic MLP for Discrete action spaces."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+
+    def init_params(self, key) -> Dict[str, Any]:
+        import jax
+        k1, k2, k3 = jax.random.split(key, 3)
+        torso = [self.obs_dim, *self.hiddens]
+        return {
+            "torso": _mlp_init(k1, torso, scale_last=None),
+            "pi": _mlp_init(k2, [self.hiddens[-1], self.num_actions]),
+            "vf": _mlp_init(k3, [self.hiddens[-1], 1], scale_last=1.0),
+        }
+
+    def forward_train(self, params, batch):
+        import jax
+        h = _mlp_apply(params["torso"], batch["obs"])
+        h = jax.nn.tanh(h)
+        logits = _mlp_apply(params["pi"], h)
+        vf = _mlp_apply(params["vf"], h)[..., 0]
+        return {"action_dist_inputs": logits, "vf_preds": vf}
+
+    def action_dist(self, dist_inputs) -> Categorical:
+        return Categorical(dist_inputs)
+
+
+def default_module_for(observation_space, action_space,
+                       hiddens: Sequence[int] = (64, 64)) -> RLModule:
+    """reference Catalog._get_encoder_config dispatch, reduced to the
+    spaces this build ships."""
+    if isinstance(action_space, Discrete) and \
+            isinstance(observation_space, Box) and \
+            len(observation_space.shape) == 1:
+        return DiscreteMLPModule(
+            observation_space.shape[0], action_space.n, hiddens)
+    raise NotImplementedError(
+        f"no default module for obs={observation_space} "
+        f"act={action_space}; pass a custom RLModule via config.rl_module()")

@@ -1,0 +1,122 @@
+"""Learner: the gradient engine, one jitted update.
+
+reference parity: rllib/core/learner/learner.py:231 (Learner ABC:
+compute_loss / compute_gradients / postprocess_gradients /
+apply_gradients / additional_update at :557,679,988,1042) and
+TorchLearner (torch_learner.py:53). The torch stack splits those into
+five framework methods because autograd is stateful; in jax the whole
+minibatch update — loss, grad, clip, apply — is ONE pure jitted function,
+so the TPU Learner exposes compute_loss (override per algorithm) and the
+engine jits everything around it. Gradient clipping ≙ postprocess_
+gradients; additional_update handles KL-coeff style schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+class Learner:
+    def __init__(self, module: RLModule, config):
+        self.module = module
+        self.config = config
+        self._params = None
+        self._opt_state = None
+        self._optimizer = None
+        self._update_fn = None
+        # mutable non-jitted state for additional_update (e.g. kl coeff)
+        self.curr_kl_coeff = getattr(config, "kl_coeff", 0.0)
+
+    # ---- build ------------------------------------------------------
+    def build(self, seed: int = 0) -> None:
+        import jax
+        import optax
+
+        self._params = self.module.init_params(jax.random.PRNGKey(seed))
+        clip = getattr(self.config, "grad_clip", None)
+        chain = []
+        if clip:
+            chain.append(optax.clip_by_global_norm(clip))
+        chain.append(optax.adam(self.config.lr))
+        self._optimizer = optax.chain(*chain)
+        self._opt_state = self._optimizer.init(self._params)
+
+        def update(params, opt_state, batch, extra):
+            def loss_wrap(p):
+                loss, stats = self.compute_loss(p, batch, extra)
+                return loss, stats
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_wrap, has_aux=True)(params)
+            updates, opt_state = self._optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats = dict(stats)
+            stats["total_loss"] = loss
+            stats["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, stats
+
+        self._update_fn = jax.jit(update, donate_argnums=(0, 1))
+
+    # ---- algorithm contract ----------------------------------------
+    def compute_loss(self, params, batch: Dict[str, Any],
+                     extra: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def additional_update(self, **kwargs) -> Dict[str, Any]:
+        return {}
+
+    def extra_inputs(self) -> Dict[str, Any]:
+        """Scalars threaded into the jitted loss (kl coeff etc.)."""
+        return {}
+
+    # ---- update loop ------------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray],
+               minibatch_size: Optional[int] = None,
+               num_iters: int = 1,
+               seed: int = 0) -> Dict[str, float]:
+        """Minibatch SGD over the batch (reference Learner.update /
+        TorchLearner._update loop)."""
+        assert self._update_fn is not None, "call build() first"
+        n = len(batch["obs"])
+        minibatch_size = minibatch_size or n
+        rng = np.random.default_rng(seed)
+        stats: Dict[str, Any] = {}
+        count = 0
+        for _ in range(num_iters):
+            perm = rng.permutation(n)
+            for start in range(0, n, minibatch_size):
+                idx = perm[start:start + minibatch_size]
+                if len(idx) < minibatch_size and count > 0:
+                    continue  # drop ragged tail (keeps jit shapes stable)
+                mb = {k: v[idx] for k, v in batch.items()}
+                self._params, self._opt_state, st = self._update_fn(
+                    self._params, self._opt_state, mb,
+                    self.extra_inputs())
+                count += 1
+                for k, v in st.items():
+                    stats[k] = stats.get(k, 0.0) + float(v)
+        return {k: v / max(count, 1) for k, v in stats.items()}
+
+    # ---- weights ----------------------------------------------------
+    def get_weights(self):
+        import jax
+        return jax.device_get(self._params)
+
+    def set_weights(self, weights) -> None:
+        self._params = weights
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        return {"params": jax.device_get(self._params),
+                "opt_state": jax.device_get(self._opt_state),
+                "kl_coeff": self.curr_kl_coeff}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self._params = state["params"]
+        self._opt_state = state["opt_state"]
+        self.curr_kl_coeff = state.get("kl_coeff", self.curr_kl_coeff)

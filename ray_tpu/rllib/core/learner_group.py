@@ -1,0 +1,150 @@
+"""LearnerGroup: one local learner or a gang of learner actors.
+
+reference parity: rllib/core/learner/learner_group.py:63 — local mode
+(num_learners=0, learner in-process: the CartPole north-star config) or
+remote mode where learner actors are spawned over Train's worker-group
+machinery (learner_group.py:103-115 reuses BackendExecutor) and updates
+run data-parallel. The reference syncs gradients with torch DDP
+(torch_learner.py:378-390); here remote learners each update on their
+batch shard and the group averages the resulting *weights* host-side
+each round (equivalent to averaged-gradient DDP for equal shards under
+linear optimizers, and the standard host-RAM path for CPU learners —
+on a TPU pod the learners instead share one ICI mesh via
+jax.distributed, where psum rides the interconnect, see
+ray_tpu.train.JaxConfig).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class LearnerGroup:
+    def __init__(self, learner_factory: Callable[[], Any],
+                 num_learners: int = 0, seed: int = 0):
+        self._num_learners = num_learners
+        if num_learners == 0:
+            self._local = learner_factory()
+            self._local.build(seed=seed)
+            self._actors: List[Any] = []
+        else:
+            import ray_tpu
+
+            @ray_tpu.remote
+            class LearnerActor:
+                def __init__(self, factory, seed):
+                    self.learner = factory()
+                    self.learner.build(seed=seed)
+
+                def update(self, batch, minibatch_size, num_iters, seed):
+                    return self.learner.update(
+                        batch, minibatch_size, num_iters, seed)
+
+                def additional_update(self, **kw):
+                    return self.learner.additional_update(**kw)
+
+                def get_weights(self):
+                    return self.learner.get_weights()
+
+                def set_weights(self, w):
+                    self.learner.set_weights(w)
+
+                def get_state(self):
+                    return self.learner.get_state()
+
+                def set_state(self, s):
+                    self.learner.set_state(s)
+
+            self._local = None
+            self._actors = [LearnerActor.options(num_cpus=1).remote(
+                learner_factory, seed) for _ in range(num_learners)]
+            # all replicas must start from identical weights
+            import ray_tpu as rt
+            w0 = rt.get(self._actors[0].get_weights.remote(), timeout=120)
+            rt.get([a.set_weights.remote(w0) for a in self._actors[1:]],
+                   timeout=120)
+
+    def __len__(self) -> int:
+        return max(1, self._num_learners)
+
+    # ---- updates ----------------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray],
+               minibatch_size: Optional[int] = None,
+               num_iters: int = 1, seed: int = 0) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update(batch, minibatch_size, num_iters,
+                                      seed)
+        import jax
+        import ray_tpu
+
+        shards = _shard_batch(batch, len(self._actors))
+        stats = ray_tpu.get([
+            a.update.remote(s, minibatch_size, num_iters, seed + i)
+            for i, (a, s) in enumerate(zip(self._actors, shards))
+        ], timeout=600)
+        # average replica weights (see module docstring)
+        weights = ray_tpu.get(
+            [a.get_weights.remote() for a in self._actors], timeout=600)
+        mean_w = jax.tree.map(
+            lambda *xs: np.mean(np.stack(xs), axis=0), *weights)
+        ray_tpu.get([a.set_weights.remote(mean_w) for a in self._actors],
+                    timeout=600)
+        return {k: float(np.mean([s[k] for s in stats]))
+                for k in stats[0]}
+
+    def additional_update(self, **kwargs) -> Dict[str, Any]:
+        if self._local is not None:
+            return self._local.additional_update(**kwargs)
+        import ray_tpu
+        outs = ray_tpu.get(
+            [a.additional_update.remote(**kwargs) for a in self._actors],
+            timeout=120)
+        return outs[0]
+
+    # ---- weights ----------------------------------------------------
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu
+        return ray_tpu.get(self._actors[0].get_weights.remote(),
+                           timeout=600)
+
+    def set_weights(self, w) -> None:
+        if self._local is not None:
+            self._local.set_weights(w)
+            return
+        import ray_tpu
+        ray_tpu.get([a.set_weights.remote(w) for a in self._actors],
+                    timeout=600)
+
+    def get_state(self):
+        if self._local is not None:
+            return self._local.get_state()
+        import ray_tpu
+        return ray_tpu.get(self._actors[0].get_state.remote(), timeout=600)
+
+    def set_state(self, state) -> None:
+        if self._local is not None:
+            self._local.set_state(state)
+            return
+        import ray_tpu
+        ray_tpu.get([a.set_state.remote(state) for a in self._actors],
+                    timeout=600)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        self._actors = []
+
+
+def _shard_batch(batch: Dict[str, np.ndarray], n: int
+                 ) -> List[Dict[str, np.ndarray]]:
+    size = len(batch["obs"])
+    idx = np.array_split(np.arange(size), n)
+    return [{k: v[i] for k, v in batch.items()} for i in idx]

@@ -1,0 +1,88 @@
+"""RLModule: the policy/value network contract, jax-functional.
+
+reference parity: rllib/core/rl_module/rl_module.py:229 — RLModule with
+forward_exploration / forward_inference / forward_train. The reference
+couples module objects to torch state; here modules are *stateless
+describers*: params live in an explicit pytree (the Learner owns them),
+every forward is a pure function — so the whole train step jits and the
+EnvRunner can run the same module on CPU with device-put weights.
+
+Output column names follow the reference's SampleBatch/Columns contract
+(rllib/policy/sample_batch.py): actions, action_logp,
+action_dist_inputs, vf_preds.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+class RLModule:
+    """Subclasses define the network; all methods are pure functions."""
+
+    def init_params(self, key) -> Any:
+        raise NotImplementedError
+
+    def forward_train(self, params, batch: Dict[str, Any]
+                      ) -> Dict[str, Any]:
+        """-> {"action_dist_inputs": logits, "vf_preds": values}."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, batch: Dict[str, Any], key
+                            ) -> Dict[str, Any]:
+        """Stochastic acting: adds sampled actions + their logp."""
+        out = self.forward_train(params, batch)
+        dist = self.action_dist(out["action_dist_inputs"])
+        actions, logp = dist.sample_and_logp(key)
+        out["actions"] = actions
+        out["action_logp"] = logp
+        return out
+
+    def forward_inference(self, params, batch: Dict[str, Any]
+                          ) -> Dict[str, Any]:
+        """Greedy acting."""
+        out = self.forward_train(params, batch)
+        dist = self.action_dist(out["action_dist_inputs"])
+        out["actions"] = dist.mode()
+        return out
+
+    def action_dist(self, dist_inputs):
+        raise NotImplementedError
+
+
+class Categorical:
+    """Categorical over logits [..., n]."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def sample_and_logp(self, key) -> Tuple[Any, Any]:
+        import jax
+        actions = jax.random.categorical(key, self.logits, axis=-1)
+        return actions, self.logp(actions)
+
+    def logp(self, actions):
+        import jax
+        import jax.numpy as jnp
+        logp_all = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        import jax
+        import jax.numpy as jnp
+        p = jax.nn.softmax(self.logits, axis=-1)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(p * logp, axis=-1)
+
+    def mode(self):
+        import jax.numpy as jnp
+        return jnp.argmax(self.logits, axis=-1)
+
+    def kl(self, other: "Categorical"):
+        import jax
+        import jax.numpy as jnp
+        p = jax.nn.softmax(self.logits, axis=-1)
+        return jnp.sum(
+            p * (jax.nn.log_softmax(self.logits, axis=-1)
+                 - jax.nn.log_softmax(other.logits, axis=-1)), axis=-1)

@@ -1,0 +1,50 @@
+"""Env API (gymnasium-style 5-tuple protocol) + registry.
+
+reference parity: RLlib consumes gym.Env everywhere
+(env/single_agent_env_runner.py:34 builds gym.vector envs; env registry
+via tune.register_env). Same protocol here:
+reset(seed) -> (obs, info); step(a) -> (obs, reward, terminated,
+truncated, info). Register custom envs with register_env(name, creator);
+gymnasium envs plug in unchanged if the package is present.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_ENV_REGISTRY: Dict[str, Callable[[Dict[str, Any]], "Env"]] = {}
+
+
+class Env:
+    observation_space = None
+    action_space = None
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def register_env(name: str,
+                 creator: Callable[[Dict[str, Any]], Env]) -> None:
+    """reference: ray.tune.register_env."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(name: str, config: Optional[Dict[str, Any]] = None) -> Env:
+    config = config or {}
+    if name in _ENV_REGISTRY:
+        return _ENV_REGISTRY[name](config)
+    # fall through to gymnasium when available
+    try:
+        import gymnasium
+        return gymnasium.make(name)
+    except ImportError:
+        pass
+    raise KeyError(
+        f"unknown env {name!r}; register it with "
+        "ray_tpu.rllib.register_env(name, creator)")

@@ -1,0 +1,128 @@
+"""EnvRunner: CPU rollout workers shipping trajectories.
+
+reference parity: rllib/env/env_runner.py:15 (EnvRunner ABC) +
+single_agent_env_runner.py:34,99,139,312 — vector envs stepped with
+module.forward_exploration (:227), episodes returned to the driver
+through the object store. Runners are plain classes here; the Algorithm
+wraps them in actors (`ray_tpu.remote`) for num_env_runners > 0 exactly
+like WorkerSet does (evaluation/worker_set.py:82).
+
+The policy forward runs jitted on the runner's CPU jax; weights arrive
+as numpy pytrees via set_weights (broadcast from the Learner over the
+object store — device arrays never transit it, SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.base import make_env
+from ray_tpu.rllib.env.vector import SyncVectorEnv
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_name: str, module: RLModule,
+                 env_config: Optional[Dict[str, Any]] = None,
+                 num_envs: int = 1, seed: Optional[int] = None,
+                 worker_index: int = 0, gamma: float = 0.99):
+        import jax
+        # runners always act on CPU regardless of driver platform
+        jax.config.update("jax_platforms", "cpu")
+
+        self.env = SyncVectorEnv(
+            [functools.partial(make_env, env_name, env_config)
+             for _ in range(num_envs)])
+        self.module = module
+        self.worker_index = worker_index
+        self.gamma = gamma
+        self._key = jax.random.PRNGKey(
+            (seed if seed is not None else 0) * 10007 + worker_index)
+        self.params = None
+
+        self._explore = jax.jit(
+            lambda p, obs, k: module.forward_exploration(
+                p, {"obs": obs}, k))
+        self._value_only = jax.jit(
+            lambda p, obs: module.forward_train(p, {"obs": obs})["vf_preds"])
+
+        base_seed = None if seed is None else seed + worker_index * 1000
+        self._obs, _ = self.env.reset(base_seed)
+        # per-env running episode returns/lengths for metrics
+        self._ep_ret = np.zeros(self.env.num_envs, np.float64)
+        self._ep_len = np.zeros(self.env.num_envs, np.int64)
+        self._completed: List[Dict[str, float]] = []
+
+    # ---- weight sync (reference worker_set.py:365 sync_weights) -----
+    def set_weights(self, weights) -> None:
+        self.params = weights
+
+    def get_weights(self):
+        return self.params
+
+    # ---- sampling ---------------------------------------------------
+    def sample(self, num_timesteps: int) -> Dict[str, Any]:
+        """Roll out ~num_timesteps across the vector env; returns a
+        fragment batch of stacked columns [T, num_envs, ...] plus
+        bootstrap values and completed-episode metrics."""
+        import jax
+
+        assert self.params is not None, "set_weights before sample"
+        steps = max(1, num_timesteps // self.env.num_envs)
+        cols: Dict[str, List[np.ndarray]] = {
+            "obs": [], "actions": [], "rewards": [], "terminateds": [],
+            "truncateds": [], "action_logp": [], "vf_preds": []}
+        for _ in range(steps):
+            self._key, sub = jax.random.split(self._key)
+            out = self._explore(self.params, self._obs, sub)
+            actions = np.asarray(out["actions"])
+            obs_next, rewards, terms, truncs, _, final_obs = \
+                self.env.step(actions)
+            # Truncation is not termination: fold the bootstrap value of
+            # the true final observation into the reward (exactly
+            # equivalent to bootstrapping V there), so GAE can then treat
+            # done = term|trunc uniformly as episode end.
+            trunc_idx = np.nonzero(np.asarray(truncs)
+                                   & ~np.asarray(terms))[0]
+            if trunc_idx.size:
+                f_obs = np.stack([final_obs[i] for i in trunc_idx])
+                v_fin = np.asarray(self._value_only(self.params, f_obs))
+                rewards = rewards.copy()
+                rewards[trunc_idx] += self.gamma * v_fin
+            cols["obs"].append(self._obs)
+            cols["actions"].append(actions)
+            cols["rewards"].append(rewards)
+            cols["terminateds"].append(np.asarray(terms))
+            cols["truncateds"].append(np.asarray(truncs))
+            cols["action_logp"].append(np.asarray(out["action_logp"]))
+            cols["vf_preds"].append(np.asarray(out["vf_preds"]))
+
+            self._ep_ret += rewards
+            self._ep_len += 1
+            done = np.asarray(terms) | np.asarray(truncs)
+            for i in np.nonzero(done)[0]:
+                self._completed.append({
+                    "episode_return": float(self._ep_ret[i]),
+                    "episode_len": int(self._ep_len[i])})
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+            self._obs = obs_next
+
+        batch = {k: np.stack(v) for k, v in cols.items()}  # [T, N, ...]
+        # Fragment-end bootstrap: V(current obs). For envs whose last step
+        # was done, this is the autoreset obs — GAE masks it with
+        # (1 - done); truncation bootstrap was already folded into the
+        # reward above.
+        batch["bootstrap_value"] = np.asarray(
+            self._value_only(self.params, self._obs))
+        metrics = self._completed
+        self._completed = []
+        batch["episode_metrics"] = metrics
+        batch["worker_index"] = self.worker_index
+        return batch
+
+    def stop(self) -> None:
+        self.env.close()
