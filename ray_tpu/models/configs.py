@@ -23,8 +23,9 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
-    # "dense" | "ring" | "ulysses" — how attention is computed when the
-    # sequence axis is sharded. dense = all-gather-free local compute with
+    # "auto" | "dense" | "flash" | "ring" | "ulysses". auto = pallas
+    # flash kernel on TPU when the seq axis is unsharded (ring when it
+    # is), dense elsewhere; dense = materialized-scores attention with
     # GSPMD-managed layout; ring/ulysses = explicit shard_map SP.
     attention_impl: str = "dense"
     # dtypes: params kept in param_dtype, compute runs in dtype (bf16 on
@@ -32,6 +33,20 @@ class TransformerConfig:
     dtype: Any = "bfloat16"
     param_dtype: Any = "float32"
     remat: bool = False                   # jax.checkpoint each layer
+    # "full": recompute the whole layer in bwd (min memory, +1 fwd pass);
+    # "dots": save matmul outputs, recompute only elementwise chains
+    # (near-zero recompute FLOPs — fastest when activations fit; pick it
+    # explicitly for small/mid models like the GPT-2 bench config).
+    remat_policy: str = "full"
+    # chunk the lm-head + cross-entropy over the sequence axis so the
+    # [B,T,vocab] f32 logits (+grad) never materialize at once; 0 = off.
+    loss_chunk: int = 256
+    # unroll factor for the layer scan. True unrolls fully: XLA sees
+    # static weight slices (no dynamic-slice bookkeeping per layer) and
+    # can fuse across layer boundaries; costs compile time, wins step
+    # time for shallow stacks. Keep 1 (rolled) for deep models and for
+    # the pipeline axis.
+    scan_unroll: int = 1
 
     @property
     def kv_heads(self) -> int:

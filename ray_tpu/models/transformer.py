@@ -24,20 +24,29 @@ from ray_tpu.parallel.mesh import AXIS_SEQ
 from ray_tpu.parallel.sharding import ShardingRules, with_logical_constraint
 
 
-def _rope(x, positions, theta):
-    """Rotary position embedding on [..., T, H, D] with explicit positions
-    (global positions keep RoPE exact when the sequence axis is sharded)."""
+def _rope_tables(positions, head_dim, theta):
+    """cos/sin tables [..., T, half] (f32) for explicit positions — global
+    positions keep RoPE exact when the sequence axis is sharded. Computed
+    once per forward and closed over by the layer scan (not recomputed
+    per layer)."""
     import jax.numpy as jnp
 
-    d = x.shape[-1]
-    half = d // 2
+    half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, half]
-    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads: [...,T,1,half]
-    sin = jnp.sin(angles)[..., None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope(x, cos, sin):
+    """Apply rotary embedding to [..., T, H, D] given [..., T, half]
+    tables."""
+    import jax.numpy as jnp
+
+    half = x.shape[-1] // 2
+    c = cos[..., None, :]  # broadcast over heads: [..., T, 1, half]
+    s = sin[..., None, :]
     x1, x2 = x[..., :half], x[..., half:]
-    out = jnp.concatenate(
-        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
     return out.astype(x.dtype)
 
 
@@ -67,19 +76,35 @@ class Transformer:
             return (jax.random.normal(k, shape, jnp.float32)
                     * stddev).astype(pdt)
 
+        # QKV and gate/up projections are FUSED along an unsharded group
+        # axis (one wide MXU matmul instead of 3/2 narrow ones; slicing the
+        # group axis never crosses a shard boundary). MHA fuses q,k,v into
+        # wqkv[..., 3, nh, hd]; GQA keeps wq separate and fuses k,v.
+        layers = {
+            "attn_norm": jnp.ones((l, d), pdt),
+            "wo": norm_init((nh * hd) ** -0.5, keys[4], (l, nh, hd, d)),
+            "mlp_norm": jnp.ones((l, d), pdt),
+            "w_gateup": jnp.stack(
+                [norm_init(d ** -0.5, keys[5], (l, d, f)),
+                 norm_init(d ** -0.5, keys[6], (l, d, f))],
+                axis=2),  # (l, d, 2, f)
+            "w_down": norm_init(f ** -0.5, keys[7], (l, f, d)),
+        }
+        if nkv == nh:
+            layers["wqkv"] = jnp.stack(
+                [norm_init(d ** -0.5, keys[1], (l, d, nh, hd)),
+                 norm_init(d ** -0.5, keys[2], (l, d, nh, hd)),
+                 norm_init(d ** -0.5, keys[3], (l, d, nh, hd))],
+                axis=2)  # (l, d, 3, nh, hd)
+        else:
+            layers["wq"] = norm_init(d ** -0.5, keys[1], (l, d, nh, hd))
+            layers["wkv"] = jnp.stack(
+                [norm_init(d ** -0.5, keys[2], (l, d, nkv, hd)),
+                 norm_init(d ** -0.5, keys[3], (l, d, nkv, hd))],
+                axis=2)  # (l, d, 2, nkv, hd)
         params = {
             "embed": norm_init(0.02, keys[0], (cfg.vocab_size, d)),
-            "layers": {
-                "attn_norm": jnp.ones((l, d), pdt),
-                "wq": norm_init(d ** -0.5, keys[1], (l, d, nh, hd)),
-                "wk": norm_init(d ** -0.5, keys[2], (l, d, nkv, hd)),
-                "wv": norm_init(d ** -0.5, keys[3], (l, d, nkv, hd)),
-                "wo": norm_init((nh * hd) ** -0.5, keys[4], (l, nh, hd, d)),
-                "mlp_norm": jnp.ones((l, d), pdt),
-                "w_gate": norm_init(d ** -0.5, keys[5], (l, d, f)),
-                "w_up": norm_init(d ** -0.5, keys[6], (l, d, f)),
-                "w_down": norm_init(f ** -0.5, keys[7], (l, f, d)),
-            },
+            "layers": layers,
             "final_norm": jnp.ones((d,), pdt),
         }
         if not cfg.tie_embeddings:
@@ -90,19 +115,22 @@ class Transformer:
     @staticmethod
     def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         """Logical sharding spec tree, same structure as init()'s output."""
+        layers = {
+            "attn_norm": ("layers", "norm"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "w_gateup": ("layers", "embed", None, "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
+        if cfg.kv_heads == cfg.n_heads:
+            layers["wqkv"] = ("layers", "embed", None, "heads", "head_dim")
+        else:
+            layers["wq"] = ("layers", "embed", "heads", "head_dim")
+            layers["wkv"] = ("layers", "embed", None, "kv_heads",
+                             "head_dim")
         specs = {
             "embed": ("vocab", "embed"),
-            "layers": {
-                "attn_norm": ("layers", "norm"),
-                "wq": ("layers", "embed", "heads", "head_dim"),
-                "wk": ("layers", "embed", "kv_heads", "head_dim"),
-                "wv": ("layers", "embed", "kv_heads", "head_dim"),
-                "wo": ("layers", "heads", "head_dim", "embed"),
-                "mlp_norm": ("layers", "norm"),
-                "w_gate": ("layers", "embed", "mlp"),
-                "w_up": ("layers", "embed", "mlp"),
-                "w_down": ("layers", "mlp", "embed"),
-            },
+            "layers": layers,
             "final_norm": ("norm",),
         }
         if not cfg.tie_embeddings:
@@ -111,10 +139,13 @@ class Transformer:
 
     # ---- forward ----------------------------------------------------
     @staticmethod
-    def apply(params, tokens, cfg: TransformerConfig, *,
-              mesh=None, rules: Optional[ShardingRules] = None,
-              positions=None):
-        """tokens [B, T] int32 -> logits [B, T, vocab] (compute dtype).
+    def hidden(params, tokens, cfg: TransformerConfig, *,
+               mesh=None, rules: Optional[ShardingRules] = None,
+               positions=None):
+        """tokens [B, T] int32 -> final-norm hidden states [B, T, d]
+        (compute dtype) — apply() stopping before the lm head, so the
+        loss can chunk head+softmax over T (the f32 [B,T,vocab] logits
+        and their grad are the biggest HBM tenant at GPT-2 scale).
 
         When `mesh` is provided and cfg.attention_impl is ring/ulysses, the
         attention op runs inside shard_map over the "seq" axis; everything
@@ -138,14 +169,21 @@ class Transformer:
 
         attn_fn = Transformer._make_attention(cfg, mesh, rules)
         scale = cfg.head_dim ** -0.5
+        cos, sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
         def layer(x, lp):
             h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-            q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cdt))
-            k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(cdt))
-            v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(cdt))
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
+            if cfg.kv_heads == cfg.n_heads:
+                qkv = jnp.einsum("btd,dghk->btghk", h,
+                                 lp["wqkv"].astype(cdt))
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            else:
+                q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cdt))
+                kv = jnp.einsum("btd,dghk->btghk", h,
+                                lp["wkv"].astype(cdt))
+                k, v = kv[:, :, 0], kv[:, :, 1]
+            q = _rope(q, cos, sin)
+            k = _rope(k, cos, sin)
             if cfg.kv_heads != cfg.n_heads:
                 rep = cfg.n_heads // cfg.kv_heads
                 k = jnp.repeat(k, rep, axis=2)
@@ -154,72 +192,107 @@ class Transformer:
             k = constrain(k, ("batch", "seq", "heads", "head_dim"))
             v = constrain(v, ("batch", "seq", "heads", "head_dim"))
             o = attn_fn(q, k, v, scale)
+            # name the (pallas) attention output so the "dots" remat
+            # policy can save it — it isn't a dot, and recomputing the
+            # kernel in bwd costs a full extra attention pass
+            from jax.ad_checkpoint import checkpoint_name
+            o = checkpoint_name(o, "attn_out")
             o = constrain(o, ("batch", "seq", "heads", "head_dim"))
             o = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(cdt))
             x = x + constrain(o, ("batch", "seq", "act_embed"))
 
             h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-            gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(cdt))
-            up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cdt))
-            ff = jax.nn.silu(gate) * up
+            gu = jnp.einsum("btd,dgf->btgf", h, lp["w_gateup"].astype(cdt))
+            ff = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
             ff = constrain(ff, ("batch", "seq", "act_mlp"))
             down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(cdt))
             x = x + constrain(down, ("batch", "seq", "act_embed"))
             return x
 
         if cfg.remat:
-            layer = jax.checkpoint(layer)
+            if cfg.remat_policy == "dots":
+                pol = jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.checkpoint_dots,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "attn_out"))
+                layer = jax.checkpoint(layer, policy=pol)
+            else:
+                layer = jax.checkpoint(layer)
 
         def scan_body(x, lp):
             return layer(x, lp), None
 
-        x, _ = lax.scan(scan_body, x, params["layers"])
+        x, _ = lax.scan(scan_body, x, params["layers"],
+                        unroll=cfg.scan_unroll)
 
-        x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        return _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+
+    @staticmethod
+    def apply(params, tokens, cfg: TransformerConfig, *,
+              mesh=None, rules: Optional[ShardingRules] = None,
+              positions=None):
+        """tokens [B, T] int32 -> logits [B, T, vocab] (f32 accum)."""
+        import jax.numpy as jnp
+
+        rules = rules or ShardingRules()
+        cdt = jnp.dtype(cfg.dtype)
+        x = Transformer.hidden(params, tokens, cfg, mesh=mesh, rules=rules,
+                               positions=positions)
         head = (params["embed"].T if cfg.tie_embeddings
                 else params["lm_head"])
         logits = jnp.einsum("btd,dv->btv", x, head.astype(cdt),
                             preferred_element_type=jnp.float32)
-        return constrain(logits, ("batch", "seq", "act_vocab"))
+        return with_logical_constraint(
+            logits, ("batch", "seq", "act_vocab"), mesh=mesh, rules=rules)
 
     @staticmethod
     def _make_attention(cfg: TransformerConfig, mesh, rules: ShardingRules):
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from ray_tpu.ops.attention import dense_attention
+        from ray_tpu.ops.attention import dense_attention, flash_attention
 
         impl = cfg.attention_impl
-        if impl not in ("dense", "ring", "ulysses"):
+        if impl not in ("auto", "dense", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {impl!r}")
-        if impl == "dense" or mesh is None or mesh.shape.get(AXIS_SEQ, 1) == 1:
-            return lambda q, k, v, scale: dense_attention(
-                q, k, v, causal=True, scale=scale)
+        seq_unsharded = mesh is None or mesh.shape.get(AXIS_SEQ, 1) == 1
+        if impl == "auto":
+            impl = "flash" if seq_unsharded else "ring"
+        if impl == "flash" and not seq_unsharded:
+            raise ValueError("attention_impl='flash' requires an unsharded "
+                             "seq axis; use ring/ulysses for SP")
+        # [B, T, H, D] spec shared by every shard_map path; only the seq
+        # entry differs (sharded for ring/ulysses SP, local for flash).
+        def qkv_spec(seq_entry):
+            return P(rules.mesh_axes("batch"), seq_entry,
+                     rules.mesh_axes("heads"), None)
+
+        def shard_mapped(body, spec, **shard_map_kw):
+            def wrapped(q, k, v, scale):
+                fn = jax.shard_map(
+                    functools.partial(body, scale=scale), mesh=mesh,
+                    in_specs=(spec, spec, spec), out_specs=spec,
+                    **shard_map_kw)
+                return fn(q, k, v)
+            return wrapped
+
+        if impl in ("dense", "flash") or seq_unsharded:
+            local = flash_attention if impl == "flash" else dense_attention
+            body = functools.partial(local, causal=True)
+            if impl == "flash" and mesh is not None:
+                # pallas kernels don't GSPMD-partition; run per-shard under
+                # shard_map with batch/heads sharded as the constraints say.
+                return shard_mapped(body, qkv_spec(None), check_vma=False)
+            return lambda q, k, v, scale: body(q, k, v, scale=scale)
 
         from ray_tpu.parallel.ring import ring_attention
         from ray_tpu.parallel.ulysses import ulysses_attention
 
         # Heads stay sharded over the tensor axis inside the shard_map —
         # SP composes with TP instead of all-gathering Q/K/V heads.
-        batch_axes = rules.mesh_axes("batch")
-        heads_axes = rules.mesh_axes("heads")
-        qkv_spec = P(batch_axes, AXIS_SEQ, heads_axes, None)
-
-        if impl == "ring":
-            body = lambda q, k, v, scale: ring_attention(  # noqa: E731
-                q, k, v, causal=True, scale=scale)
-        else:
-            body = lambda q, k, v, scale: ulysses_attention(  # noqa: E731
-                q, k, v, causal=True, scale=scale)
-
-        def sharded(q, k, v, scale):
-            fn = jax.shard_map(
-                functools.partial(body, scale=scale), mesh=mesh,
-                in_specs=(qkv_spec, qkv_spec, qkv_spec),
-                out_specs=qkv_spec)
-            return fn(q, k, v)
-
-        return sharded
+        sp = ring_attention if impl == "ring" else ulysses_attention
+        return shard_mapped(functools.partial(sp, causal=True),
+                            qkv_spec(AXIS_SEQ))
 
     # ---- loss -------------------------------------------------------
     @staticmethod
@@ -233,15 +306,63 @@ class Transformer:
             tokens, targets = batch["tokens"], batch["targets"]
         else:
             tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-        logits = Transformer.apply(params, tokens, cfg, mesh=mesh,
-                                   rules=rules)
         import jax
-        logits = logits.astype(jnp.float32)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(
-            logits, targets[..., None], axis=-1)[..., 0]
+        from jax import lax
+
         mask = batch.get("mask")
-        nll = logz - gold
-        if mask is not None:
-            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return jnp.mean(nll)
+        b, t = tokens.shape
+        chunk = cfg.loss_chunk
+        if not (chunk and t > chunk and t % chunk == 0):
+            logits = Transformer.apply(params, tokens, cfg, mesh=mesh,
+                                       rules=rules)
+            logits = logits.astype(jnp.float32)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, targets[..., None], axis=-1)[..., 0]
+            nll = logz - gold
+            if mask is not None:
+                return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return jnp.mean(nll)
+
+        # Chunked head + cross-entropy: scan T in loss_chunk slices so only
+        # one [B, chunk, vocab] f32 logits block (and its grad, via
+        # jax.checkpoint recompute) lives in HBM at a time.
+        rules = rules or ShardingRules()
+        x = Transformer.hidden(params, tokens, cfg, mesh=mesh, rules=rules)
+        cdt = x.dtype
+        # contract against embed directly ("vd" orientation) rather than
+        # materializing a [d, vocab] transpose each step
+        tied = cfg.tie_embeddings
+        head = (params["embed"] if tied else params["lm_head"]).astype(cdt)
+        eq = "bcd,vd->bcv" if tied else "bcd,dv->bcv"
+        n = t // chunk
+
+        def chunk_nll(x_c, t_c):
+            logits = jnp.einsum(eq, x_c, head,
+                                preferred_element_type=jnp.float32)
+            logits = with_logical_constraint(
+                logits, ("batch", None, "act_vocab"), mesh=mesh,
+                rules=rules)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, t_c[..., None], axis=-1)[..., 0]
+            return logz - gold  # [b, chunk] f32
+
+        chunk_nll = jax.checkpoint(chunk_nll)
+        xs = jnp.swapaxes(x.reshape(b, n, chunk, x.shape[-1]), 0, 1)
+        ts = jnp.swapaxes(targets.reshape(b, n, chunk), 0, 1)
+        if mask is None:
+            def body(tot, xt):
+                return tot + jnp.sum(chunk_nll(*xt)), None
+            total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts),
+                                unroll=cfg.scan_unroll > 1)
+            return total / (b * t)
+        ms = jnp.swapaxes(
+            mask.reshape(b, n, chunk), 0, 1).astype(jnp.float32)
+
+        def body_m(tot, xtm):
+            x_c, t_c, m_c = xtm
+            return tot + jnp.sum(chunk_nll(x_c, t_c) * m_c), None
+        total, _ = lax.scan(body_m, jnp.zeros((), jnp.float32),
+                            (xs, ts, ms), unroll=cfg.scan_unroll > 1)
+        return total / jnp.maximum(jnp.sum(mask), 1.0)

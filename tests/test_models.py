@@ -86,16 +86,16 @@ class TestTrainStep:
             lambda p, b: Transformer.loss(p, b, cfg, mesh=mesh),
             Transformer.param_specs(cfg), mesh, optimizer=optax.adam(1e-2))
         state = init_state(tiny_params)
-        wg = state["params"]["layers"]["w_gate"]  # (L, d, ff): embed->fsdp,
-        spec = wg.sharding.spec                   # mlp->tensor
+        wg = state["params"]["layers"]["w_gateup"]  # (L, d, 2, ff):
+        spec = wg.sharding.spec                     # embed->fsdp, mlp->tensor
         assert "fsdp" in str(spec) and "tensor" in str(spec)
         # adam momenta shard identically to their params (ZeRO-for-free)
-        mu = state["opt_state"][0].mu["layers"]["w_gate"]
+        mu = state["opt_state"][0].mu["layers"]["w_gateup"]
         assert mu.sharding == wg.sharding
 
     def test_opt_sharding_with_shape_collision(self):
-        """d_ff == d_model: w_gate (d,f) and w_down (f,d) share a shape;
-        momenta must still shard by tree path, not by shape."""
+        """d_ff == d_model: shapes can collide across params; momenta must
+        still shard by tree path, not by shape."""
         import optax
         cfg = TINY.replace(dtype="float32", d_ff=TINY.d_model)
         mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
@@ -104,7 +104,7 @@ class TestTrainStep:
             lambda p, b: Transformer.loss(p, b, cfg, mesh=mesh),
             Transformer.param_specs(cfg), mesh, optimizer=optax.adam(1e-2))
         state = init_state(params)
-        for name in ("w_gate", "w_down", "wq", "embed"):
+        for name in ("w_gateup", "w_down", "wq", "embed"):
             tree = state["params"] if name == "embed" \
                 else state["params"]["layers"]
             mtree = state["opt_state"][0].mu if name == "embed" \
