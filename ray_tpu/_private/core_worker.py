@@ -43,6 +43,7 @@ class _TaskEntry:
     retries_left: int
     return_ids: List[ObjectID]
     lease_node: Optional[Tuple[str, int]] = None
+    node_id_hex: Optional[str] = None  # node the lease was granted on
     done: bool = False
 
 
@@ -123,6 +124,15 @@ class CoreWorker:
             handlers["w_cancel_task"] = self.executor.cancel_task
         self.server = rpc_lib.RpcServer(handlers, host=host)
         self.address = self.server.address
+        # Owner-side node-failure detection (reference: the raylet notifies
+        # owners via the object directory / lease failures; here the GCS
+        # node channel is the death signal). Without it, tasks in flight
+        # on a SIGKILLed node would hang their owner forever.
+        try:
+            self.subscribe("node", self._on_node_event)
+        except Exception:  # noqa: BLE001
+            logger.warning("could not subscribe to node events",
+                           exc_info=True)
 
     # ------------------------------------------------------------------
     # Context
@@ -420,7 +430,7 @@ class CoreWorker:
         direct_task_transport.cc:349,505)."""
         if nm is None:
             nm = self._nm
-        for _ in range(16):
+        for attempt in range(16):
             with self._lock:
                 entry = self.tasks.get(spec.task_id.hex())
                 if entry is not None:
@@ -429,7 +439,8 @@ class CoreWorker:
                     entry.lease_node = nm.address
             try:
                 kind, payload = nm.call("nm_request_lease", spec=spec,
-                                        reply_to=self.address)
+                                        reply_to=self.address,
+                                        spill_count=attempt)
             except Exception as e:  # noqa: BLE001
                 self._fail_task(spec.task_id.hex(), "SCHEDULING_FAILED",
                                 f"lease request failed: {e}", retry=True)
@@ -451,8 +462,10 @@ class CoreWorker:
                           ) -> None:
         with self._lock:
             entry = self.tasks.get(task_id.hex())
-            if entry is not None and nm_address is not None:
-                entry.lease_node = tuple(nm_address)
+            if entry is not None:
+                entry.node_id_hex = node_id
+                if nm_address is not None:
+                    entry.lease_node = tuple(nm_address)
         if entry is None or entry.done:
             self._return_lease(lease_id, entry, nm_address=nm_address)
             return
@@ -745,6 +758,30 @@ class CoreWorker:
                     self._maybe_free_locked(oid_hex)
             else:
                 self.arg_pins[oid_hex] = n
+
+    def _on_node_event(self, message: Any) -> None:
+        """GCS "node" channel: fail (and retry) in-flight normal tasks
+        whose lease lives on a node that just died — both tasks granted to
+        workers there (node_id match) and tasks still queued at its node
+        manager (lease_node match). Actor tasks resolve through the GCS
+        actor-restart path instead."""
+        try:
+            event, info = message
+        except Exception:  # noqa: BLE001
+            return
+        if event != "DEAD":
+            return
+        dead_hex = info.node_id.hex()
+        dead_nm = tuple(info.address) if info.address else None
+        with self._lock:
+            lost = [e for e in self.tasks.values()
+                    if not e.done and e.spec.actor_id is None
+                    and (e.node_id_hex == dead_hex
+                         or (e.lease_node is not None
+                             and e.lease_node == dead_nm))]
+        for e in lost:
+            self._fail_task(e.spec.task_id.hex(), "WORKER_DIED",
+                            f"node {dead_hex[:12]} died", retry=True)
 
     def _on_pubsub_push(self, channel: str, token: str, message: Any) -> None:
         cb = self._subscriptions.get((channel, token))

@@ -27,7 +27,8 @@ from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import StoreServer
 from ray_tpu._private.scheduler import pick_node
-from ray_tpu._private.state import (NodeInfo, PlacementGroupSchedulingStrategy,
+from ray_tpu._private.state import (NodeAffinitySchedulingStrategy, NodeInfo,
+                                    PlacementGroupSchedulingStrategy,
                                     ResourceSet, TaskSpec, TaskType)
 
 logger = logging.getLogger(__name__)
@@ -168,7 +169,7 @@ class NodeManager:
             try:
                 self._respill_pending()
             except Exception:  # noqa: BLE001
-                pass
+                logger.warning("respill round failed", exc_info=True)
             time.sleep(Config.resource_report_period_s)
 
     def _respill_pending(self) -> None:
@@ -191,6 +192,9 @@ class NodeManager:
             chosen = pick_node(avail, required, strategy,
                                local_node_id=self.node_id.hex(),
                                totals=totals)
+            logger.debug("respill: %s required=%s chosen=%s",
+                         pl.spec.function_name, required.to_dict(),
+                         chosen and chosen[:12])
             if chosen is None or chosen == self.node_id.hex() \
                     or chosen not in nodes:
                 continue
@@ -357,11 +361,17 @@ class NodeManager:
 
     # ---- leases (reference lease protocol, node_manager.proto:361) ------
 
+    # After this many redirects a lease request must settle somewhere: a
+    # stale resource view can otherwise ping-pong a request between busy
+    # node managers indefinitely (the reference caps spillbacks via the
+    # lease client's budget + queueing at the selected raylet).
+    LEASE_SPILL_BUDGET = 4
+
     def request_lease(self, spec: TaskSpec,
-                      reply_to: Tuple[str, int]) -> Tuple[str, Any]:
+                      reply_to: Tuple[str, int],
+                      spill_count: int = 0) -> Tuple[str, Any]:
         """Returns ("spill", node_mgr_addr) | ("queued", lease_id) |
         ("infeasible", message)."""
-        from ray_tpu._private.state import NodeAffinitySchedulingStrategy
         required = self._effective_resources(spec)
         strategy = spec.scheduling_strategy
         if isinstance(strategy, NodeAffinitySchedulingStrategy) \
@@ -382,8 +392,23 @@ class NodeManager:
         if isinstance(strategy, NodeAffinitySchedulingStrategy) \
                 and not strategy.soft:
             chosen = self.node_id.hex()  # queue here (we are the target)
-        if chosen is not None and chosen != self.node_id.hex():
+        if chosen is not None and chosen != self.node_id.hex() \
+                and spill_count < self.LEASE_SPILL_BUDGET:
             return ("spill", nodes[chosen])
+        if chosen is None or chosen != self.node_id.hex():
+            # Nothing available right now (or out of redirect budget):
+            # queue at a node whose TOTAL resources can ever run the task.
+            if not required.is_subset_of(self.resources_total):
+                for nid in sorted(totals):
+                    if nid != self.node_id.hex() and nodes.get(nid) and \
+                            required.is_subset_of(ResourceSet(totals[nid])):
+                        return ("spill", nodes[nid])
+                # Cluster-wide infeasible: stay pending here like the
+                # reference (resources may yet appear, e.g. autoscaling);
+                # the owner's get() timeout is the backstop.
+        logger.debug("request_lease: %s queued locally (chosen=%s "
+                     "spill_count=%d)", spec.function_name,
+                     chosen and chosen[:12], spill_count)
         lease_id = uuid.uuid4().hex
         pl = _PendingLease(lease_id=lease_id, spec=spec,
                            reply_to=tuple(reply_to))

@@ -40,5 +40,8 @@ def ray_session():
 
 @pytest.fixture()
 def ray_start(ray_session):
-    """Per-test alias; the session cluster is reused."""
+    """Per-test alias; the session cluster is reused (re-initialized if a
+    multinode/cluster test shut the previous one down)."""
+    if not ray_session.is_initialized():
+        ray_session.init(num_cpus=4, ignore_reinit_error=True)
     return ray_session
