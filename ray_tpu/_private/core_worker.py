@@ -28,7 +28,7 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID)
 from ray_tpu._private.object_ref import ObjectRef
-from ray_tpu._private.object_store import StoreClient
+from ray_tpu._private.object_store import ObjectStoreFullError, StoreClient
 from ray_tpu._private.state import TaskSpec, TaskType
 
 logger = logging.getLogger(__name__)
@@ -90,10 +90,18 @@ class CoreWorker:
         # Owner-side object directory: oid hex -> (tag, ...) location
         self.objects: Dict[str, Tuple] = {}
         self.object_events: Dict[str, threading.Event] = {}
-        # Reference counting (reference reference_count.h, simplified to
-        # local refs + submitted-task arg pins; borrower chains TODO).
+        # Reference counting (reference reference_count.h): local refs,
+        # submitted-task arg pins, and borrower registration — a process
+        # holding a ref it doesn't own registers a pin with the owner
+        # (cw_add_ref) on first local ref and releases it (cw_remove_ref)
+        # when its last local ref drops, so the object outlives the owner's
+        # own release while borrowed.
         self.local_refs: Dict[str, int] = {}
         self.arg_pins: Dict[str, int] = {}
+        self.borrowed: Dict[str, Tuple[str, int]] = {}  # oid hex -> owner addr
+        # One long-lived drainer for borrow releases instead of a thread
+        # per dropped ref (releases are fire-and-forget, order irrelevant).
+        self._borrow_release_queue: "queue.Queue" = queue.Queue()
         self.tasks: Dict[str, _TaskEntry] = {}
         self.actors: Dict[str, _ActorState] = {}
         self._put_index = 0
@@ -101,6 +109,8 @@ class CoreWorker:
         self._subscriptions: Dict[Tuple[str, str], Any] = {}
         self._tls = threading.local()
         self._shutdown = False
+        threading.Thread(target=self._borrow_release_loop, daemon=True,
+                         name="borrow-release").start()
 
         # Driver's root "task" context for put ids
         self._root_task_id = TaskID.of(job_id)
@@ -111,6 +121,7 @@ class CoreWorker:
             "cw_task_done": self._on_task_done,
             "cw_task_failed": self._on_task_failed,
             "cw_get_object": self._on_get_object,
+            "cw_recover_object": self._on_recover_object,
             "cw_add_ref": self._on_add_ref,
             "cw_remove_ref": self._on_remove_ref,
             "cw_pubsub_push": self._on_pubsub_push,
@@ -130,8 +141,13 @@ class CoreWorker:
         # on a SIGKILLed node would hang their owner forever.
         try:
             self.subscribe("node", self._on_node_event)
+            # Actor channel: fail in-flight calls when an actor dies
+            # (reference: direct_actor_task_submitter DisconnectActor via
+            # the GCS actor pubsub). Without it a caller blocked in get()
+            # on a call pushed to a crashed actor hangs forever.
+            self.subscribe("actor", self._on_actor_event)
         except Exception:  # noqa: BLE001
-            logger.warning("could not subscribe to node events",
+            logger.warning("could not subscribe to GCS events",
                            exc_info=True)
 
     # ------------------------------------------------------------------
@@ -154,12 +170,32 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def add_local_ref(self, ref: ObjectRef) -> None:
+        h = ref.hex()
+        register_borrow = False
         with self._lock:
-            self.local_refs[ref.hex()] = self.local_refs.get(ref.hex(), 0) + 1
+            n = self.local_refs.get(h, 0) + 1
+            self.local_refs[h] = n
+            if n == 1 and not self._is_own(ref) and h not in self.borrowed:
+                self.borrowed[h] = tuple(ref.owner_address)
+                register_borrow = True
+        if register_borrow:
+            # Synchronous so the borrower pin lands before the task that
+            # carried this ref completes (its completion releases the
+            # sender's in-flight arg pin at the same owner).
+            try:
+                self._pool.get(tuple(ref.owner_address)).call(
+                    "cw_add_ref", oid_hex=h)
+            except Exception:  # noqa: BLE001 - owner gone; get() will surface
+                # Roll back the borrow record: without a registered pin, a
+                # later cw_remove_ref would decrement a pin some OTHER
+                # borrower legitimately holds.
+                with self._lock:
+                    self.borrowed.pop(h, None)
 
     def remove_local_ref(self, ref: ObjectRef) -> None:
         if self._shutdown:
             return
+        release_borrow = None
         with self._lock:
             h = ref.hex()
             n = self.local_refs.get(h, 0) - 1
@@ -167,9 +203,13 @@ class CoreWorker:
                 self.local_refs[h] = n
                 return
             self.local_refs.pop(h, None)
-            if self.arg_pins.get(h, 0) > 0:
-                return
-            self._maybe_free_locked(h)
+            release_borrow = self.borrowed.pop(h, None)
+            if release_borrow is None:
+                if self.arg_pins.get(h, 0) > 0:
+                    return
+                self._maybe_free_locked(h)
+        if release_borrow is not None:
+            self._borrow_release_queue.put((release_borrow, h))
 
     def _maybe_free_locked(self, oid_hex: str) -> None:
         loc = self.objects.get(oid_hex)
@@ -181,6 +221,18 @@ class CoreWorker:
             except Exception:  # noqa: BLE001
                 pass
         self.objects[oid_hex] = (FREED,)
+
+    def _borrow_release_loop(self) -> None:
+        while not self._shutdown:
+            item = self._borrow_release_queue.get()
+            if item is None:
+                return
+            owner_addr, oid_hex = item
+            try:
+                self._pool.get(owner_addr).call("cw_remove_ref",
+                                                oid_hex=oid_hex)
+            except Exception:  # noqa: BLE001 - owner gone; nothing to free
+                pass
 
     def _pin_args(self, refs: List[ObjectID]) -> None:
         with self._lock:
@@ -279,8 +331,58 @@ class CoreWorker:
         assert ref.owner_address is not None
         return self._pool.get(ref.owner_address)
 
+    def _recover_object(self, oid_hex: str) -> bool:
+        """Lineage reconstruction: re-execute the task that created a lost
+        object (reference object_recovery_manager.cc:22 RecoverObject →
+        task_manager.cc:255 ResubmitTask). Returns True if recovery is in
+        flight (or the object is already being recomputed)."""
+        oid = ObjectID(bytes.fromhex(oid_hex))
+        if oid.is_put():
+            return False  # puts have no lineage; their data is gone
+        # Verify actual loss first: a borrower's transient pull failure must
+        # not trigger a duplicate re-execution over a live primary copy.
+        with self._lock:
+            loc = self.objects.get(oid_hex)
+        if loc is not None and loc[0] == STORE:
+            try:
+                if self._pool.get(tuple(loc[1])).call(
+                        "store_contains", object_id=oid_hex):
+                    return True  # primary alive; caller should retry its pull
+            except Exception:  # noqa: BLE001 - store/node really gone
+                pass
+        with self._lock:
+            entry = self.tasks.get(oid.task_id().hex())
+            if entry is None or entry.spec.task_type != TaskType.NORMAL_TASK:
+                return False  # actor tasks aren't safely replayable
+            loc = self.objects.get(oid_hex)
+            if loc is not None and loc[0] == PENDING:
+                return True  # already recomputing
+            if loc is not None and loc[0] in (FREED, ERROR):
+                return False
+            if not entry.done:
+                return True  # original execution still in flight
+            entry.done = False
+            for rid in entry.return_ids:
+                rh = rid.hex()
+                if self.objects.get(rh, (PENDING,))[0] not in (FREED, INLINE,
+                                                              ERROR):
+                    self.objects[rh] = (PENDING,)
+                    self.object_events.setdefault(rh, threading.Event()).clear()
+        logger.info("recovering object %s by resubmitting task %s",
+                    oid_hex[:16], entry.spec.function_name)
+        # Re-pin args for the re-execution; if an arg object was itself
+        # evicted, the executing worker's get() triggers recursive recovery.
+        self._pin_args(entry.spec.arg_object_refs)
+        threading.Thread(target=self._request_lease, args=(entry.spec,),
+                         daemon=True, name="lineage-recover").start()
+        return True
+
+    def _on_recover_object(self, oid_hex: str) -> bool:
+        return self._recover_object(oid_hex)
+
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         h = ref.hex()
+        recover_attempts = 0
         while True:
             with self._lock:
                 loc = self.objects.get(h)
@@ -317,7 +419,32 @@ class CoreWorker:
                     continue
                 with self._lock:
                     self.objects.setdefault(h, loc)
-            return self._materialize(h, loc)
+            try:
+                return self._materialize(h, loc)
+            except exc.ObjectFreedError:
+                raise
+            except exc.ObjectLostError:
+                # Lost from the store (evicted / node died): try lineage
+                # reconstruction, then loop back and wait for the new value.
+                recover_attempts += 1
+                if recover_attempts > 3:
+                    raise
+                if self._is_own(ref):
+                    if not self._recover_object(h):
+                        raise
+                else:
+                    with self._lock:
+                        self.objects.pop(h, None)  # drop stale cached loc
+                    try:
+                        ok = self._owner_client(ref).call(
+                            "cw_recover_object", oid_hex=h)
+                    except Exception:  # noqa: BLE001
+                        raise exc.OwnerDiedError(
+                            f"owner {ref.owner_address} of {h[:16]} "
+                            "unreachable during recovery") from None
+                    if not ok:
+                        raise
+                time.sleep(0.01)
 
     def _materialize(self, oid_hex: str, loc: Tuple) -> Any:
         tag = loc[0]
@@ -326,11 +453,21 @@ class CoreWorker:
         if tag == STORE:
             _, store_addr, size = loc
             store_addr = tuple(store_addr)
-            if store_addr == self.store.address:
-                bufs = self.store.get([oid_hex], timeout=60)
-            else:
-                self.store.pull(oid_hex, store_addr, size)
-                bufs = self.store.get([oid_hex], timeout=60)
+            try:
+                if store_addr == self.store.address:
+                    # Own/local objects are sealed before their location is
+                    # recorded; a short wait distinguishes a momentary race
+                    # from real loss (which lineage recovery then handles).
+                    bufs = self.store.get([oid_hex], timeout=5)
+                else:
+                    self.store.pull(oid_hex, store_addr, size)
+                    bufs = self.store.get([oid_hex], timeout=60)
+            except ObjectStoreFullError:
+                raise
+            except Exception as e:  # noqa: BLE001 - peer store refused/died
+                raise exc.ObjectLostError(
+                    f"object {oid_hex[:16]} unavailable from store "
+                    f"{store_addr}: {e}") from None
             if oid_hex not in bufs:
                 raise exc.ObjectLostError(f"object {oid_hex[:16]} lost in store")
             return ser.unpack(bufs[oid_hex])
@@ -783,6 +920,27 @@ class CoreWorker:
             self._fail_task(e.spec.task_id.hex(), "WORKER_DIED",
                             f"node {dead_hex[:12]} died", retry=True)
 
+    def _on_actor_event(self, message: Any) -> None:
+        try:
+            event, info = message
+        except Exception:  # noqa: BLE001
+            return
+        with self._lock:
+            state = self.actors.get(info.actor_id.hex())
+        if state is None or state.dead:
+            return  # not an actor we hold a handle to
+        if event == "DEAD":
+            self._mark_actor_dead(info.actor_id, info.death_cause)
+        elif event == "RESTARTING":
+            with self._lock:
+                state.address = None
+                need = not state.resolving
+                state.resolving = True
+            if need:
+                threading.Thread(target=self._resolve_actor,
+                                 args=(info.actor_id,), daemon=True,
+                                 name="actor-rebind").start()
+
     def _on_pubsub_push(self, channel: str, token: str, message: Any) -> None:
         cb = self._subscriptions.get((channel, token))
         if cb is not None:
@@ -806,6 +964,7 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        self._borrow_release_queue.put(None)
         self.server.stop()
         self.store.close()
         self._pool.close_all()

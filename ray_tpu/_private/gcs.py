@@ -297,13 +297,16 @@ class GcsServer:
             can_restart = (restart and
                            (info.max_restarts == -1
                             or info.num_restarts < info.max_restarts))
+            # Always record the latest death reason — even when restarting —
+            # so a later terminal DEAD (e.g. restart-scheduling failure with
+            # a vague reason) still surfaces what originally killed the actor.
+            info.death_cause = reason or info.death_cause or "(unknown cause)"
             if can_restart:
                 info.state = "RESTARTING"
                 info.num_restarts += 1
                 info.address = None
             else:
                 info.state = "DEAD"
-                info.death_cause = reason
                 info.address = None
         if can_restart:
             logger.warning("GCS: restarting actor %s (%d/%s): %s",

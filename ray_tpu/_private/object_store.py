@@ -19,7 +19,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import rpc as rpc_lib
 
@@ -38,17 +38,31 @@ class _Entry:
     pinned: int = 0          # pin count (owner pins while referenced)
     last_access: float = field(default_factory=time.time)
     creating: bool = True
+    spilled: bool = False    # payload lives in the disk spill dir, not shm
 
 
 class StoreServer:
     """Metadata + lifecycle authority for one node's shared-memory objects."""
 
     def __init__(self, session_dir: str, capacity_bytes: int,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 spill_dir: Optional[str] = None):
         self.dir = os.path.join(session_dir, "objects")
         os.makedirs(self.dir, exist_ok=True)
+        # Spill target must be real disk, not /dev/shm (spilling to RAM
+        # frees nothing) — reference local_object_manager.cc:161-334 spills
+        # to external storage via _private/external_storage.py.
+        if spill_dir is None:
+            import tempfile
+            spill_dir = os.path.join(
+                tempfile.gettempdir(),
+                "ray_tpu_spill_" + os.path.basename(session_dir.rstrip("/")))
+        self.spill_dir = spill_dir
+        os.makedirs(self.spill_dir, exist_ok=True)
         self.capacity = capacity_bytes
         self.used = 0
+        self.num_spilled = 0
+        self.num_restored = 0
         self._objects: Dict[str, _Entry] = {}
         self._lock = threading.Lock()
         self._sealed_cv = threading.Condition(self._lock)
@@ -65,32 +79,92 @@ class StoreServer:
             "store_pull": self.pull,
             "store_put_raw": self.put_raw,
             "store_stats": self.stats,
+            "store_list": self.list_objects,
         }, host=host)
         self.address = self.server.address
 
     # -- lifecycle ---------------------------------------------------------
 
     def _evict_until(self, needed: int) -> None:
-        """LRU-evict sealed, unpinned objects (reference eviction_policy.h)."""
+        """Free shm space: LRU-drop unpinned replicas first (reference
+        eviction_policy.h), then LRU-spill pinned primaries to disk
+        (reference local_object_manager.cc:161-334 SpillObjects)."""
         if self.used + needed <= self.capacity:
             return
         victims = sorted(
             ((e.last_access, oid) for oid, e in self._objects.items()
-             if e.sealed and e.pinned == 0),
+             if e.sealed and e.pinned == 0 and not e.spilled),
             key=lambda t: t[0])
         for _, oid in victims:
             if self.used + needed <= self.capacity:
                 return
             self._delete_locked(oid)
+        # Still short: spill pinned, sealed primaries to disk. Their data
+        # survives and restores on next access; only shm space is released.
+        spillable = sorted(
+            ((e.last_access, oid) for oid, e in self._objects.items()
+             if e.sealed and not e.spilled),
+            key=lambda t: t[0])
+        for _, oid in spillable:
+            if self.used + needed <= self.capacity:
+                return
+            self._spill_locked(oid)
         if self.used + needed > self.capacity:
             raise ObjectStoreFullError(
                 f"object store full: need {needed}, used {self.used}/{self.capacity}")
+
+    def _spill_locked(self, object_id: str) -> None:
+        e = self._objects.get(object_id)
+        if e is None or not e.sealed or e.spilled:
+            return
+        spill_path = os.path.join(self.spill_dir, object_id)
+        # Copy (not rename): spill dir is on a different filesystem than shm.
+        with open(e.path, "rb") as src, open(spill_path, "wb") as dst:
+            while True:
+                chunk = src.read(CHUNK_SIZE)
+                if not chunk:
+                    break
+                dst.write(chunk)
+        try:
+            os.unlink(e.path)
+        except OSError:
+            pass
+        e.path = spill_path
+        e.spilled = True
+        self.used -= e.size
+        self.num_spilled += 1
+
+    def _restore_locked(self, object_id: str) -> None:
+        """Bring a spilled object back into shm (reference
+        RestoreSpilledObject)."""
+        e = self._objects.get(object_id)
+        if e is None or not e.spilled:
+            return
+        self._evict_until(e.size)
+        shm_path = os.path.join(self.dir, object_id)
+        spill_path = e.path
+        with open(spill_path, "rb") as src, open(shm_path, "wb") as dst:
+            while True:
+                chunk = src.read(CHUNK_SIZE)
+                if not chunk:
+                    break
+                dst.write(chunk)
+        try:
+            os.unlink(spill_path)
+        except OSError:
+            pass
+        e.path = shm_path
+        e.spilled = False
+        e.last_access = time.time()
+        self.used += e.size
+        self.num_restored += 1
 
     def _delete_locked(self, object_id: str) -> None:
         e = self._objects.pop(object_id, None)
         if e is None:
             return
-        self.used -= e.size
+        if not e.spilled:
+            self.used -= e.size
         try:
             os.unlink(e.path)
         except OSError:
@@ -107,7 +181,13 @@ class StoreServer:
         with self._lock:
             if object_id in self._objects:
                 e = self._objects[object_id]
-                return e.path
+                if e.size == size and not e.spilled:
+                    return e.path
+                # Same id re-created with a different payload size (lineage
+                # re-execution of a nondeterministic task) or a spilled
+                # entry being rewritten: replace the backing file — mmap'ing
+                # a larger size over the old file would SIGBUS past EOF.
+                self._delete_locked(object_id)
             self._evict_until(size)
             path = os.path.join(self.dir, object_id)
             with open(path, "wb") as f:
@@ -146,6 +226,8 @@ class StoreServer:
                 for oid in object_ids:
                     e = self._objects.get(oid)
                     if e is not None and e.sealed:
+                        if e.spilled:
+                            self._restore_locked(oid)
                         e.last_access = time.time()
                         ready[oid] = (e.path, e.size)
                 if len(ready) >= num_required:
@@ -212,16 +294,27 @@ class StoreServer:
         self.seal(object_id)
         return path, size
 
+    def list_objects(self) -> List[Dict[str, Any]]:
+        """Object-level metadata for the state API (`ray list objects`)."""
+        with self._lock:
+            return [{"object_id": oid, "size": e.size, "sealed": e.sealed,
+                     "pinned": e.pinned, "spilled": e.spilled}
+                    for oid, e in self._objects.items()]
+
     def stats(self) -> Dict[str, float]:
         with self._lock:
             return {"used": self.used, "capacity": self.capacity,
-                    "num_objects": len(self._objects)}
+                    "num_objects": len(self._objects),
+                    "num_spilled": self.num_spilled,
+                    "num_restored": self.num_restored}
 
     def shutdown(self) -> None:
         self.server.stop()
         with self._lock:
             for oid in list(self._objects):
                 self._delete_locked(oid)
+        import shutil as _shutil
+        _shutil.rmtree(self.spill_dir, ignore_errors=True)
 
 
 class StoreClient:
@@ -230,7 +323,11 @@ class StoreClient:
     def __init__(self, store_address: Tuple[str, int]):
         self.address = tuple(store_address)
         self._rpc = rpc_lib.RpcClient(self.address, timeout=None)
-        self._maps: Dict[str, Tuple[mmap.mmap, memoryview]] = {}
+        # object id -> (mmap, view, inode). The inode detects a deleted-and-
+        # recreated object id (e.g. lineage re-execution after eviction):
+        # the cached map then points at the dead unlinked inode and must be
+        # replaced, or writes/reads silently hit stale data.
+        self._maps: Dict[str, Tuple[mmap.mmap, memoryview, int]] = {}
         self._lock = threading.Lock()
 
     def create(self, object_id: str, size: int) -> memoryview:
@@ -240,9 +337,12 @@ class StoreClient:
     def _map(self, object_id: str, path: str, size: int,
              writable: bool = False) -> memoryview:
         with self._lock:
+            inode = os.stat(path).st_ino
             cached = self._maps.get(object_id)
             if cached is not None:
-                return cached[1]
+                if cached[2] == inode:
+                    return cached[1]
+                self._release_locked(object_id)
             fd = os.open(path, os.O_RDWR if writable else os.O_RDONLY)
             try:
                 mm = mmap.mmap(fd, max(size, 1),
@@ -251,7 +351,7 @@ class StoreClient:
             finally:
                 os.close(fd)
             view = memoryview(mm)[:size]
-            self._maps[object_id] = (mm, view)
+            self._maps[object_id] = (mm, view, inode)
             return view
 
     def seal(self, object_id: str) -> None:
@@ -285,16 +385,19 @@ class StoreClient:
         self._release(object_ids)
         self._rpc.call("store_delete", object_ids=object_ids)
 
+    def _release_locked(self, oid: str) -> None:
+        m = self._maps.pop(oid, None)
+        if m is not None:
+            try:
+                m[1].release()
+                m[0].close()
+            except (BufferError, ValueError):
+                pass  # a live numpy view still references the map
+
     def _release(self, object_ids: List[str]) -> None:
         with self._lock:
             for oid in object_ids:
-                m = self._maps.pop(oid, None)
-                if m is not None:
-                    try:
-                        m[1].release()
-                        m[0].close()
-                    except (BufferError, ValueError):
-                        pass  # a live numpy view still references the map
+                self._release_locked(oid)
 
     def stats(self) -> Dict[str, float]:
         return self._rpc.call("store_stats")

@@ -66,8 +66,16 @@ class _Handler(socketserver.BaseRequestHandler):
                     try:
                         result = handler(**kwargs)
                         reply = ("ok", result)
-                    except Exception:  # noqa: BLE001 - ship traceback to caller
-                        reply = ("err", traceback.format_exc())
+                    except Exception as e:  # noqa: BLE001 - ship to caller
+                        # Typed propagation: the client re-raises the real
+                        # exception class (e.g. ObjectStoreFullError from a
+                        # store handler) so callers can catch specifically;
+                        # the traceback string rides along for diagnostics.
+                        try:
+                            blob = pickle.dumps(e, protocol=5)
+                        except Exception:  # noqa: BLE001 - unpicklable exc
+                            blob = None
+                        reply = ("err", (blob, traceback.format_exc()))
                 _send_frame(sock, pickle.dumps(reply, protocol=5))
         except (ConnectionLost, ConnectionResetError, BrokenPipeError, OSError):
             return
@@ -146,6 +154,17 @@ class RpcClient:
                             f"rpc to {self.address} failed: {method}")
         status, result = pickle.loads(reply)
         if status != "ok":
+            if isinstance(result, tuple) and len(result) == 2:
+                blob, tb = result
+                if blob is not None:
+                    try:
+                        remote_exc = pickle.loads(blob)
+                    except Exception:  # noqa: BLE001
+                        remote_exc = None
+                    if remote_exc is not None:
+                        raise remote_exc from RpcError(
+                            f"remote error from {self.address}.{method}:\n{tb}")
+                result = tb
             raise RpcError(f"remote error from {self.address}.{method}:\n{result}")
         return result
 

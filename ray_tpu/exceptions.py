@@ -18,6 +18,12 @@ class RayTaskError(RayError):
         super().__init__(
             f"task {function_name} failed:\n{traceback_str or cause}")
 
+    def __reduce__(self):
+        # See RayActorError.__reduce__: rebuild from the real fields, not
+        # the formatted message, so the message doesn't re-nest per hop.
+        return (RayTaskError, (self.function_name, self.traceback_str,
+                               self.cause))
+
     def as_instanceof_cause(self) -> BaseException:
         """Best effort: raise something isinstance-compatible with the
         original exception (reference RayTaskError.as_instanceof_cause)."""
@@ -49,7 +55,14 @@ class RayActorError(RayError):
 
     def __init__(self, actor_id: str = "", cause: str = ""):
         self.actor_id = actor_id
-        super().__init__(f"actor {actor_id[:12]} died: {cause}")
+        self.cause = cause or "(death cause unknown)"
+        super().__init__(f"actor {actor_id[:12]} died: {self.cause}")
+
+    def __reduce__(self):
+        # Default Exception pickling reconstructs from self.args (the
+        # formatted message), which would shift into actor_id and blank the
+        # cause on every serialization hop. Preserve the real fields.
+        return (type(self), (self.actor_id, self.cause))
 
 
 class ActorDiedError(RayActorError):

@@ -1,0 +1,153 @@
+"""Object-plane depth: spilling, lineage reconstruction, borrower refs.
+
+reference parity for the behaviors under test:
+- spilling: src/ray/raylet/local_object_manager.cc:161-334 (spill/restore)
+- lineage recovery: src/ray/core_worker/object_recovery_manager.cc:22 +
+  task_manager.cc:255 (resubmit on object loss)
+- borrowing: src/ray/core_worker/reference_count.h:61 (borrower pins keep
+  an object alive past the owner's local release)
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+PAYLOAD = 512 * 1024  # > max_inline_object_size → lands in the shm store
+
+
+def test_spill_and_restore(tmp_path):
+    """Puts exceeding store capacity spill to disk and restore on get."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, object_store_memory=3 * PAYLOAD)
+    try:
+        w = ray_tpu._private.worker.global_worker()
+        refs = [ray_tpu.put(np.full(PAYLOAD // 8, i, dtype=np.float64))
+                for i in range(8)]  # 8 × 512KiB into a 1.5MiB store
+        stats = w.core_worker.store.stats()
+        assert stats["num_spilled"] > 0, "expected spills over capacity"
+        for i, ref in enumerate(refs):
+            val = ray_tpu.get(ref)
+            assert float(val[0]) == float(i)
+        stats = w.core_worker.store.stats()
+        assert stats["num_restored"] > 0, "expected restores on get"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_evict_then_get_reconstructs_via_lineage(ray_start):
+    """Force-losing a task's return object re-executes the task."""
+    counter_file = os.path.join(tempfile.gettempdir(),
+                                f"lineage_count_{os.getpid()}")
+    if os.path.exists(counter_file):
+        os.unlink(counter_file)
+
+    @ray_tpu.remote
+    def produce(path):
+        with open(path, "a") as f:
+            f.write("x")
+        return np.arange(PAYLOAD // 8, dtype=np.float64)
+
+    ref = produce.remote(counter_file)
+    first = ray_tpu.get(ref)
+    assert first.shape == (PAYLOAD // 8,)
+    assert os.path.getsize(counter_file) == 1
+
+    # Simulate loss: delete the primary copy from the node's store.
+    w = ray_tpu._private.worker.global_worker()
+    w.core_worker.store.delete([ref.hex()])
+
+    again = ray_tpu.get(ref)  # must reconstruct through lineage
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(again))
+    assert os.path.getsize(counter_file) == 2, "task should have re-executed"
+    os.unlink(counter_file)
+
+
+def test_put_object_not_recoverable(ray_start):
+    """ray.put objects have no lineage; loss surfaces ObjectLostError."""
+    ref = ray_tpu.put(np.zeros(PAYLOAD // 8))
+    w = ray_tpu._private.worker.global_worker()
+    w.core_worker.store.delete([ref.hex()])
+    with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+        ray_tpu.get(ref)
+
+
+def test_borrowed_ref_survives_owner_release(ray_start):
+    """An actor that keeps a borrowed ref pins it at the owner; the driver
+    dropping its last local ref must not free the object."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, refs):
+            self.ref = refs[0]  # keeps the ObjectRef → borrower pin
+            return "held"
+
+        def read(self):
+            return float(ray_tpu.get(self.ref)[0])
+
+    holder = Holder.options(num_cpus=0.1).remote()
+    ref = ray_tpu.put(np.full(PAYLOAD // 8, 7.0))
+    # Wrap in a list so the top-level arg isn't resolved to a value — the
+    # actor receives the ObjectRef itself (reference semantics: only
+    # top-level args are inlined).
+    assert ray_tpu.get(holder.hold.remote([ref])) == "held"
+    oid_hex = ref.hex()
+    del ref  # drop the driver's last local ref
+    import gc
+    gc.collect()
+    w = ray_tpu._private.worker.global_worker()
+    # Owner must still hold the object (borrower pin), not FREED.
+    loc = w.core_worker.objects.get(oid_hex)
+    assert loc is not None and loc[0] != "freed", f"freed under borrow: {loc}"
+    # And the borrower can still read it.
+    @ray_tpu.remote
+    def identity(x):
+        return x
+    assert ray_tpu.get(holder.read.remote()) == 7.0
+    ray_tpu.kill(holder)
+
+
+def test_borrowed_ref_released_frees_object(ray_start):
+    """When the last borrower releases, the owner's release takes effect."""
+    import time as _time
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, refs):
+            self.ref = refs[0]
+            return "held"
+
+        def drop(self):
+            self.ref = None
+            import gc
+            gc.collect()
+            return "dropped"
+
+    holder = Holder.options(num_cpus=0.1).remote()
+    ref = ray_tpu.put(np.zeros(PAYLOAD // 8))
+    assert ray_tpu.get(holder.hold.remote([ref])) == "held"
+    oid_hex = ref.hex()
+    del ref
+    import gc
+    gc.collect()
+    assert ray_tpu.get(holder.drop.remote()) == "dropped"
+    w = ray_tpu._private.worker.global_worker()
+    deadline = _time.time() + 10
+    while _time.time() < deadline:
+        loc = w.core_worker.objects.get(oid_hex)
+        if loc is not None and loc[0] == "freed":
+            break
+        _time.sleep(0.1)
+    assert loc is not None and loc[0] == "freed", \
+        f"object not freed after borrow release: {loc}"
+    ray_tpu.kill(holder)
