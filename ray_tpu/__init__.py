@@ -19,7 +19,7 @@ from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
 from ray_tpu.api import (available_resources, cancel, cluster_resources,  # noqa: F401
                          free, get, get_gcs_address, get_runtime_context,
                          init, is_initialized, kill, nodes, put, remote,
-                         shutdown, wait)
+                         shutdown, timeline, wait)
 from ray_tpu.remote_function import RemoteFunction  # noqa: F401
 
 __version__ = "0.1.0"
@@ -29,5 +29,5 @@ __all__ = [
     "shutdown", "is_initialized", "get", "put", "wait", "kill", "cancel",
     "free", "nodes", "cluster_resources", "available_resources",
     "get_gcs_address", "get_runtime_context", "exceptions", "RemoteFunction",
-    "__version__",
+    "timeline", "__version__",
 ]

@@ -30,6 +30,7 @@ from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreFullError, StoreClient
 from ray_tpu._private.state import TaskSpec, TaskType
+from ray_tpu._private.task_events import TaskEventBuffer, now as _ev_now
 
 logger = logging.getLogger(__name__)
 
@@ -99,6 +100,11 @@ class CoreWorker:
         self.local_refs: Dict[str, int] = {}
         self.arg_pins: Dict[str, int] = {}
         self.borrowed: Dict[str, Tuple[str, int]] = {}  # oid hex -> owner addr
+        # Owner-side borrower accounting: oid hex -> {borrower addr: count}.
+        # A liveness sweep drops pins of borrowers that died without
+        # releasing (reference: ReferenceCounter detects borrower failure
+        # via the WaitForRefRemoved long-poll connection breaking).
+        self.borrower_pins: Dict[str, Dict[Tuple[str, int], int]] = {}
         # One long-lived drainer for borrow releases instead of a thread
         # per dropped ref (releases are fire-and-forget, order irrelevant).
         self._borrow_release_queue: "queue.Queue" = queue.Queue()
@@ -111,6 +117,10 @@ class CoreWorker:
         self._shutdown = False
         threading.Thread(target=self._borrow_release_loop, daemon=True,
                          name="borrow-release").start()
+        # Task state transitions → GCS task sink (reference
+        # task_event_buffer.h:206 flushed to GcsTaskManager).
+        self.task_events = TaskEventBuffer(rpc_lib.RpcClient(
+            self.gcs_address, timeout=30))
 
         # Driver's root "task" context for put ids
         self._root_task_id = TaskID.of(job_id)
@@ -184,7 +194,7 @@ class CoreWorker:
             # sender's in-flight arg pin at the same owner).
             try:
                 self._pool.get(tuple(ref.owner_address)).call(
-                    "cw_add_ref", oid_hex=h)
+                    "cw_add_ref", oid_hex=h, borrower=self.address)
             except Exception:  # noqa: BLE001 - owner gone; get() will surface
                 # Roll back the borrow record: without a registered pin, a
                 # later cw_remove_ref would decrement a pin some OTHER
@@ -224,13 +234,22 @@ class CoreWorker:
 
     def _borrow_release_loop(self) -> None:
         while not self._shutdown:
-            item = self._borrow_release_queue.get()
+            try:
+                item = self._borrow_release_queue.get(timeout=10.0)
+            except queue.Empty:
+                # Idle: sweep for borrowers that died without releasing.
+                try:
+                    self._sweep_dead_borrowers()
+                except Exception:  # noqa: BLE001
+                    logger.exception("borrower sweep failed")
+                continue
             if item is None:
                 return
             owner_addr, oid_hex = item
             try:
                 self._pool.get(owner_addr).call("cw_remove_ref",
-                                                oid_hex=oid_hex)
+                                                oid_hex=oid_hex,
+                                                borrower=self.address)
             except Exception:  # noqa: BLE001 - owner gone; nothing to free
                 pass
 
@@ -545,6 +564,10 @@ class CoreWorker:
             self.tasks[spec.task_id.hex()] = _TaskEntry(
                 spec=spec, retries_left=spec.max_retries,
                 return_ids=return_ids)
+        self.task_events.record(
+            spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
+            name=spec.function_name, type="NORMAL_TASK",
+            job_id=spec.job_id.hex())
         self._pin_args(spec.arg_object_refs)
         self._request_lease(spec)
         return [ObjectRef(oid, self.address) for oid in return_ids]
@@ -604,8 +627,14 @@ class CoreWorker:
                 if nm_address is not None:
                     entry.lease_node = tuple(nm_address)
         if entry is None or entry.done:
+            # Stale grant (task already finished/cancelled/retried): hand
+            # the lease back without touching task state — recording
+            # SCHEDULED here could clobber a terminal FAILED still sitting
+            # in the local event buffer's pending merge.
             self._return_lease(lease_id, entry, nm_address=nm_address)
             return
+        self.task_events.record(task_id.hex(), state="SCHEDULED",
+                                node_id=node_id)
         try:
             self._pool.get(tuple(worker_address)).call(
                 "w_push_task", spec=entry.spec, lease_id=lease_id)
@@ -650,6 +679,7 @@ class CoreWorker:
                 if ev is not None:
                     ev.set()
         self._unpin_args(entry.spec.arg_object_refs)
+        self.task_events.record(h, state="FINISHED", ts_finished=_ev_now())
         if lease_id is not None:
             self._return_lease(lease_id, entry)
 
@@ -689,6 +719,9 @@ class CoreWorker:
                 if ev is not None:
                     ev.set()
         self._unpin_args(entry.spec.arg_object_refs)
+        self.task_events.record(task_hex, state="FAILED",
+                                ts_finished=_ev_now(),
+                                error=f"{error_type}: {message}"[:500])
 
     # ------------------------------------------------------------------
     # Actor submission (reference direct_actor_task_submitter.h)
@@ -702,6 +735,10 @@ class CoreWorker:
                 actor_id=spec.actor_id)
         self._gcs.call("register_actor", spec=spec, name=name,
                        namespace=namespace)
+        self.task_events.record(
+            spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
+            name=f"{spec.function_name}.__init__", type="ACTOR_CREATION_TASK",
+            job_id=spec.job_id.hex())
 
     def attach_actor(self, actor_id: ActorID) -> None:
         """Track an actor we only hold a handle to (named/deserialized)."""
@@ -748,6 +785,10 @@ class CoreWorker:
                 state.resolving = True
             else:
                 need_resolve = False
+        self.task_events.record(
+            spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
+            name=f"{method_name} [actor {actor_id.hex()[:8]}]",
+            type="ACTOR_TASK", job_id=spec.job_id.hex())
         self._pin_args(arg_refs)
         if addr is not None:
             self._push_actor_task(addr, spec)
@@ -882,12 +923,29 @@ class CoreWorker:
             return (PENDING,)
         return loc
 
-    def _on_add_ref(self, oid_hex: str) -> None:
+    def _on_add_ref(self, oid_hex: str,
+                    borrower: Optional[Tuple[str, int]] = None) -> None:
         with self._lock:
             self.arg_pins[oid_hex] = self.arg_pins.get(oid_hex, 0) + 1
+            if borrower is not None:
+                by = self.borrower_pins.setdefault(oid_hex, {})
+                addr = tuple(borrower)
+                by[addr] = by.get(addr, 0) + 1
 
-    def _on_remove_ref(self, oid_hex: str) -> None:
+    def _on_remove_ref(self, oid_hex: str,
+                       borrower: Optional[Tuple[str, int]] = None) -> None:
         with self._lock:
+            if borrower is not None:
+                by = self.borrower_pins.get(oid_hex)
+                if by is not None:
+                    addr = tuple(borrower)
+                    left = by.get(addr, 0) - 1
+                    if left <= 0:
+                        by.pop(addr, None)
+                        if not by:
+                            self.borrower_pins.pop(oid_hex, None)
+                    else:
+                        by[addr] = left
             n = self.arg_pins.get(oid_hex, 0) - 1
             if n <= 0:
                 self.arg_pins.pop(oid_hex, None)
@@ -895,6 +953,34 @@ class CoreWorker:
                     self._maybe_free_locked(oid_hex)
             else:
                 self.arg_pins[oid_hex] = n
+
+    def _sweep_dead_borrowers(self) -> None:
+        """Drop pins held by borrowers that died without releasing."""
+        with self._lock:
+            addrs = {a for by in self.borrower_pins.values() for a in by}
+        dead = []
+        for addr in addrs:
+            try:
+                self._pool.get(addr).call("cw_ping")
+            except Exception:  # noqa: BLE001
+                self._pool.invalidate(addr)
+                dead.append(addr)
+        for addr in dead:
+            logger.info("borrower %s died; releasing its pins", addr)
+            with self._lock:
+                for oid_hex, by in list(self.borrower_pins.items()):
+                    count = by.pop(addr, 0)
+                    if not by:
+                        self.borrower_pins.pop(oid_hex, None)
+                    if count <= 0:
+                        continue
+                    n = self.arg_pins.get(oid_hex, 0) - count
+                    if n <= 0:
+                        self.arg_pins.pop(oid_hex, None)
+                        if self.local_refs.get(oid_hex, 0) == 0:
+                            self._maybe_free_locked(oid_hex)
+                    else:
+                        self.arg_pins[oid_hex] = n
 
     def _on_node_event(self, message: Any) -> None:
         """GCS "node" channel: fail (and retry) in-flight normal tasks
@@ -964,7 +1050,26 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        # Drain queued borrow releases before tearing the process down so a
+        # clean exit doesn't strand pins at owners.
+        while True:
+            try:
+                item = self._borrow_release_queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            owner_addr, oid_hex = item
+            try:
+                self._pool.get(owner_addr).call(
+                    "cw_remove_ref", oid_hex=oid_hex, borrower=self.address)
+            except Exception:  # noqa: BLE001
+                pass
         self._borrow_release_queue.put(None)
+        try:
+            self.task_events.stop()
+        except Exception:  # noqa: BLE001
+            pass
         self.server.stop()
         self.store.close()
         self._pool.close_all()
@@ -1051,6 +1156,10 @@ class _Executor:
             self._report_error(spec, exc.TaskCancelledError(spec.function_name))
             return
         cw.set_current_task(spec.task_id)
+        cw.task_events.record(spec.task_id.hex(), state="RUNNING",
+                              ts_running=_ev_now(),
+                              worker_id=cw.worker_id.hex(),
+                              node_id=cw.node_id_hex)
         # expose the task's placement group for get_current_placement_group
         # (reference: worker.placement_group_id via TaskSpec capture); an
         # actor keeps its creation PG for all subsequent method calls
@@ -1099,6 +1208,7 @@ class _Executor:
                 results.append(cw.store_blob(oid.hex(), ser.pack(v)))
             self._report_done(spec, results)
         finally:
+            cw.task_events.record(spec.task_id.hex(), ts_exec_end=_ev_now())
             cw.set_current_task(None)
             if spec.task_type == TaskType.NORMAL_TASK:
                 cw.current_placement_group_id = None

@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import rpc as rpc_lib
@@ -76,6 +77,10 @@ class GcsServer:
         self.job_counter = 0
         # pg_id hex -> PlacementGroupInfo
         self.placement_groups: Dict[str, "PlacementGroupInfo"] = {}
+        # Task event sink (reference GcsTaskManager, gcs_task_manager.h:85):
+        # merged task records keyed by task id, FIFO-capped.
+        self.task_events: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.task_events_dropped = 0
         self._dead = False
 
         self.server = rpc_lib.RpcServer({
@@ -107,6 +112,9 @@ class GcsServer:
             "remove_placement_group": self.remove_placement_group,
             "get_placement_group": self.get_placement_group,
             "list_placement_groups": self.list_placement_groups,
+            # task events (reference TaskInfoGcsService / GcsTaskManager)
+            "add_task_events": self.add_task_events,
+            "list_tasks": self.list_tasks,
             # pubsub (reference InternalPubSubGcsService)
             "subscribe": self.subscribe,
             "ping": lambda: "pong",
@@ -349,6 +357,38 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 pass
         self.report_actor_death(actor_id_hex, "ray.kill", restart=not no_restart)
+
+    # ---- task events (reference GcsTaskManager) -------------------------
+
+    TASK_EVENTS_MAX = 16384
+
+    def add_task_events(self, events: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            for rec in events:
+                tid = rec.get("task_id")
+                if not tid:
+                    continue
+                existing = self.task_events.get(tid)
+                if existing is None:
+                    self.task_events[tid] = dict(rec)
+                else:
+                    # Terminal states must not be clobbered by a late-arriving
+                    # RUNNING delta from the executing worker's buffer.
+                    if existing.get("state") in ("FINISHED", "FAILED"):
+                        rec = {k: v for k, v in rec.items() if k != "state"}
+                    existing.update(rec)
+            while len(self.task_events) > self.TASK_EVENTS_MAX:
+                self.task_events.popitem(last=False)
+                self.task_events_dropped += 1
+
+    def list_tasks(self, filters: Optional[Dict[str, Any]] = None,
+                   limit: int = 10000) -> List[Dict[str, Any]]:
+        with self._lock:
+            records = list(self.task_events.values())
+        if filters:
+            records = [r for r in records
+                       if all(r.get(k) == v for k, v in filters.items())]
+        return records[-limit:]
 
     # ---- pubsub ----------------------------------------------------------
 

@@ -140,6 +140,7 @@ class NodeManager:
             "nm_commit_bundle": self.commit_bundle,
             "nm_return_bundle": self.return_bundle,
             "nm_get_info": self.get_info,
+            "nm_list_workers": self.list_workers,
             "nm_drain": self.drain,
         }, host=host)
         self.address = self.server.address
@@ -616,6 +617,20 @@ class NodeManager:
                 "num_workers": len(self.workers),
                 "num_pending_leases": len(self.pending),
             }
+
+    def list_workers(self) -> List[Dict[str, Any]]:
+        """Worker-level metadata for the state API (`ray list workers`)."""
+        with self._lock:
+            return [{
+                "worker_id": wid,
+                "node_id": self.node_id.hex(),
+                "pid": h.proc.pid if h.proc is not None else None,
+                "is_actor": h.is_actor,
+                "actor_id": h.actor_id_hex,
+                "idle": h.current_task is None,
+                "current_task": (h.current_task.function_name
+                                 if h.current_task is not None else None),
+            } for wid, h in self.workers.items()]
 
     def drain(self) -> None:
         self.shutdown()

@@ -49,6 +49,19 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
+def _chaos_delay() -> None:
+    """Chaos testing: inject a random handler delay (reference
+    asio_chaos.cc:29-40, env RAY_testing_asio_delay_us). Set
+    RAY_TPU_testing_rpc_delay_us to randomize RPC handler latencies and
+    surface race/ordering bugs in tests."""
+    from ray_tpu._private.config import Config
+    max_us = Config.testing_rpc_delay_us
+    if max_us > 0:
+        import random
+        import time
+        time.sleep(random.uniform(0, max_us) / 1e6)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         server: RpcServer = self.server.rpc_server  # type: ignore[attr-defined]
@@ -58,6 +71,7 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 req = _recv_frame(sock)
                 method, kwargs = pickle.loads(req)
+                _chaos_delay()
                 try:
                     handler = server.handlers[method]
                 except KeyError:

@@ -115,6 +115,20 @@ def available_resources() -> Dict[str, float]:
     return avail
 
 
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Dump task execution as Chrome-trace JSON (reference `ray timeline`,
+    scripts/scripts.py:1856; load via chrome://tracing or Perfetto)."""
+    import json
+
+    from ray_tpu._private.task_events import timeline_events
+    from ray_tpu.util import state as state_api
+    events = timeline_events(state_api.list_tasks())
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
 def get_gcs_address() -> str:
     w = worker_mod.global_worker()
     host, port = w.gcs_address

@@ -1,0 +1,125 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+reference parity: python/ray/util/metrics.py (Counter/Gauge/Histogram over
+the OpenCensus-based native registry, src/ray/stats/metric.h). Here metrics
+live in a per-process registry; `collect()` snapshots them, and node-level
+aggregation rides the existing state API instead of a Prometheus agent.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+class Metric:
+    """Base: named metric with optional tag keys; values kept per tag-set."""
+
+    kind = "metric"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        with _REGISTRY_LOCK:
+            if name in _REGISTRY:
+                # Silent replacement would orphan the earlier instance:
+                # increments through it would vanish from collect().
+                raise ValueError(
+                    f"metric {name!r} already registered in this process")
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]
+             ) -> Tuple[Tuple[str, str], ...]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"undeclared tag keys {sorted(extra)} for "
+                             f"metric {self.name} (declared: {self.tag_keys})")
+        return tuple(sorted(merged.items()))
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"name": self.name, "kind": self.kind,
+                    "description": self.description,
+                    "values": {k: v for k, v in self._values.items()}}
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100, 1000])
+        self._buckets: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            buckets[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"name": self.name, "kind": self.kind,
+                    "description": self.description,
+                    "boundaries": list(self.boundaries),
+                    "buckets": {k: list(v) for k, v in self._buckets.items()},
+                    "sum": dict(self._sums), "count": dict(self._counts)}
+
+
+def collect() -> List[Dict]:
+    """Snapshot every metric registered in this process."""
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    return [m.snapshot() for m in metrics]
+
+
+def clear() -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

@@ -1,0 +1,121 @@
+"""Cluster state introspection API (`ray list tasks/actors/objects/...`).
+
+reference parity: python/ray/util/state/api.py — list_* entry points backed
+by the GCS task sink (gcs_task_manager.h:85) and per-node queries, aggregated
+like dashboard/state_aggregator.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import rpc as rpc_lib
+from ray_tpu._private import worker as worker_mod
+
+
+def _gcs():
+    return worker_mod.global_worker().core_worker._gcs
+
+
+def _pool():
+    return worker_mod.global_worker().core_worker._pool
+
+
+def list_tasks(filters: Optional[Dict[str, Any]] = None,
+               limit: int = 10000) -> List[Dict[str, Any]]:
+    """Task records with state transitions + timestamps."""
+    # Flush this process's buffered events first so a list right after a
+    # get() sees the terminal state.
+    worker_mod.global_worker().core_worker.task_events.flush()
+    return _gcs().call("list_tasks", filters=filters, limit=limit)
+
+
+def list_actors(filters: Optional[Dict[str, Any]] = None
+                ) -> List[Dict[str, Any]]:
+    infos = _gcs().call("list_actors")
+    out = [{
+        "actor_id": a.actor_id.hex(),
+        "class_name": a.class_name,
+        "name": a.name,
+        "namespace": a.namespace,
+        "state": a.state,
+        "node_id": a.node_id.hex() if a.node_id else None,
+        "num_restarts": a.num_restarts,
+        "death_cause": a.death_cause,
+    } for a in infos]
+    if filters:
+        out = [r for r in out
+               if all(r.get(k) == v for k, v in filters.items())]
+    return out
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return [{
+        "node_id": n.node_id.hex(),
+        "state": "ALIVE" if n.alive else "DEAD",
+        "address": n.address,
+        "is_head": n.is_head,
+        "resources_total": dict(n.resources_total),
+        "labels": dict(n.labels),
+    } for n in _gcs().call("get_all_nodes")]
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for n in _gcs().call("get_all_nodes"):
+        if not n.alive:
+            continue
+        try:
+            out.extend(_pool().get(tuple(n.address)).call("nm_list_workers"))
+        except Exception:  # noqa: BLE001 - node died mid-listing
+            pass
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Objects resident in every alive node's shared-memory store."""
+    out: List[Dict[str, Any]] = []
+    for n in _gcs().call("get_all_nodes"):
+        if not n.alive:
+            continue
+        try:
+            for rec in _pool().get(tuple(n.store_address)).call("store_list"):
+                rec["node_id"] = n.node_id.hex()
+                out.append(rec)
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return [{
+        "placement_group_id": pg.pg_id.hex(),
+        "name": pg.name,
+        "state": pg.state,
+        "strategy": pg.strategy,
+        "bundles": list(pg.bundles),
+        "bundle_nodes": list(pg.bundle_nodes),
+    } for pg in _gcs().call("list_placement_groups")]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """Count of tasks per state (reference `ray summary tasks`)."""
+    counts: Dict[str, int] = {}
+    for rec in list_tasks():
+        counts[rec.get("state", "?")] = counts.get(rec.get("state", "?"), 0) + 1
+    return counts
+
+
+def object_store_stats() -> List[Dict[str, Any]]:
+    """Per-node store stats incl. spill/restore counters (`ray memory`)."""
+    out = []
+    for n in _gcs().call("get_all_nodes"):
+        if not n.alive:
+            continue
+        try:
+            stats = _pool().get(tuple(n.store_address)).call("store_stats")
+            stats["node_id"] = n.node_id.hex()
+            out.append(stats)
+        except Exception:  # noqa: BLE001
+            pass
+    return out

@@ -114,6 +114,39 @@ def test_borrowed_ref_survives_owner_release(ray_start):
     ray_tpu.kill(holder)
 
 
+def test_dead_borrower_pins_swept(ray_start):
+    """A borrower that dies without releasing must not pin the object
+    forever: the owner's liveness sweep drops its pins."""
+    import signal
+    import time as _time
+
+    @ray_tpu.remote
+    class Holder:
+        def hold(self, refs):
+            self.ref = refs[0]
+            return os.getpid()
+
+    holder = Holder.options(num_cpus=0.1).remote()
+    ref = ray_tpu.put(np.zeros(PAYLOAD // 8))
+    pid = ray_tpu.get(holder.hold.remote([ref]))
+    oid_hex = ref.hex()
+    os.kill(pid, signal.SIGKILL)  # borrower dies holding the pin
+    del ref
+    import gc
+    gc.collect()
+    w = ray_tpu._private.worker.global_worker()
+    # the sweep runs on a ~10s idle cadence
+    deadline = _time.time() + 40
+    loc = None
+    while _time.time() < deadline:
+        loc = w.core_worker.objects.get(oid_hex)
+        if loc is not None and loc[0] == "freed":
+            break
+        _time.sleep(0.5)
+    assert loc is not None and loc[0] == "freed", \
+        f"dead borrower's pin never swept: {loc}"
+
+
 def test_borrowed_ref_released_frees_object(ray_start):
     """When the last borrower releases, the owner's release takes effect."""
     import time as _time
