@@ -7,9 +7,27 @@ and participates in reference counting via __del__.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional, Tuple
 
 from ray_tpu._private.ids import ObjectID
+
+# Active nested-ref collector for the current thread's serialization
+# (reference: the SerializationContext tracks "contained object refs" so
+# the submitter pins refs nested anywhere inside task args, not just
+# top-level ones).
+_collect_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def collect_serialized_refs(out: list):
+    prev = getattr(_collect_ctx, "refs", None)
+    _collect_ctx.refs = out
+    try:
+        yield out
+    finally:
+        _collect_ctx.refs = prev
 
 
 class ObjectRef:
@@ -67,6 +85,9 @@ class ObjectRef:
     def __reduce__(self):
         # Serialized refs re-register on the receiving process; the sender's
         # core worker pins the object for in-flight arg refs separately.
+        collector = getattr(_collect_ctx, "refs", None)
+        if collector is not None:
+            collector.append(self)
         return (_deserialize_ref, (self._id, self._owner_address))
 
     def future(self):

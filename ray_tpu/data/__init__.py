@@ -1,0 +1,15 @@
+"""ray_tpu.data: block-based datasets with a streaming executor.
+
+reference parity: python/ray/data — Dataset over blocks, lazy transforms,
+pull-based streaming execution with backpressure, per-worker train shards.
+"""
+
+from ray_tpu.data.block import Block  # noqa: F401
+from ray_tpu.data.dataset import (Dataset, MaterializedDataset,  # noqa: F401
+                                  from_blocks, from_items, from_numpy, range)
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
+
+__all__ = [
+    "Block", "Dataset", "MaterializedDataset", "DataIterator",
+    "from_items", "from_numpy", "from_blocks", "range",
+]

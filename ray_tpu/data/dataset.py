@@ -1,0 +1,282 @@
+"""Dataset: lazy block-based data pipeline feeding trainers.
+
+reference parity: python/ray/data/dataset.py — lazy logical plan over
+blocks executed by a streaming executor (streaming_executor.py:60) with
+map/map_batches/filter/flat_map/repartition/random_shuffle/split, iteration
+(iter_rows/iter_batches), and Train integration via per-worker shards
+(train/_internal/session.py:1017 get_dataset_shard). Blocks here are
+columnar numpy dicts (see block.py) — the shape jax wants.
+"""
+
+from __future__ import annotations
+
+import builtins
+import math
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+from ray_tpu.data.block import Block
+from ray_tpu.data.executor import StreamingExecutor, _execute_chain
+from ray_tpu.data.iterator import DataIterator
+
+
+class Dataset:
+    """A lazy pipeline: input block sources + a chain of per-block ops.
+
+    Per-block ops (map/map_batches/filter/flat_map) fuse into one task per
+    block. All-to-all ops (repartition/random_shuffle) materialize.
+    """
+
+    def __init__(self, inputs: List[Any], ops: Optional[List] = None):
+        self._inputs = inputs
+        self._ops = list(ops or [])
+
+    # -- transforms (lazy, fused per block) ---------------------------
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
+        return Dataset(self._inputs, self._ops + [("map", fn)])
+
+    def map_batches(self, fn: Callable[[Block], Block], *,
+                    batch_size: Optional[int] = None) -> "Dataset":
+        return Dataset(self._inputs,
+                       self._ops + [("map_batches", fn, batch_size)])
+
+    def flat_map(self, fn: Callable[[Dict[str, Any]], Sequence[Dict]]
+                 ) -> "Dataset":
+        return Dataset(self._inputs, self._ops + [("flat_map", fn)])
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        return Dataset(self._inputs, self._ops + [("filter", fn)])
+
+    # -- all-to-all ops (materializing) -------------------------------
+
+    def repartition(self, num_blocks: int) -> "MaterializedDataset":
+        """Redistribute rows into `num_blocks` equal-ish blocks."""
+        return self._redistribute(num_blocks, shuffle_seed=None)
+
+    def random_shuffle(self, *, seed: Optional[int] = None
+                       ) -> "MaterializedDataset":
+        """Global row permutation (reference Dataset.random_shuffle)."""
+        if seed is None:
+            # Fresh entropy per call — a fixed default seed would hand
+            # training the same "random" permutation every epoch.
+            import os as _os
+            seed = int.from_bytes(_os.urandom(4), "big")
+        n_out = max(1, len(self._inputs))
+        return self._redistribute(n_out, shuffle_seed=seed)
+
+    def _redistribute(self, num_blocks: int,
+                      shuffle_seed: Optional[int]) -> "MaterializedDataset":
+        mat = self.materialize()
+        # Row counts via tiny tasks — don't pull whole blocks to the driver.
+        count_remote = ray_tpu.remote(_count_rows)
+        counts = ray_tpu.get([count_remote.remote(r) for r in mat._refs])
+        total = sum(counts)
+        n = num_blocks
+        size = math.ceil(total / n) if total else 0
+        out_refs = []
+        if shuffle_seed is None:
+            # Plain repartition keeps global row order, so partition j is
+            # the contiguous row range [j*size,(j+1)*size): each task only
+            # needs the input blocks overlapping its range — NOT the whole
+            # dataset n times over.
+            starts = [0]
+            for c in counts[:-1]:
+                starts.append(starts[-1] + c)
+            remote = ray_tpu.remote(_build_partition_contig)
+            for j in builtins.range(n):
+                lo, hi = j * size, min((j + 1) * size, total)
+                sel = [i for i, (s, c) in enumerate(zip(starts, counts))
+                       if s < hi and s + c > lo]
+                refs_j = [mat._refs[i] for i in sel]
+                counts_j = [counts[i] for i in sel]
+                gstart = starts[sel[0]] if sel else 0
+                out_refs.append(remote.remote(refs_j, counts_j, gstart,
+                                              lo, hi))
+        else:
+            # Global permutation: a true all-to-all; every output needs
+            # rows from (potentially) every input.
+            remote = ray_tpu.remote(_build_partition)
+            out_refs = [
+                remote.remote(mat._refs, counts, j, n, shuffle_seed)
+                for j in builtins.range(n)
+            ]
+        return MaterializedDataset(out_refs)
+
+    # -- consumption --------------------------------------------------
+
+    def materialize(self, *, max_in_flight_blocks: int = 4
+                    ) -> "MaterializedDataset":
+        if isinstance(self, MaterializedDataset) and not self._ops:
+            return self
+        ex = StreamingExecutor(self._inputs, self._ops,
+                               max_in_flight_blocks=max_in_flight_blocks)
+        return MaterializedDataset(list(ex.execute()))
+
+    def iter_blocks(self, *, max_in_flight_blocks: int = 4) -> Iterator[Block]:
+        ex = StreamingExecutor(self._inputs, self._ops,
+                               max_in_flight_blocks=max_in_flight_blocks)
+        for ref in ex.execute():
+            yield ray_tpu.get(ref) if isinstance(ref, ray_tpu.ObjectRef) \
+                else ref
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for blk in self.iter_blocks():
+            yield from block_mod.block_to_rows(blk)
+
+    def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
+                     max_in_flight_blocks: int = 4) -> Iterator[Block]:
+        it = DataIterator(blocks=self.iter_blocks(
+            max_in_flight_blocks=max_in_flight_blocks))
+        yield from it.iter_batches(batch_size=batch_size, drop_last=drop_last)
+
+    def take(self, k: int = 20) -> List[Dict[str, Any]]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= k:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(block_mod.block_num_rows(b) for b in self.iter_blocks())
+
+    def schema(self) -> Dict[str, str]:
+        for blk in self.iter_blocks():
+            if block_mod.block_num_rows(blk):
+                return block_mod.block_schema(blk)
+        return {}
+
+    # -- train integration --------------------------------------------
+
+    def split(self, n: int, *, equal: bool = False
+              ) -> List["MaterializedDataset"]:
+        """N disjoint shards, one per train worker (reference
+        Dataset.split / streaming_split feeding get_dataset_shard)."""
+        mat = self.repartition(n) if equal else self.materialize()
+        shards: List[List[Any]] = [[] for _ in builtins.range(n)]
+        for i, ref in enumerate(mat._refs):
+            shards[i % n].append(ref)
+        return [MaterializedDataset(refs) for refs in shards]
+
+    def num_blocks(self) -> int:
+        return len(self._inputs)
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(num_blocks={len(self._inputs)}, "
+                f"ops={[o[0] for o in self._ops]})")
+
+
+class MaterializedDataset(Dataset):
+    """All blocks computed and living in the object store as refs."""
+
+    def __init__(self, refs: List[Any]):
+        super().__init__(refs, [])
+        self._refs = refs
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(refs=list(self._refs))
+
+
+def _count_rows(blk: Block) -> int:
+    return block_mod.block_num_rows(blk)
+
+
+def _build_partition_contig(refs: List[Any], counts: List[int],
+                            gstart: int, lo: int, hi: int) -> Block:
+    """Assemble contiguous global row range [lo,hi) from the (overlapping)
+    input blocks, whose first block starts at global row `gstart`."""
+    blocks = ray_tpu.get(list(refs))
+    pieces = []
+    pos = gstart
+    for blk, cnt in zip(blocks, counts):
+        s, e = max(lo, pos), min(hi, pos + cnt)
+        if e > s:
+            pieces.append(block_mod.slice_block(blk, s - pos, e - pos))
+        pos += cnt
+    return block_mod.concat_blocks(pieces)
+
+
+def _build_partition(refs: List[Any], counts: List[int], j: int, n: int,
+                     shuffle_seed: Optional[int]) -> Block:
+    """Worker-side: assemble output partition j of n from all input blocks
+    (global row ids round-robin or permuted when shuffling)."""
+    blocks = ray_tpu.get(list(refs))
+    total = sum(counts)
+    ids = np.arange(total)
+    if shuffle_seed is not None:
+        ids = np.random.default_rng(shuffle_seed).permutation(total)
+    size = math.ceil(total / n)
+    mine = ids[j * size:(j + 1) * size]
+    mine_sorted = np.sort(mine) if shuffle_seed is None else mine
+    # map global row id -> (block, local row)
+    starts = np.cumsum([0] + counts[:-1])
+    pieces = []
+    for blk, start, cnt in zip(blocks, starts, counts):
+        sel = mine_sorted[(mine_sorted >= start) & (mine_sorted < start + cnt)]
+        if len(sel):
+            pieces.append(block_mod.take_rows(blk, sel - start))
+    return block_mod.concat_blocks(pieces)
+
+
+# -- creation APIs (reference ray.data.from_items / range / from_numpy) ----
+
+def _chunk_bounds(n: int, parallelism: int) -> List[tuple]:
+    parallelism = max(1, min(parallelism, n)) if n else 1
+    size = math.ceil(n / parallelism) if n else 0
+    # builtins.range: the module-level `range` below shadows the builtin
+    return [(i, min(i + size, n))
+            for i in builtins.range(0, n, size)] if n else []
+
+
+def from_items(items: Sequence[Any], *, parallelism: int = 8) -> Dataset:
+    bounds = _chunk_bounds(len(items), parallelism)
+    inputs = [_ItemsSource(list(items[a:b])) for a, b in bounds]
+    return Dataset(inputs)
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    bounds = _chunk_bounds(n, parallelism)
+    return Dataset([_RangeSource(a, b) for a, b in bounds])
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *,
+               parallelism: int = 8) -> Dataset:
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    bounds = _chunk_bounds(n, parallelism)
+    return Dataset([
+        _ItemsBlockSource({k: v[a:b] for k, v in arrays.items()})
+        for a, b in bounds])
+
+
+def from_blocks(blocks: Sequence[Block]) -> Dataset:
+    return Dataset([_ItemsBlockSource(dict(b)) for b in blocks])
+
+
+class _RangeSource:
+    """Picklable lazy block: np.arange slice built inside the task."""
+
+    def __init__(self, start: int, stop: int):
+        self.start, self.stop = start, stop
+
+    def __call__(self) -> Block:
+        return {"id": np.arange(self.start, self.stop)}
+
+
+class _ItemsSource:
+    def __init__(self, items: List[Any]):
+        self.items = items
+
+    def __call__(self) -> Block:
+        return block_mod.rows_to_block(self.items)
+
+
+class _ItemsBlockSource:
+    def __init__(self, blk: Block):
+        self.blk = blk
+
+    def __call__(self) -> Block:
+        return self.blk

@@ -1,0 +1,109 @@
+"""Pull-based streaming executor with bounded in-flight blocks.
+
+reference parity: python/ray/data/_internal/execution/streaming_executor.py
+:60 — the reference streams RefBundles between physical operators with
+backpressure from ExecutionOptions resource limits. Here the per-block op
+chain is fused into ONE task per block (the reference's map fusion), and
+backpressure is a hard cap on blocks submitted but not yet consumed, so an
+arbitrarily large dataset streams through bounded store memory.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+
+
+def _apply_op(block, op: Tuple) -> Any:
+    kind = op[0]
+    if kind == "map_batches":
+        _, fn, batch_size = op
+        if batch_size is None:
+            return fn(block)
+        n = block_mod.block_num_rows(block)
+        outs = [fn(block_mod.slice_block(block, i, min(i + batch_size, n)))
+                for i in range(0, n, batch_size)]
+        return block_mod.concat_blocks(outs)
+    if kind == "map":
+        _, fn = op
+        return block_mod.rows_to_block(
+            [fn(r) for r in block_mod.block_to_rows(block)])
+    if kind == "flat_map":
+        _, fn = op
+        out: List[Any] = []
+        for r in block_mod.block_to_rows(block):
+            out.extend(fn(r))
+        return block_mod.rows_to_block(out)
+    if kind == "filter":
+        _, fn = op
+        return block_mod.rows_to_block(
+            [r for r in block_mod.block_to_rows(block) if fn(r)])
+    raise ValueError(f"unknown op {kind}")
+
+
+def _execute_chain(source: Any, ops: List[Tuple]) -> Any:
+    """One fused task: build/fetch the input block, run every per-block op."""
+    blk = source() if callable(source) else source
+    for op in ops:
+        blk = _apply_op(blk, op)
+    return blk
+
+
+# Lazily decorated so importing ray_tpu.data stays cheap.
+_remote_chain = None
+
+
+def _get_remote_chain():
+    global _remote_chain
+    if _remote_chain is None:
+        _remote_chain = ray_tpu.remote(_execute_chain)
+    return _remote_chain
+
+
+class StreamingExecutor:
+    """Streams (index-ordered) result block refs for `inputs` × `ops`.
+
+    `max_in_flight_blocks` bounds submitted-but-unconsumed blocks: the
+    driver does not submit block k+max until block k has been yielded to
+    (and therefore consumable by) the caller.
+    """
+
+    def __init__(self, inputs: List[Any], ops: List[Tuple], *,
+                 max_in_flight_blocks: int = 4,
+                 num_cpus_per_task: float = 1.0):
+        self.inputs = inputs
+        self.ops = ops
+        self.max_in_flight = max(1, max_in_flight_blocks)
+        self.num_cpus = num_cpus_per_task
+        # instrumentation (asserted by backpressure tests)
+        self.peak_in_flight = 0
+        self._in_flight = 0
+
+    def _submit(self, source: Any):
+        remote = _get_remote_chain().options(num_cpus=self.num_cpus)
+        ref = remote.remote(source, self.ops)
+        self._in_flight += 1
+        self.peak_in_flight = max(self.peak_in_flight, self._in_flight)
+        return ref
+
+    def execute(self) -> Iterator[Any]:
+        """Yield one block ref per input, in input order."""
+        if not self.ops:
+            # No per-block work: pass through without spawning tasks
+            # (materialized refs) or run creation-only tasks for lazy inputs.
+            lazy = any(callable(s) for s in self.inputs)
+            if not lazy:
+                yield from self.inputs
+                return
+        pending: "deque[Any]" = deque()
+        for source in self.inputs:
+            while len(pending) >= self.max_in_flight:
+                self._in_flight -= 1
+                yield pending.popleft()
+            pending.append(self._submit(source))
+        while pending:
+            self._in_flight -= 1
+            yield pending.popleft()

@@ -42,9 +42,21 @@ def build_resources(options: Dict[str, Any],
 
 
 def pack_args(args: tuple, kwargs: dict) -> "tuple[bytes, List[ObjectID]]":
-    refs = [a.id for a in args if isinstance(a, ObjectRef)]
-    refs += [v.id for v in kwargs.values() if isinstance(v, ObjectRef)]
-    return ser.pack((args, kwargs)), refs
+    """Serialize args, collecting every ObjectRef at ANY nesting depth so
+    the submitter pins them all until the task completes (a ref inside a
+    list freed mid-flight would otherwise vanish under the executing
+    worker)."""
+    from ray_tpu._private.object_ref import collect_serialized_refs
+    collected: List[ObjectRef] = []
+    with collect_serialized_refs(collected):
+        blob = ser.pack((args, kwargs))
+    seen = set()
+    refs = []
+    for r in collected:
+        if r.id.hex() not in seen:
+            seen.add(r.id.hex())
+            refs.append(r.id)
+    return blob, refs
 
 
 class RemoteFunction:

@@ -13,10 +13,11 @@ from ray_tpu.train.data_parallel_trainer import (DataParallelTrainer,  # noqa: F
 from ray_tpu.train.jax_backend import JaxConfig  # noqa: F401
 from ray_tpu.train.jax_trainer import JaxTrainer  # noqa: F401
 from ray_tpu.train.session import (TrainContext, get_checkpoint,  # noqa: F401
-                                   get_context, report)
+                                   get_context, get_dataset_shard, report)
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
     "ScalingConfig", "DataParallelTrainer", "Result", "JaxConfig",
     "JaxTrainer", "TrainContext", "report", "get_checkpoint", "get_context",
+    "get_dataset_shard",
 ]

@@ -104,23 +104,37 @@ class BackendExecutor:
                        config: Optional[Dict[str, Any]],
                        checkpoint_dir: Optional[str] = None,
                        experiment_name: str = "",
-                       trial_dir: str = "") -> None:
+                       trial_dir: str = "",
+                       datasets: Optional[Dict[str, Any]] = None) -> None:
         assert self.worker_group is not None, "call start() first"
         self._train_args = {
             "train_loop": train_loop, "config": config,
             "experiment_name": experiment_name, "trial_dir": trial_dir,
+            "datasets": datasets,
         }
         self._latest_checkpoint_dir = checkpoint_dir
         self._backend.on_training_start(self.worker_group,
                                         self._backend_config)
         import ray_tpu
+        # Disjoint per-rank dataset shards (reference backend_executor +
+        # session.py:1017 get_dataset_shard contract).
+        shards_per_rank: Optional[List[Dict[str, Any]]] = None
+        if datasets:
+            world = len(self.worker_group)
+            shards_per_rank = [dict() for _ in range(world)]
+            for name, ds in datasets.items():
+                # equal=True: every rank must get a non-empty shard or an
+                # SPMD loop doing per-batch collectives would deadlock.
+                for rank, shard in enumerate(ds.split(world, equal=True)):
+                    shards_per_rank[rank][name] = shard.iterator()
         refs = []
         for rank, w in enumerate(self.worker_group.workers):
             ctx = self._contexts[rank]
             ctx.experiment_name = experiment_name
             ctx.trial_dir = trial_dir
             refs.append(w.init_session.remote(
-                train_loop, config, ctx, checkpoint_dir))
+                train_loop, config, ctx, checkpoint_dir,
+                shards_per_rank[rank] if shards_per_rank else None))
         ray_tpu.get(refs, timeout=120)
         ray_tpu.get([w.start_training_session.remote()
                      for w in self.worker_group.workers], timeout=120)
@@ -184,7 +198,8 @@ class BackendExecutor:
             self._train_args["train_loop"], self._train_args["config"],
             checkpoint_dir=self._latest_checkpoint_dir,
             experiment_name=self._train_args["experiment_name"],
-            trial_dir=self._train_args["trial_dir"])
+            trial_dir=self._train_args["trial_dir"],
+            datasets=self._train_args.get("datasets"))
 
     def note_checkpoint(self, checkpoint_dir: str) -> None:
         """Driver tells the executor where the latest persisted checkpoint

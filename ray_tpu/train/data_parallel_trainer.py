@@ -53,12 +53,14 @@ class DataParallelTrainer:
                  backend_config: Optional[BackendConfig] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         self._train_loop = train_loop_per_worker
         self._train_loop_config = train_loop_config
         self._backend_config = backend_config or self._backend_config_cls()
         self._scaling_config = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
+        self._datasets = datasets
         self._resume_from = resume_from_checkpoint
 
     def fit(self) -> Result:
@@ -82,7 +84,8 @@ class DataParallelTrainer:
                 self._train_loop, self._train_loop_config,
                 checkpoint_dir=(self._resume_from.path
                                 if self._resume_from else None),
-                experiment_name=run_name, trial_dir=run_dir)
+                experiment_name=run_name, trial_dir=run_dir,
+                datasets=self._datasets)
             while True:
                 results = executor.get_next_results()
                 if results is None:

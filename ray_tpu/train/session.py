@@ -72,9 +72,11 @@ class _TrainSession:
     def __init__(self, train_loop: Callable[..., Any],
                  config: Optional[Dict[str, Any]],
                  context: TrainContext,
-                 starting_checkpoint: Optional[Checkpoint] = None):
+                 starting_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.context = context
         self.starting_checkpoint = starting_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self._results: "queue.Queue[TrainingResult]" = queue.Queue(maxsize=1)
         self._loop = train_loop
         self._config = config
@@ -160,3 +162,15 @@ def get_checkpoint() -> Optional[Checkpoint]:
 def get_context() -> TrainContext:
     """reference ray.train.get_context()."""
     return _get_session_or_raise().context
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer (reference
+    train/_internal/session.py:1017 get_dataset_shard). Returns a
+    ray_tpu.data.DataIterator."""
+    shards = _get_session_or_raise().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard named {name!r}: trainer was given "
+            f"datasets={list(shards)}")
+    return shards[name]

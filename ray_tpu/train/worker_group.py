@@ -36,9 +36,11 @@ class RayTrainWorker:
 
     def init_session(self, train_loop: Callable, config: Optional[Dict],
                       context: TrainContext,
-                      checkpoint_dir: Optional[str]) -> None:
+                      checkpoint_dir: Optional[str],
+                      dataset_shards: Optional[Dict] = None) -> None:
         ckpt = Checkpoint(checkpoint_dir) if checkpoint_dir else None
-        self._session = _TrainSession(train_loop, config, context, ckpt)
+        self._session = _TrainSession(train_loop, config, context, ckpt,
+                                      dataset_shards=dataset_shards)
         _set_session(self._session)
 
     def start_training_session(self) -> None:

@@ -127,7 +127,8 @@ class FaultTolerantActorManager:
             try:
                 refs.append((i, self._call(a, fn)))
             except Exception as e:  # noqa: BLE001 - submission itself failed
-                self.set_actor_state(i, False)
+                if _is_actor_failure(e):
+                    self.set_actor_state(i, False)
                 refs.append((i, e))
         out: List[CallResult] = []
         for i, ref in refs:
@@ -161,8 +162,9 @@ class FaultTolerantActorManager:
                 continue
             try:
                 ref = self._call(a, fn)
-            except Exception:  # noqa: BLE001
-                self.set_actor_state(i, False)
+            except Exception as e:  # noqa: BLE001
+                if _is_actor_failure(e):
+                    self.set_actor_state(i, False)
                 continue
             with self._lock:
                 self._in_flight[ref] = (i, tag)

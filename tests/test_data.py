@@ -1,0 +1,120 @@
+"""ray_tpu.data: lazy transforms, streaming execution + backpressure,
+shuffle/repartition, train-shard integration.
+
+reference parity: python/ray/data — Dataset transforms (dataset.py),
+streaming executor backpressure (streaming_executor.py:60), train shards
+(train/_internal/session.py:1017 get_dataset_shard).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.executor import StreamingExecutor
+
+
+def test_range_map_filter_count(ray_start):
+    ds = rd.range(100, parallelism=4)
+    ds2 = ds.map(lambda r: {"id": r["id"] * 2})
+    ds3 = ds2.filter(lambda r: r["id"] % 4 == 0)
+    assert ds3.count() == 50
+    rows = ds3.take(5)
+    assert [r["id"] for r in rows] == [0, 4, 8, 12, 16]
+
+
+def test_map_batches_columnar(ray_start):
+    ds = rd.range(64, parallelism=4).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2}, batch_size=8)
+    out = ds.take(3)
+    assert [r["sq"] for r in out] == [0, 1, 4]
+    assert ds.schema().keys() == {"id", "sq"}
+
+
+def test_from_items_flat_map(ray_start):
+    ds = rd.from_items([1, 2, 3], parallelism=2)
+    ds2 = ds.flat_map(lambda r: [{"v": r["item"]}, {"v": r["item"] * 10}])
+    vals = sorted(r["v"] for r in ds2.iter_rows())
+    assert vals == [1, 2, 3, 10, 20, 30]
+
+
+def test_iter_batches_exact_sizes(ray_start):
+    ds = rd.range(50, parallelism=4)
+    batches = list(ds.iter_batches(batch_size=16))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [16, 16, 16, 2]
+    assert list(batches[0]["id"][:4]) == [0, 1, 2, 3]
+    batches = list(ds.iter_batches(batch_size=16, drop_last=True))
+    assert [len(b["id"]) for b in batches] == [16, 16, 16]
+
+
+def test_streaming_backpressure_bounded(ray_start):
+    """No more than max_in_flight blocks are submitted-but-unconsumed."""
+    ds = rd.range(200, parallelism=10).map(lambda r: {"id": r["id"] + 1})
+    ex = StreamingExecutor(ds._inputs, ds._ops, max_in_flight_blocks=2)
+    total = 0
+    for ref in ex.execute():
+        blk = ray_tpu.get(ref)
+        total += len(blk["id"])
+    assert total == 200
+    assert ex.peak_in_flight <= 2, (
+        f"backpressure violated: {ex.peak_in_flight} blocks in flight")
+
+
+def test_repartition_and_shuffle(ray_start):
+    ds = rd.range(30, parallelism=3)
+    rep = ds.repartition(5)
+    assert rep.num_blocks() == 5
+    assert sorted(r["id"] for r in rep.iter_rows()) == list(range(30))
+
+    shuf = rd.range(30, parallelism=3).random_shuffle(seed=7)
+    got = [r["id"] for r in shuf.iter_rows()]
+    assert sorted(got) == list(range(30))
+    assert got != list(range(30)), "shuffle produced identity order"
+
+
+def test_split_disjoint_shards(ray_start):
+    shards = rd.range(40, parallelism=4).split(2, equal=True)
+    assert len(shards) == 2
+    seen = []
+    for s in shards:
+        seen.extend(r["id"] for r in s.iter_rows())
+    assert sorted(seen) == list(range(40))
+    c0, c1 = shards[0].count(), shards[1].count()
+    assert c0 == c1 == 20
+
+
+def test_from_numpy_roundtrip(ray_start):
+    x = np.arange(20, dtype=np.float32)
+    y = x * 3
+    ds = rd.from_numpy({"x": x, "y": y}, parallelism=3)
+    batch = next(ds.iter_batches(batch_size=20))
+    np.testing.assert_array_equal(batch["x"], x)
+    np.testing.assert_array_equal(batch["y"], y)
+
+
+def test_train_get_dataset_shard(ray_start):
+    """Each train worker consumes a disjoint shard via get_dataset_shard."""
+    from ray_tpu.train import (DataParallelTrainer, ScalingConfig, report,
+                               get_context, get_dataset_shard)
+
+    def loop():
+        it = get_dataset_shard("train")
+        ids = []
+        for batch in it.iter_batches(batch_size=8):
+            ids.extend(int(v) for v in batch["id"])
+        report({"ids": ids, "rank": get_context().get_world_rank()})
+
+    ds = rd.range(32, parallelism=4)
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 0.5}),
+        datasets={"train": ds})
+    result = trainer.fit()
+    assert result.error is None
+    history = result.metrics_history
+    assert history, "no reports received"
+    # rank-0 metrics carry rank 0's ids; disjointness checked via count
+    ids0 = history[-1]["ids"]
+    assert len(ids0) == 16 and len(set(ids0)) == 16
