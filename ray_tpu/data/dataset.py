@@ -76,7 +76,17 @@ class Dataset:
         counts = ray_tpu.get([count_remote.remote(r) for r in mat._refs])
         total = sum(counts)
         n = num_blocks
-        size = math.ceil(total / n) if total else 0
+        # Balanced bounds (sizes differ by at most 1): ceil-sized partitions
+        # would leave trailing partitions empty (e.g. 9 rows / 4 parts →
+        # [3,3,3,0]), breaking the every-rank-gets-data invariant SPMD
+        # training shards rely on.
+        base, extra = divmod(total, n)
+        bounds = []
+        lo = 0
+        for j in builtins.range(n):
+            hi = lo + base + (1 if j < extra else 0)
+            bounds.append((lo, hi))
+            lo = hi
         out_refs = []
         if shuffle_seed is None:
             # Plain repartition keeps global row order, so partition j is
@@ -87,8 +97,7 @@ class Dataset:
             for c in counts[:-1]:
                 starts.append(starts[-1] + c)
             remote = ray_tpu.remote(_build_partition_contig)
-            for j in builtins.range(n):
-                lo, hi = j * size, min((j + 1) * size, total)
+            for lo, hi in bounds:
                 sel = [i for i, (s, c) in enumerate(zip(starts, counts))
                        if s < hi and s + c > lo]
                 refs_j = [mat._refs[i] for i in sel]
@@ -101,8 +110,8 @@ class Dataset:
             # rows from (potentially) every input.
             remote = ray_tpu.remote(_build_partition)
             out_refs = [
-                remote.remote(mat._refs, counts, j, n, shuffle_seed)
-                for j in builtins.range(n)
+                remote.remote(mat._refs, counts, lo, hi, shuffle_seed)
+                for lo, hi in bounds
             ]
         return MaterializedDataset(out_refs)
 
@@ -142,7 +151,15 @@ class Dataset:
         return out
 
     def count(self) -> int:
-        return sum(block_mod.block_num_rows(b) for b in self.iter_blocks())
+        # Counting never needs block payloads in the driver: materialize,
+        # then sum row counts via tiny tasks.
+        mat = self.materialize()
+        refs = [r for r in mat._refs if isinstance(r, ray_tpu.ObjectRef)]
+        if len(refs) != len(mat._refs):
+            return sum(block_mod.block_num_rows(b)
+                       for b in mat.iter_blocks())
+        count_remote = ray_tpu.remote(_count_rows)
+        return sum(ray_tpu.get([count_remote.remote(r) for r in refs]))
 
     def schema(self) -> Dict[str, str]:
         for blk in self.iter_blocks():
@@ -200,17 +217,16 @@ def _build_partition_contig(refs: List[Any], counts: List[int],
     return block_mod.concat_blocks(pieces)
 
 
-def _build_partition(refs: List[Any], counts: List[int], j: int, n: int,
+def _build_partition(refs: List[Any], counts: List[int], lo: int, hi: int,
                      shuffle_seed: Optional[int]) -> Block:
-    """Worker-side: assemble output partition j of n from all input blocks
-    (global row ids round-robin or permuted when shuffling)."""
+    """Worker-side: assemble the output rows [lo,hi) of the (optionally
+    permuted) global row order from all input blocks."""
     blocks = ray_tpu.get(list(refs))
     total = sum(counts)
     ids = np.arange(total)
     if shuffle_seed is not None:
         ids = np.random.default_rng(shuffle_seed).permutation(total)
-    size = math.ceil(total / n)
-    mine = ids[j * size:(j + 1) * size]
+    mine = ids[lo:hi]
     mine_sorted = np.sort(mine) if shuffle_seed is None else mine
     # map global row id -> (block, local row)
     starts = np.cumsum([0] + counts[:-1])

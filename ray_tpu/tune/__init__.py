@@ -1,0 +1,21 @@
+"""ray_tpu.tune: trial-based experiment execution (Tune equivalent).
+
+reference parity: python/ray/tune — Tuner/TuneController over the
+Trainable step/save/restore contract, grid+random search, ASHA scheduler,
+per-trial failure retry from checkpoint.
+"""
+
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from ray_tpu.tune.search import (choice, grid_search, loguniform,  # noqa: F401
+                                 randint, uniform)
+from ray_tpu.tune.trainable import (FunctionTrainable, Trainable,  # noqa: F401
+                                    report, wrap_function)
+from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig,  # noqa: F401
+                                TuneRunConfig, Tuner)
+
+__all__ = [
+    "Tuner", "TuneConfig", "TuneRunConfig", "ResultGrid", "TrialResult",
+    "Trainable", "FunctionTrainable", "wrap_function", "report",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "ASHAScheduler", "FIFOScheduler",
+]

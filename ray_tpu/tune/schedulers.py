@@ -1,0 +1,63 @@
+"""Trial schedulers: FIFO (run to stop condition) + ASHA early stopping.
+
+reference parity: python/ray/tune/schedulers/ — FIFOScheduler and
+AsyncHyperBandScheduler/ASHA (async_hyperband.py): rungs at
+grace_period * reduction_factor^k; a trial reaching a rung must be in the
+top 1/reduction_factor of completed results at that rung or it stops.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung milestone -> list of recorded metric values at that rung
+        self._rungs: Dict[int, list] = defaultdict(list)
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self._milestones = milestones
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for milestone in self._milestones:
+            if t == milestone:
+                recorded = self._rungs[milestone]
+                recorded.append(value)
+                ranked = sorted(recorded, reverse=(self.mode == "max"))
+                # Keep the top len//rf (>=1) at this rung; an early arrival
+                # with no peers is promoted optimistically (async ASHA).
+                keep = max(1, len(ranked) // self.rf)
+                if len(ranked) >= self.rf and \
+                        ranked.index(value) >= keep:
+                    decision = STOP
+        return decision

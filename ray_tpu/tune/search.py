@@ -1,0 +1,110 @@
+"""Search spaces + variant generation (grid + random sampling).
+
+reference parity: python/ray/tune/search/ — BasicVariantGenerator
+(search/basic_variant.py) expanding tune.grid_search over the cross
+product and sampling Domain objects (search/sample.py: choice/uniform/
+loguniform/randint) num_samples times.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng: random.Random) -> float:
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+        self._lo, self._hi = math.log(low), math.log(high)
+
+    def sample(self, rng: random.Random) -> float:
+        import math
+        return math.exp(rng.uniform(self._lo, self._hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randrange(self.low, self.high)
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+# -- public space constructors (reference tune.grid_search/choice/...) ----
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(categories)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+class BasicVariantGenerator:
+    """Cross product of grid_search entries × num_samples random draws of
+    Domain entries (reference search/basic_variant.py)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = dict(param_space)
+        self.num_samples = num_samples
+        self._rng = random.Random(seed)
+
+    def variants(self) -> Iterator[Dict[str, Any]]:
+        grid_keys = [k for k, v in self.param_space.items()
+                     if isinstance(v, GridSearch)]
+        grid_values = [self.param_space[k].values for k in grid_keys]
+        has_domains = any(isinstance(v, Domain)
+                          for v in self.param_space.values())
+        repeats = self.num_samples if (has_domains or not grid_keys) else 1
+        for _ in range(repeats):
+            for combo in itertools.product(*grid_values) if grid_keys \
+                    else [()]:
+                cfg: Dict[str, Any] = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    else:
+                        cfg[k] = v
+                yield cfg

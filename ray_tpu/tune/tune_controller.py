@@ -1,0 +1,233 @@
+"""TuneController: drives N trials as actors with retries + scheduling.
+
+reference parity: python/ray/tune/execution/tune_controller.py:73 — the
+event loop owning trial actors: start up to max_concurrent, collect
+results asynchronously, apply scheduler decisions (ASHA stops), retry
+failed trials from their latest checkpoint, persist per-trial state under
+the experiment dir (experiment/trial.py:245 Trial contract).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+
+logger = logging.getLogger(__name__)
+
+PENDING, RUNNING, TERMINATED, ERROR = \
+    "PENDING", "RUNNING", "TERMINATED", "ERROR"
+
+
+class _TrialRunner:
+    """The per-trial actor: hosts one trainable instance."""
+
+    def __init__(self, factory: Callable[[Dict[str, Any]], Any],
+                 config: Dict[str, Any]):
+        self._t = factory(config)
+
+    def ping(self) -> str:
+        return "pong"
+
+    def train(self) -> Dict[str, Any]:
+        return self._t.train()
+
+    def save(self, checkpoint_dir: str) -> str:
+        return self._t.save(checkpoint_dir)
+
+    def restore(self, checkpoint_dir: str) -> None:
+        self._t.restore(checkpoint_dir)
+
+    def stop(self) -> None:
+        self._t.stop()
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    state: str = PENDING
+    actor: Any = None
+    in_flight: Any = None           # ObjectRef of the pending train() call
+    results: List[Dict[str, Any]] = field(default_factory=list)
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    checkpoint_dir: Optional[str] = None
+    num_failures: int = 0
+    num_restores: int = 0
+    error: Optional[BaseException] = None
+    trial_dir: str = ""
+
+    @property
+    def iteration(self) -> int:
+        return self.last_result.get("training_iteration", 0)
+
+
+class TuneController:
+    def __init__(self, factory: Callable[[Dict[str, Any]], Any],
+                 variants: List[Dict[str, Any]], *,
+                 run_dir: str,
+                 stop: Optional[Dict[str, Any]] = None,
+                 scheduler: Optional[Any] = None,
+                 max_concurrent_trials: int = 4,
+                 max_failures_per_trial: int = 1,
+                 checkpoint_frequency: int = 0,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self._factory = factory
+        self._stop = dict(stop or {})
+        self._scheduler = scheduler or FIFOScheduler()
+        self._max_concurrent = max_concurrent_trials
+        self._max_failures = max_failures_per_trial
+        self._ckpt_freq = checkpoint_frequency
+        self._resources = dict(resources_per_trial or {"CPU": 1})
+        self.run_dir = run_dir
+        self.trials = [
+            Trial(trial_id=f"trial_{i:05d}", config=cfg,
+                  trial_dir=os.path.join(run_dir, f"trial_{i:05d}"))
+            for i, cfg in enumerate(variants)
+        ]
+        for t in self.trials:
+            os.makedirs(t.trial_dir, exist_ok=True)
+
+    # -- actor lifecycle ---------------------------------------------------
+
+    def _start_trial(self, trial: Trial, restore: bool = False) -> None:
+        runner_cls = ray_tpu.remote(_TrialRunner)
+        trial.actor = runner_cls.options(**_resource_options(
+            self._resources)).remote(self._factory, trial.config)
+        if restore and trial.checkpoint_dir:
+            ray_tpu.get(trial.actor.restore.remote(trial.checkpoint_dir),
+                        timeout=300)
+            trial.num_restores += 1
+        trial.state = RUNNING
+        trial.in_flight = trial.actor.train.remote()
+
+    def _stop_trial(self, trial: Trial, state: str,
+                    save_final: bool = True) -> None:
+        if trial.actor is not None:
+            try:
+                if save_final and state == TERMINATED:
+                    trial.checkpoint_dir = ray_tpu.get(
+                        trial.actor.save.remote(self._next_ckpt_dir(trial)),
+                        timeout=300)
+                ray_tpu.get(trial.actor.stop.remote(), timeout=60)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:  # noqa: BLE001
+                pass
+        trial.actor = None
+        trial.in_flight = None
+        trial.state = state
+
+    def _next_ckpt_dir(self, trial: Trial) -> str:
+        return os.path.join(trial.trial_dir,
+                            f"checkpoint_{trial.iteration:06d}")
+
+    # -- stop conditions ---------------------------------------------------
+
+    def _should_stop(self, result: Dict[str, Any]) -> bool:
+        if result.get("done"):
+            return True
+        for key, bound in self._stop.items():
+            if key in result and result[key] >= bound:
+                return True
+        return False
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, timeout_s: float = 3600.0) -> List[Trial]:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            # launch pending trials up to the concurrency cap
+            running = [t for t in self.trials if t.state == RUNNING]
+            pending = [t for t in self.trials if t.state == PENDING]
+            for t in pending[:max(0, self._max_concurrent - len(running))]:
+                try:
+                    self._start_trial(t)
+                except Exception as e:  # noqa: BLE001
+                    t.error = e
+                    t.state = ERROR
+            running = [t for t in self.trials if t.state == RUNNING]
+            if not running:
+                break
+            refs = [t.in_flight for t in running]
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=5.0)
+            for ref in ready:
+                trial = next(t for t in running if t.in_flight == ref)
+                self._handle_ready(trial, ref)
+        # Time budget expired: don't leak live actors (they'd keep holding
+        # resources and training forever).
+        for t in self.trials:
+            if t.state == RUNNING:
+                t.error = TimeoutError(
+                    "tune run hit its time budget with this trial running")
+                self._stop_trial(t, ERROR, save_final=False)
+        return self.trials
+
+    def _handle_ready(self, trial: Trial, ref: Any) -> None:
+        try:
+            result = ray_tpu.get(ref)
+        except Exception as e:  # noqa: BLE001
+            self._handle_trial_failure(trial, e)
+            return
+        result.setdefault("trial_id", trial.trial_id)
+        trial.results.append(result)
+        trial.last_result = result
+        if self._ckpt_freq and trial.iteration % self._ckpt_freq == 0:
+            try:
+                trial.checkpoint_dir = ray_tpu.get(
+                    trial.actor.save.remote(self._next_ckpt_dir(trial)),
+                    timeout=300)
+            except Exception:  # noqa: BLE001
+                logger.warning("periodic checkpoint failed for %s",
+                               trial.trial_id, exc_info=True)
+        if self._should_stop(result):
+            self._stop_trial(trial, TERMINATED)
+            return
+        decision = self._scheduler.on_result(trial.trial_id, result)
+        if decision == STOP:
+            logger.info("scheduler stopped %s at iter %d",
+                        trial.trial_id, trial.iteration)
+            self._stop_trial(trial, TERMINATED)
+            return
+        assert decision == CONTINUE
+        trial.in_flight = trial.actor.train.remote()
+
+    def _handle_trial_failure(self, trial: Trial,
+                              error: BaseException) -> None:
+        trial.num_failures += 1
+        if trial.num_failures > self._max_failures:
+            trial.error = error
+            self._stop_trial(trial, ERROR, save_final=False)
+            return
+        logger.warning(
+            "trial %s failed (%d/%d): %r — restarting from %s",
+            trial.trial_id, trial.num_failures, self._max_failures, error,
+            trial.checkpoint_dir or "scratch")
+        try:
+            ray_tpu.kill(trial.actor)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._start_trial(trial, restore=True)
+        except Exception as e:  # noqa: BLE001
+            trial.error = e
+            self._stop_trial(trial, ERROR, save_final=False)
+
+
+def _resource_options(resources: Dict[str, float]) -> Dict[str, Any]:
+    opts: Dict[str, Any] = {}
+    res = dict(resources)
+    if "CPU" in res:
+        opts["num_cpus"] = res.pop("CPU")
+    if "TPU" in res:
+        opts["num_tpus"] = res.pop("TPU")
+    if res:
+        opts["resources"] = res
+    return opts

@@ -1,0 +1,174 @@
+"""Tuner: the public experiment API over TuneController.
+
+reference parity: python/ray/tune/tuner.py:54 (Tuner.fit → ResultGrid)
++ tune/tune.py run(). Accepts a function trainable, a Trainable subclass,
+an rllib AlgorithmConfig (variants merge into .training(**cfg)), or a
+DataParallelTrainer instance (variants merge into train_loop_config).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclass
+class TuneConfig:
+    """reference tune/tune_config.py TuneConfig."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    search_seed: Optional[int] = None
+
+
+@dataclass
+class TuneRunConfig:
+    """Experiment-level config (reference air RunConfig for Tune runs)."""
+
+    name: str = ""
+    storage_path: str = "/tmp/ray_tpu_results"
+    stop: Optional[Dict[str, Any]] = None
+    max_failures_per_trial: int = 1
+    checkpoint_frequency: int = 0
+    resources_per_trial: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1.0})
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    checkpoint_dir: Optional[str]
+    error: Optional[BaseException]
+    state: str
+    num_restores: int = 0
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i: int) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given (TuneConfig.metric or arg)")
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+
+def _make_factory(trainable: Any) -> Callable[[Dict[str, Any]], Any]:
+    """Normalize the four accepted trainable kinds into factory(config)."""
+    from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+    if isinstance(trainable, AlgorithmConfig):
+        base = trainable
+
+        def algo_factory(config: Dict[str, Any]):
+            return base.copy().training(**config).build()
+        return algo_factory
+    from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+    if isinstance(trainable, DataParallelTrainer):
+        return _TrainerTrainableFactory(trainable)
+    if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+        return trainable
+    if callable(trainable):
+        return wrap_function(trainable)
+    raise TypeError(f"unsupported trainable: {trainable!r}")
+
+
+class _TrainerTrainableFactory:
+    """Each trial clones the trainer with the variant merged into
+    train_loop_config and fit()s it once (reference
+    BaseTrainer.as_trainable, base_trainer.py:839)."""
+
+    def __init__(self, trainer: Any):
+        self._trainer = trainer
+
+    def __call__(self, config: Dict[str, Any]):
+        import copy
+
+        trainer = copy.copy(self._trainer)
+        merged = dict(trainer._train_loop_config or {})
+        merged.update(config)
+        trainer._train_loop_config = merged
+
+        class _OneShot(Trainable):
+            def step(inner) -> Dict[str, Any]:
+                result = trainer.fit()
+                if result.error is not None:
+                    raise result.error
+                out = dict(result.metrics)
+                out["done"] = True
+                inner._result = result
+                return out
+
+        return _OneShot(config)
+
+
+class Tuner:
+    def __init__(self, trainable: Any, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[TuneRunConfig] = None):
+        self._trainable = trainable
+        self._param_space = dict(param_space or {})
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or TuneRunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc, rc = self._tune_config, self._run_config
+        name = rc.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
+        run_dir = os.path.join(rc.storage_path, name)
+        os.makedirs(run_dir, exist_ok=True)
+        variants = list(BasicVariantGenerator(
+            self._param_space, num_samples=tc.num_samples,
+            seed=tc.search_seed).variants())
+        controller = TuneController(
+            _make_factory(self._trainable), variants,
+            run_dir=run_dir, stop=rc.stop, scheduler=tc.scheduler,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            max_failures_per_trial=rc.max_failures_per_trial,
+            checkpoint_frequency=rc.checkpoint_frequency,
+            resources_per_trial=rc.resources_per_trial)
+        trials = controller.run()
+        results = [
+            TrialResult(
+                trial_id=t.trial_id, config=t.config,
+                metrics=t.last_result, metrics_history=t.results,
+                checkpoint_dir=t.checkpoint_dir, error=t.error,
+                state=t.state, num_restores=t.num_restores)
+            for t in trials
+        ]
+        return ResultGrid(results, tc.metric, tc.mode)

@@ -1,0 +1,162 @@
+"""Tune: trial controller, searchers, ASHA, failure-retry, PPO sweep.
+
+reference parity: tune/execution/tune_controller.py:73 (trial loop),
+search/basic_variant.py (grid+random), schedulers/async_hyperband.py
+(ASHA), trainable contract (experiment/trial.py:245). The PPO LR sweep
+mirrors the reference pattern Tuner("PPO", param_space=...).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+def test_variant_generator_grid_and_random():
+    space = {"lr": tune.grid_search([0.1, 0.01]),
+             "h": tune.choice([32, 64]),
+             "fixed": "abc"}
+    variants = list(BasicVariantGenerator(space, num_samples=3,
+                                          seed=0).variants())
+    assert len(variants) == 6  # 3 samples x 2 grid values
+    assert all(v["fixed"] == "abc" for v in variants)
+    assert sorted({v["lr"] for v in variants}) == [0.01, 0.1]
+    assert {v["h"] for v in variants} <= {32, 64}
+
+
+def test_function_trainable_grid_sweep(ray_start):
+    def objective(config):
+        for i in range(5):
+            tune.report(score=config["x"] * (i + 1))
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=2),
+        run_config=tune.TuneRunConfig(
+            stop={"training_iteration": 3},
+            resources_per_trial={"CPU": 0.5}))
+    grid = tuner.fit()
+    assert len(grid) == 4 and not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 4
+    assert best.metrics["score"] == 12  # 4 * 3rd iteration
+    assert all(r.state == "TERMINATED" for r in grid)
+
+
+def test_asha_rung_decisions_unit():
+    """Deterministic ASHA semantics: once rf peers sit at a rung, a
+    below-cutoff newcomer stops; an above-cutoff one continues."""
+    s = tune.ASHAScheduler(metric="acc", mode="max", max_t=8,
+                           grace_period=2, reduction_factor=2)
+    # best trial reaches the t=2 rung first (promoted optimistically)
+    assert s.on_result("a", {"acc": 8.0, "training_iteration": 2}) \
+        == "CONTINUE"
+    # worse latecomers at the same rung are cut (keep top 1/2)
+    assert s.on_result("b", {"acc": 2.0, "training_iteration": 2}) == "STOP"
+    assert s.on_result("c", {"acc": 9.0, "training_iteration": 2}) \
+        == "CONTINUE"  # new best continues
+    assert s.on_result("d", {"acc": 3.0, "training_iteration": 2}) == "STOP"
+    # non-milestone iterations never stop
+    assert s.on_result("a", {"acc": 8.0, "training_iteration": 3}) \
+        == "CONTINUE"
+    # reaching max_t stops unconditionally
+    assert s.on_result("a", {"acc": 99.0, "training_iteration": 8}) == "STOP"
+
+
+def test_asha_integration_completes_with_best(ray_start):
+    def objective(config):
+        for i in range(8):
+            tune.report(acc=config["q"] * (i + 1))
+
+    sched = tune.ASHAScheduler(metric="acc", mode="max", max_t=8,
+                               grace_period=2, reduction_factor=2)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=4),
+        run_config=tune.TuneRunConfig(stop={"training_iteration": 8},
+                                      resources_per_trial={"CPU": 0.5}))
+    grid = tuner.fit()
+    assert not grid.errors
+    assert all(r.state == "TERMINATED" for r in grid)
+    # Async arrival order decides who gets cut, but the best q must survive
+    # to a competitive score and win selection.
+    assert grid.get_best_result().config["q"] == 4.0
+
+
+def test_trainable_failure_restores_from_checkpoint(ray_start, tmp_path):
+    marker = str(tmp_path / "crashed_once")
+
+    class Flaky(tune.Trainable):
+        def setup(self, config):
+            self.n = 0
+            self.marker = config["marker"]
+
+        def step(self):
+            self.n += 1
+            if self.n == 4 and not os.path.exists(self.marker):
+                with open(self.marker, "w") as f:
+                    f.write("x")
+                os._exit(1)  # hard-kill the trial actor mid-training
+            return {"n": self.n}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "state"), "w") as f:
+                f.write(str(self.n))
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "state")) as f:
+                self.n = int(f.read())
+
+    tuner = tune.Tuner(
+        Flaky,
+        param_space={"marker": marker},
+        tune_config=tune.TuneConfig(metric="n", mode="max"),
+        run_config=tune.TuneRunConfig(
+            stop={"training_iteration": 6},
+            checkpoint_frequency=2,
+            max_failures_per_trial=2,
+            resources_per_trial={"CPU": 0.5}))
+    grid = tuner.fit()
+    r = grid[0]
+    assert r.error is None and r.state == "TERMINATED"
+    assert r.num_restores == 1, "trial should have restored exactly once"
+    # restored from n=3's checkpoint (freq=2 → checkpoint at n=2), so the
+    # counter continues rather than restarting from zero
+    assert r.metrics["n"] == 6
+
+
+@pytest.mark.slow
+def test_ppo_lr_sweep_with_best_trial(ray_start):
+    """VERDICT item 7's acceptance: a 4-trial PPO LR sweep completes with
+    best-trial selection (param_space merges into AlgorithmConfig
+    .training)."""
+    from ray_tpu.rllib import PPOConfig
+
+    base = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .training(train_batch_size=256, minibatch_size=64, num_epochs=2)
+            .debugging(seed=3))
+    tuner = tune.Tuner(
+        base,
+        param_space={"lr": tune.grid_search([3e-2, 1e-3, 3e-4, 1e-4])},
+        tune_config=tune.TuneConfig(metric="episode_reward_mean",
+                                    mode="max", max_concurrent_trials=2),
+        run_config=tune.TuneRunConfig(stop={"training_iteration": 2},
+                                      resources_per_trial={"CPU": 0.5}))
+    grid = tuner.fit()
+    assert len(grid) == 4 and not grid.errors
+    best = grid.get_best_result()
+    assert best.config["lr"] in (3e-2, 1e-3, 3e-4, 1e-4)
+    assert "episode_reward_mean" in best.metrics
+    assert all(r.checkpoint_dir for r in grid
+               if r.state == "TERMINATED"), "final checkpoints missing"
