@@ -12,17 +12,19 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F
 from ray_tpu.rllib.algorithms.impala.impala import (Impala,  # noqa: F401
                                                     ImpalaConfig)
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
-from ray_tpu.rllib.core.catalog import DiscreteMLPModule  # noqa: F401
+from ray_tpu.rllib.core.catalog import (DiscreteConvModule,  # noqa: F401
+                                        DiscreteMLPModule)
 from ray_tpu.rllib.core.learner import Learner  # noqa: F401
 from ray_tpu.rllib.core.learner_group import LearnerGroup  # noqa: F401
 from ray_tpu.rllib.core.rl_module import RLModule  # noqa: F401
 from ray_tpu.rllib.env.base import Env, make_env, register_env  # noqa: F401
 from ray_tpu.rllib.env import cartpole  # noqa: F401  (registers CartPole-v1)
+from ray_tpu.rllib.env import catch_pixels  # noqa: F401  (CatchPixels-v0)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
     "ImpalaConfig", "Learner", "LearnerGroup", "RLModule",
-    "DiscreteMLPModule", "Env", "register_env", "make_env",
-    "SingleAgentEnvRunner",
+    "DiscreteMLPModule", "DiscreteConvModule", "Env", "register_env",
+    "make_env", "SingleAgentEnvRunner",
 ]

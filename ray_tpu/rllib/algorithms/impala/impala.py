@@ -1,17 +1,23 @@
 """IMPALA: async actor-critic with V-trace off-policy correction.
 
 reference parity: rllib/algorithms/impala/impala.py:68 (ImpalaConfig),
-:559 (Impala), training_step :692-780 — async sample gathering from
-runners with in-flight requests (FaultTolerantActorManager), V-trace
-learner updates, targeted weight sync only to the runners whose batches
-were consumed (:775); ImpalaLearner (impala_learner.py:52).
-Tree-aggregation actors (:1247) are not needed at this scale and the
-mixin replay is left to config.replay_proportion=0 semantics.
+:559 (Impala), training_step :692-780 — async sample gathering with
+bounded in-flight requests per runner (FaultTolerantActorManager),
+fragments buffered up to `train_batch_size`, a background learner thread
+decoupling updates from the sample loop (the reference's learner thread,
+impala.py legacy _LearnerThread / async LearnerGroup updates), mixin
+replay (`replay_proportion` over a bounded slot buffer, reference
+MixInMultiAgentReplayBuffer), and targeted weight sync only to runners
+whose batches were consumed (:775); ImpalaLearner (impala_learner.py:52).
+Tree-aggregation actors (:1247) are not needed at this scale.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import collections
+import queue
+import threading
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -34,6 +40,13 @@ class ImpalaConfig(AlgorithmConfig):
         self.grad_clip = 40.0
         self.max_requests_in_flight_per_env_runner = 2
         self.broadcast_interval = 1
+        # mixin replay (reference impala.py replay_proportion /
+        # replay_buffer_num_slots): ratio of replayed to fresh fragments
+        # mixed into each train batch.
+        self.replay_proportion = 0.0
+        self.replay_buffer_num_slots = 16
+        # bounded learner queue: sampling backpressures on a slow learner
+        self.learner_queue_size = 4
 
 
 class ImpalaLearner(Learner):
@@ -72,9 +85,15 @@ class ImpalaLearner(Learner):
         """Sequence batches update in one full-batch step (the reference
         ImpalaLearner also consumes whole trajectories per update)."""
         assert self._update_fn is not None, "call build() first"
-        self._params, self._opt_state, stats = self._update_fn(
-            self._params, self._opt_state, batch, self.extra_inputs())
+        with self._state_lock:
+            self._params, self._opt_state, stats = self._update_fn(
+                self._params, self._opt_state, batch, self.extra_inputs())
         return {k: float(v) for k, v in stats.items()}
+
+    def data_axis_for(self, key: str) -> int:
+        # time-major [T, B] sequences: the env/batch axis is 1; the
+        # per-sequence bootstrap values are [B].
+        return 0 if key == "bootstrap_value" else 1
 
 
 def _to_timemajor(fragment: Dict[str, Any]) -> Dict[str, np.ndarray]:
@@ -90,70 +109,201 @@ def _to_timemajor(fragment: Dict[str, Any]) -> Dict[str, np.ndarray]:
     }
 
 
+def _concat_fragments(frags: List[Dict[str, np.ndarray]]
+                      ) -> Dict[str, np.ndarray]:
+    """Stack same-T fragments along the batch (env) axis."""
+    out: Dict[str, np.ndarray] = {}
+    for k in frags[0]:
+        axis = 0 if k == "bootstrap_value" else 1
+        out[k] = frags[0][k] if len(frags) == 1 else np.concatenate(
+            [f[k] for f in frags], axis=axis)
+    return out
+
+
 class Impala(Algorithm):
     learner_cls = ImpalaLearner
 
     def __init__(self, config):
         super().__init__(config)
-        self._inflight: Dict[Any, Any] = {}   # ref -> runner actor
+        self._mgr = None                      # built on first async step
+        self._fresh: List[Dict[str, np.ndarray]] = []
+        self._fresh_steps = 0
+        self._replay: collections.deque = collections.deque(
+            maxlen=config.replay_buffer_num_slots)
+        self._replay_rng = np.random.default_rng(config.seed or 0)
+        self._train_queue: "queue.Queue" = queue.Queue(
+            maxsize=config.learner_queue_size)
+        self._learner_thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self._stats_lock = threading.Lock()
+        self._learner_stats: Dict[str, float] = {}
+        self._learner_error: Optional[BaseException] = None
+        self._steps_trained = 0
+        self._last_reported_trained = 0
+        self._weights_version = 0
+        self._synced_version = 0
+        self._touched_ids: set = set()
+
+    # ---- background learner (reference legacy _LearnerThread) --------
+
+    def _ensure_learner_thread(self) -> None:
+        if self._learner_thread is not None:
+            return
+        self._learner_thread = threading.Thread(
+            target=self._learner_loop, daemon=True, name="impala-learner")
+        self._learner_thread.start()
+
+    def _learner_loop(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                batch, steps = self._train_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                stats = self.learner_group.update(batch)
+            except BaseException as e:  # noqa: BLE001
+                self._learner_error = e
+                return
+            with self._stats_lock:
+                self._learner_stats = stats
+                self._steps_trained += steps
+                self._weights_version += 1
+
+    def _assemble_train_batch(self) -> Optional[tuple]:
+        """Once train_batch_size fresh steps accumulated: drain them, mix
+        in replayed fragments per replay_proportion, and return
+        (batch, steps). Shared by the async and sync paths."""
+        cfg = self.config
+        if self._fresh_steps < cfg.train_batch_size:
+            return None
+        frags = list(self._fresh)
+        self._fresh = []
+        steps = self._fresh_steps
+        self._fresh_steps = 0
+        for f in frags:
+            self._replay.append(f)
+        if cfg.replay_proportion > 0 and len(self._replay) > len(frags):
+            n_replay = max(0, round(cfg.replay_proportion * len(frags)))
+            for _ in range(n_replay):
+                f = self._replay[self._replay_rng.integers(
+                    len(self._replay))]
+                frags.append(f)
+                steps += f["actions"].size
+        return _concat_fragments(frags), steps
+
+    def _maybe_enqueue_batch(self) -> int:
+        assembled = self._assemble_train_batch()
+        if assembled is None:
+            return 0
+        batch, steps = assembled
+        # Bounded queue gives sampling backpressure on a slow learner; the
+        # poll loop keeps a dead learner thread from deadlocking us here.
+        while True:
+            if self._learner_error is not None:
+                raise self._learner_error
+            try:
+                self._train_queue.put((batch, steps), timeout=1.0)
+                return steps
+            except queue.Full:
+                continue
+
+    # ---- the training step -------------------------------------------
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         if not self.env_runners.actors:
-            # synchronous degenerate mode (num_env_runners=0)
-            fragments = self.env_runners.sample_sync(
-                cfg.rollout_fragment_length
-                * cfg.num_envs_per_env_runner)
-            self._record_episode_metrics(fragments)
-            stats = {}
-            for f in fragments:
-                self._timesteps_total += f["actions"].size
-                stats = self.learner_group.update(_to_timemajor(f))
-            self.env_runners.sync_weights(
-                self.learner_group.get_weights())
-            return {"learner": stats,
-                    "num_env_steps_trained": sum(
-                        f["actions"].size for f in fragments)}
+            return self._training_step_sync()
 
         import ray_tpu
+        from ray_tpu.util.actor_manager import FaultTolerantActorManager
+
+        if self._learner_error is not None:
+            raise self._learner_error
+        self._ensure_learner_thread()
+        if self._mgr is None:
+            self._mgr = FaultTolerantActorManager(
+                self.env_runners.actors,
+                max_remote_requests_in_flight_per_actor=(
+                    cfg.max_requests_in_flight_per_env_runner),
+                health_probe_method="ping")
         per_request = cfg.rollout_fragment_length \
             * cfg.num_envs_per_env_runner
 
-        # keep every runner saturated with in-flight sample requests
-        # (reference impala.py:692-706 async request management)
-        counts: Dict[int, int] = {}
-        for ref, actor in self._inflight.items():
-            counts[id(actor)] = counts.get(id(actor), 0) + 1
-        for actor in self.env_runners.actors:
-            while counts.get(id(actor), 0) < \
-                    cfg.max_requests_in_flight_per_env_runner:
-                self._inflight[actor.sample.remote(per_request)] = actor
-                counts[id(actor)] = counts.get(id(actor), 0) + 1
-
-        ready, _ = ray_tpu.wait(
-            list(self._inflight), num_returns=1, timeout=60.0)
-        stats: Dict[str, float] = {}
-        trained = 0
-        touched: List[Any] = []
-        for ref in ready:
-            actor = self._inflight.pop(ref)
-            fragment = ray_tpu.get(ref)
+        # keep every healthy runner saturated (reference impala.py:692-706)
+        self._mgr.foreach_actor_async(("sample", (per_request,), None))
+        results = self._mgr.fetch_ready_async_reqs(timeout_seconds=2.0)
+        enqueued = 0
+        for r in results:
+            if not r.ok:
+                continue
+            fragment = r.value
             self._record_episode_metrics([fragment])
             self._timesteps_total += fragment["actions"].size
-            trained += fragment["actions"].size
-            stats = self.learner_group.update(_to_timemajor(fragment))
-            touched.append(actor)
-            # immediately re-request from this runner
-            self._inflight[actor.sample.remote(per_request)] = actor
+            self._fresh.append(_to_timemajor(fragment))
+            self._fresh_steps += fragment["actions"].size
+            self._touched_ids.add(r.actor_id)
+            enqueued += self._maybe_enqueue_batch()
 
-        # targeted weight sync to the runners whose batches were trained
-        # on (reference impala.py:775-780)
-        if touched and self._iteration % cfg.broadcast_interval == 0:
+        # targeted weight sync: only runners that contributed since the
+        # last broadcast, only when the learner produced new weights
+        with self._stats_lock:
+            version = self._weights_version
+            stats = dict(self._learner_stats)
+            trained_total = self._steps_trained
+        # per-iteration delta (PPO-consistent semantics); the lifetime
+        # total is reported separately
+        trained_delta = trained_total - self._last_reported_trained
+        self._last_reported_trained = trained_total
+        if version > self._synced_version and self._touched_ids and \
+                self._iteration % cfg.broadcast_interval == 0:
             weights = self.learner_group.get_weights()
-            ray_tpu.get([a.set_weights.remote(weights) for a in touched],
+            actors = self._mgr.actors()
+            targets = [actors[i] for i in self._touched_ids
+                       if i in actors]
+            ray_tpu.get([a.set_weights.remote(weights) for a in targets],
                         timeout=300)
-        return {"learner": stats, "num_env_steps_trained": trained}
+            self._synced_version = version
+            self._touched_ids.clear()
+        if self._iteration % 10 == 9:
+            self._mgr.probe_unhealthy_actors(timeout_seconds=2.0)
+        return {
+            "learner": stats,
+            "num_env_steps_trained": trained_delta,
+            "num_env_steps_trained_total": trained_total,
+            "num_env_steps_enqueued": enqueued,
+            "learner_queue_depth": self._train_queue.qsize(),
+            "num_healthy_env_runners": self._mgr.num_healthy_actors(),
+        }
+
+    def _training_step_sync(self) -> Dict[str, Any]:
+        """Degenerate num_env_runners=0 mode: local sampling, but still
+        buffered to train_batch_size with mixin replay."""
+        cfg = self.config
+        fragments = self.env_runners.sample_sync(
+            cfg.rollout_fragment_length * cfg.num_envs_per_env_runner)
+        self._record_episode_metrics(fragments)
+        stats: Dict[str, float] = {}
+        trained_delta = 0
+        for f in fragments:
+            self._timesteps_total += f["actions"].size
+            self._fresh.append(_to_timemajor(f))
+            self._fresh_steps += f["actions"].size
+        assembled = self._assemble_train_batch()
+        if assembled is not None:
+            batch, steps = assembled
+            stats = self.learner_group.update(batch)
+            trained_delta = steps
+            with self._stats_lock:
+                self._steps_trained += steps
+            self.env_runners.sync_weights(self.learner_group.get_weights())
+        return {"learner": stats,
+                "num_env_steps_trained": trained_delta,
+                "num_env_steps_trained_total": self._steps_trained}
 
     def stop(self) -> None:
-        self._inflight.clear()
+        self._stop_event.set()
+        if self._learner_thread is not None:
+            self._learner_thread.join(timeout=10)
+        if self._mgr is not None:
+            self._mgr = None
         super().stop()

@@ -72,15 +72,88 @@ class DiscreteMLPModule(RLModule):
         return Categorical(dist_inputs)
 
 
+class DiscreteConvModule(RLModule):
+    """Actor-critic conv net for image observations ([H, W, C] uint8).
+
+    The classic Atari torso (reference models/catalog.py CNN defaults /
+    the Nature-DQN stack used by the Pong tuned examples): conv 32@8s4,
+    64@4s2, 64@3s1 → dense 512 → policy/value heads. Convs map onto the
+    MXU; uint8 pixels are normalized to [0,1] inside the jitted forward
+    so frames cross the object store as compact uint8.
+    """
+
+    CONVS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))  # (out_ch, kernel, stride)
+
+    def __init__(self, obs_shape: Sequence[int], num_actions: int,
+                 dense: int = 512):
+        assert len(obs_shape) == 3, f"need [H,W,C] obs, got {obs_shape}"
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        self.dense = dense
+
+    def _conv_out_size(self) -> int:
+        h, w, _ = self.obs_shape
+        for _, k, s in self.CONVS:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+        return h * w * self.CONVS[-1][0]
+
+    def init_params(self, key) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        keys = jax.random.split(key, len(self.CONVS) + 3)
+        params: Dict[str, Any] = {"convs": []}
+        in_ch = self.obs_shape[-1]
+        for i, (out_ch, k, _s) in enumerate(self.CONVS):
+            fan_in = k * k * in_ch
+            params["convs"].append({
+                "w": (jax.random.normal(keys[i], (k, k, in_ch, out_ch),
+                                        jnp.float32)
+                      * (2.0 / fan_in) ** 0.5),
+                "b": jnp.zeros((out_ch,), jnp.float32),
+            })
+            in_ch = out_ch
+        flat = self._conv_out_size()
+        params["dense"] = _mlp_init(keys[-3], [flat, self.dense],
+                                    scale_last=None)
+        params["pi"] = _mlp_init(keys[-2], [self.dense, self.num_actions])
+        params["vf"] = _mlp_init(keys[-1], [self.dense, 1], scale_last=1.0)
+        return params
+
+    def forward_train(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        x = batch["obs"].astype(jnp.float32) / 255.0
+        for layer, (_out, _k, s) in zip(params["convs"], self.CONVS):
+            x = lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
+            x = jax.nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        h = jax.nn.relu(_mlp_apply(params["dense"], x))
+        logits = _mlp_apply(params["pi"], h)
+        vf = _mlp_apply(params["vf"], h)[..., 0]
+        return {"action_dist_inputs": logits, "vf_preds": vf}
+
+    def action_dist(self, dist_inputs) -> Categorical:
+        return Categorical(dist_inputs)
+
+
 def default_module_for(observation_space, action_space,
                        hiddens: Sequence[int] = (64, 64)) -> RLModule:
     """reference Catalog._get_encoder_config dispatch, reduced to the
     spaces this build ships."""
     if isinstance(action_space, Discrete) and \
-            isinstance(observation_space, Box) and \
-            len(observation_space.shape) == 1:
-        return DiscreteMLPModule(
-            observation_space.shape[0], action_space.n, hiddens)
+            isinstance(observation_space, Box):
+        if len(observation_space.shape) == 1:
+            return DiscreteMLPModule(
+                observation_space.shape[0], action_space.n, hiddens)
+        if len(observation_space.shape) == 3:
+            return DiscreteConvModule(
+                observation_space.shape, action_space.n)
     raise NotImplementedError(
         f"no default module for obs={observation_space} "
         f"act={action_space}; pass a custom RLModule via config.rl_module()")

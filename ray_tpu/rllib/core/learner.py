@@ -13,6 +13,7 @@ gradients; additional_update handles KL-coeff style schedules.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -28,6 +29,11 @@ class Learner:
         self._opt_state = None
         self._optimizer = None
         self._update_fn = None
+        # Serializes updates against weight reads: the jitted update
+        # DONATES the params buffer, so a concurrent device_get (e.g. an
+        # async IMPALA driver syncing weights while the learner thread
+        # trains) would read a deleted array.
+        self._state_lock = threading.Lock()
         # mutable non-jitted state for additional_update (e.g. kl coeff)
         self.curr_kl_coeff = getattr(config, "kl_coeff", 0.0)
 
@@ -62,6 +68,118 @@ class Learner:
 
         self._update_fn = jax.jit(update, donate_argnums=(0, 1))
 
+    # ---- distributed (mesh gang) build ------------------------------
+    def data_axis_for(self, key: str) -> int:
+        """Which axis of a batch column is the data-parallel axis (row
+        batches → 0; time-major IMPALA sequences override to 1)."""
+        return 0
+
+    def build_distributed(self, seed: int = 0) -> None:
+        """Build after jax.distributed.initialize: params/opt replicated
+        over a 'data' mesh spanning every process, batches sharded along
+        the data axis. Gradients all-reduce over ICI because the jitted
+        global-mean loss contracts over the sharded batch axis with
+        replicated params — the DDP-equivalent the reference gets from
+        torch DDP (torch_learner.py:378-390), with XLA inserting the
+        psum instead of a wrapper module."""
+        import jax
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devices = np.array(jax.devices())
+        self._mesh = Mesh(devices, ("data",))
+        self._rep = NamedSharding(self._mesh, P())
+
+        host_params = self.module.init_params(jax.random.PRNGKey(seed))
+
+        def _replicate(x):
+            return jax.make_array_from_callback(
+                np.shape(x), self._rep, lambda idx: np.asarray(x)[idx])
+
+        self._replicate_host = _replicate
+        self._params = jax.tree.map(_replicate, host_params)
+        clip = getattr(self.config, "grad_clip", None)
+        chain = []
+        if clip:
+            chain.append(optax.clip_by_global_norm(clip))
+        chain.append(optax.adam(self.config.lr))
+        self._optimizer = optax.chain(*chain)
+        self._opt_state = jax.tree.map(
+            _replicate, self._optimizer.init(host_params))
+
+        def update(params, opt_state, batch, extra):
+            def loss_wrap(p):
+                return self.compute_loss(p, batch, extra)
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_wrap, has_aux=True)(params)
+            updates, opt_state = self._optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats = dict(stats)
+            stats["total_loss"] = loss
+            stats["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, stats
+
+        self._update_fn = jax.jit(
+            update, donate_argnums=(0, 1),
+            out_shardings=(self._rep, self._rep, self._rep))
+        self._distributed = True
+
+    def _make_global_batch(self, local: Dict[str, np.ndarray]
+                           ) -> Dict[str, Any]:
+        """Process-local shard → global jax.Arrays sharded on 'data'."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = {}
+        for k, v in local.items():
+            axis = self.data_axis_for(k)
+            spec = P(*([None] * axis), "data")
+            out[k] = jax.make_array_from_process_local_data(
+                NamedSharding(self._mesh, spec), np.asarray(v))
+        return out
+
+    def update_distributed(self, local_batch: Dict[str, np.ndarray],
+                           minibatch_size: Optional[int] = None,
+                           num_iters: int = 1,
+                           seed: int = 0) -> Dict[str, float]:
+        """DDP-style minibatch SGD: every process runs the SAME number of
+        jitted steps (collectives wedge otherwise); each step's global
+        minibatch is the union of per-process local samples."""
+        import jax
+
+        first = next(iter(local_batch))
+        axis = self.data_axis_for(first)
+        n = local_batch[first].shape[axis]
+        nprocs = max(1, jax.process_count())
+        local_mb = max(1, (minibatch_size or n * nprocs) // nprocs)
+        rng = np.random.default_rng(seed)
+        stats: Dict[str, Any] = {}
+        count = 0
+        for _ in range(num_iters):
+            perm = rng.permutation(n)
+            for start in range(0, n - local_mb + 1, local_mb):
+                idx = perm[start:start + local_mb]
+                mb = {k: np.take(v, idx, axis=self.data_axis_for(k))
+                      for k, v in local_batch.items()}
+                gb = self._make_global_batch(mb)
+                with self._state_lock:
+                    self._params, self._opt_state, st = self._update_fn(
+                        self._params, self._opt_state, gb,
+                        self.extra_inputs())
+                count += 1
+                for k, v in st.items():
+                    stats[k] = stats.get(k, 0.0) + float(v)
+        if count == 0:  # batch smaller than one minibatch: single step
+            gb = self._make_global_batch(local_batch)
+            with self._state_lock:
+                self._params, self._opt_state, st = self._update_fn(
+                    self._params, self._opt_state, gb, self.extra_inputs())
+            count = 1
+            stats = {k: float(v) for k, v in st.items()}
+        return {k: v / count for k, v in stats.items()}
+
     # ---- algorithm contract ----------------------------------------
     def compute_loss(self, params, batch: Dict[str, Any],
                      extra: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
@@ -94,9 +212,10 @@ class Learner:
                 if len(idx) < minibatch_size and count > 0:
                     continue  # drop ragged tail (keeps jit shapes stable)
                 mb = {k: v[idx] for k, v in batch.items()}
-                self._params, self._opt_state, st = self._update_fn(
-                    self._params, self._opt_state, mb,
-                    self.extra_inputs())
+                with self._state_lock:
+                    self._params, self._opt_state, st = self._update_fn(
+                        self._params, self._opt_state, mb,
+                        self.extra_inputs())
                 count += 1
                 for k, v in st.items():
                     stats[k] = stats.get(k, 0.0) + float(v)
@@ -105,18 +224,35 @@ class Learner:
     # ---- weights ----------------------------------------------------
     def get_weights(self):
         import jax
-        return jax.device_get(self._params)
+        with self._state_lock:
+            return jax.device_get(self._params)
 
     def set_weights(self, weights) -> None:
-        self._params = weights
+        with self._state_lock:
+            if getattr(self, "_distributed", False):
+                # Host pytrees must be re-laid-out as replicated global
+                # arrays or the jitted update would see mixed shardings.
+                import jax
+                self._params = jax.tree.map(self._replicate_host, weights)
+            else:
+                self._params = weights
 
     def get_state(self) -> Dict[str, Any]:
         import jax
-        return {"params": jax.device_get(self._params),
-                "opt_state": jax.device_get(self._opt_state),
-                "kl_coeff": self.curr_kl_coeff}
+        with self._state_lock:
+            return {"params": jax.device_get(self._params),
+                    "opt_state": jax.device_get(self._opt_state),
+                    "kl_coeff": self.curr_kl_coeff}
 
     def set_state(self, state: Dict[str, Any]) -> None:
-        self._params = state["params"]
-        self._opt_state = state["opt_state"]
-        self.curr_kl_coeff = state.get("kl_coeff", self.curr_kl_coeff)
+        with self._state_lock:
+            if getattr(self, "_distributed", False):
+                import jax
+                self._params = jax.tree.map(self._replicate_host,
+                                            state["params"])
+                self._opt_state = jax.tree.map(self._replicate_host,
+                                               state["opt_state"])
+            else:
+                self._params = state["params"]
+                self._opt_state = state["opt_state"]
+            self.curr_kl_coeff = state.get("kl_coeff", self.curr_kl_coeff)

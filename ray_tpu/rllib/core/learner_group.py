@@ -1,24 +1,95 @@
-"""LearnerGroup: one local learner or a gang of learner actors.
+"""LearnerGroup: one local learner or a mesh-coupled gang of learner actors.
 
 reference parity: rllib/core/learner/learner_group.py:63 — local mode
 (num_learners=0, learner in-process: the CartPole north-star config) or
-remote mode where learner actors are spawned over Train's worker-group
-machinery (learner_group.py:103-115 reuses BackendExecutor) and updates
-run data-parallel. The reference syncs gradients with torch DDP
-(torch_learner.py:378-390); here remote learners each update on their
-batch shard and the group averages the resulting *weights* host-side
-each round (equivalent to averaged-gradient DDP for equal shards under
-linear optimizers, and the standard host-RAM path for CPU learners —
-on a TPU pod the learners instead share one ICI mesh via
-jax.distributed, where psum rides the interconnect, see
-ray_tpu.train.JaxConfig).
+remote mode where learner actors form a jax.distributed process group
+exactly as the reference LearnerGroup reuses Train's BackendExecutor to
+build a torch process group (learner_group.py:103-115). Gradients sync
+through XLA collectives over the shared 'data' mesh (the DDP-allreduce
+equivalent of torch_learner.py:378-390) — every learner holds identical
+replicated params after every step, so there is no unsound weight
+averaging and Adam semantics match single-learner training exactly.
+On TPU pods each learner process contributes its chips and the psum
+rides ICI; in chip-free CI the same code runs over multi-process CPU.
 """
 
 from __future__ import annotations
 
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+
+class _MeshLearnerActor:
+    """One rank of the learner gang; must run in a fresh worker process
+    (jax.distributed can only initialize before any other jax use, which
+    the gang's unique runtime-env pool key guarantees)."""
+
+    def __init__(self, factory: Callable[[], Any], coordinator: str,
+                 world: int, rank: int, seed: int):
+        import os
+
+        import jax
+        # Honor an explicit platform pin (the chip-free test ladder sets
+        # JAX_PLATFORMS=cpu): device plugins can re-assert themselves over
+        # the env var, so pin through jax.config like tests/conftest.py.
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=world, process_id=rank)
+        self.rank = rank
+        self.world = world
+        self.learner = factory()
+        self.learner.build_distributed(seed=seed)
+
+    def ping(self) -> str:
+        return "pong"
+
+    def _local_shard(self, batch: Dict[str, np.ndarray]
+                     ) -> Dict[str, np.ndarray]:
+        """Equal per-rank slices along each column's data axis (truncating
+        the remainder so every rank runs identical jit step counts)."""
+        first = next(iter(batch))
+        axis = self.learner.data_axis_for(first)
+        n = batch[first].shape[axis]
+        per = n // self.world
+        out = {}
+        for k, v in batch.items():
+            a = self.learner.data_axis_for(k)
+            sl = [slice(None)] * v.ndim
+            sl[a] = slice(self.rank * per, (self.rank + 1) * per)
+            out[k] = v[tuple(sl)]
+        return out
+
+    def update(self, batch, minibatch_size, num_iters, seed):
+        return self.learner.update_distributed(
+            self._local_shard(batch), minibatch_size, num_iters, seed)
+
+    def additional_update(self, **kw):
+        return self.learner.additional_update(**kw)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, w):
+        self.learner.set_weights(w)
+
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, s):
+        self.learner.set_state(s)
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 class LearnerGroup:
@@ -29,42 +100,39 @@ class LearnerGroup:
             self._local = learner_factory()
             self._local.build(seed=seed)
             self._actors: List[Any] = []
-        else:
-            import ray_tpu
+            return
+        import ray_tpu
 
-            @ray_tpu.remote
-            class LearnerActor:
-                def __init__(self, factory, seed):
-                    self.learner = factory()
-                    self.learner.build(seed=seed)
-
-                def update(self, batch, minibatch_size, num_iters, seed):
-                    return self.learner.update(
-                        batch, minibatch_size, num_iters, seed)
-
-                def additional_update(self, **kw):
-                    return self.learner.additional_update(**kw)
-
-                def get_weights(self):
-                    return self.learner.get_weights()
-
-                def set_weights(self, w):
-                    self.learner.set_weights(w)
-
-                def get_state(self):
-                    return self.learner.get_state()
-
-                def set_state(self, s):
-                    self.learner.set_state(s)
-
-            self._local = None
-            self._actors = [LearnerActor.options(num_cpus=1).remote(
-                learner_factory, seed) for _ in range(num_learners)]
-            # all replicas must start from identical weights
-            import ray_tpu as rt
-            w0 = rt.get(self._actors[0].get_weights.remote(), timeout=120)
-            rt.get([a.set_weights.remote(w0) for a in self._actors[1:]],
-                   timeout=120)
+        self._local = None
+        # Fresh worker processes for the gang: the unique runtime-env key
+        # gives them their own worker-pool bucket, so jax.distributed
+        # initializes before any other jax use in those processes.
+        # One host (CPU) device per gang process: the virtual-device test
+        # flag (--xla_force_host_platform_device_count=8) would otherwise
+        # leak in and force per-process shard sizes to be divisible by 8.
+        # Preserve any other XLA_FLAGS the operator set (TPU tuning flags
+        # etc.) — only the host-device-count flag is replaced.
+        import os
+        import re
+        flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "",
+                       os.environ.get("XLA_FLAGS", "")).strip()
+        gang_env = {"env_vars": {
+            "RAY_TPU_LEARNER_GANG": uuid.uuid4().hex,
+            "XLA_FLAGS": (flags + " "
+                          "--xla_force_host_platform_device_count=1"
+                          ).strip(),
+        }}
+        coordinator = f"127.0.0.1:{_free_port()}"
+        actor_cls = ray_tpu.remote(_MeshLearnerActor)
+        self._actors = [
+            actor_cls.options(num_cpus=1, runtime_env=gang_env).remote(
+                learner_factory, coordinator, num_learners, rank, seed)
+            for rank in range(num_learners)
+        ]
+        # Barrier on gang readiness (rank 0 hosts the coordinator; all
+        # ranks block in jax.distributed.initialize until every peer is
+        # up — mirror of the reference's process-group rendezvous).
+        ray_tpu.get([a.ping.remote() for a in self._actors], timeout=300)
 
     def __len__(self) -> int:
         return max(1, self._num_learners)
@@ -76,21 +144,14 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.update(batch, minibatch_size, num_iters,
                                       seed)
-        import jax
         import ray_tpu
-
-        shards = _shard_batch(batch, len(self._actors))
+        # Same full batch + same seed to every rank: each slices its own
+        # equal shard and all ranks enter the jitted collective step the
+        # same number of times.
         stats = ray_tpu.get([
-            a.update.remote(s, minibatch_size, num_iters, seed + i)
-            for i, (a, s) in enumerate(zip(self._actors, shards))
+            a.update.remote(batch, minibatch_size, num_iters, seed)
+            for a in self._actors
         ], timeout=600)
-        # average replica weights (see module docstring)
-        weights = ray_tpu.get(
-            [a.get_weights.remote() for a in self._actors], timeout=600)
-        mean_w = jax.tree.map(
-            lambda *xs: np.mean(np.stack(xs), axis=0), *weights)
-        ray_tpu.get([a.set_weights.remote(mean_w) for a in self._actors],
-                    timeout=600)
         return {k: float(np.mean([s[k] for s in stats]))
                 for k in stats[0]}
 
@@ -141,10 +202,3 @@ class LearnerGroup:
             except Exception:  # noqa: BLE001
                 pass
         self._actors = []
-
-
-def _shard_batch(batch: Dict[str, np.ndarray], n: int
-                 ) -> List[Dict[str, np.ndarray]]:
-    size = len(batch["obs"])
-    idx = np.array_split(np.arange(size), n)
-    return [{k: v[i] for k, v in batch.items()} for i in idx]

@@ -56,6 +56,10 @@ class SingleAgentEnvRunner:
         self._ep_len = np.zeros(self.env.num_envs, np.int64)
         self._completed: List[Dict[str, float]] = []
 
+    def ping(self) -> str:
+        """Health probe for FaultTolerantActorManager."""
+        return "pong"
+
     # ---- weight sync (reference worker_set.py:365 sync_weights) -----
     def set_weights(self, weights) -> None:
         self.params = weights

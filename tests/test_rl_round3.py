@@ -1,0 +1,229 @@
+"""Round-3 RL additions: image env + conv nets, async IMPALA, mesh gang.
+
+reference parity: atari_wrappers [84,84,4] contract (env/wrappers/),
+Nature-CNN catalog defaults (models/catalog.py), IMPALA async pipeline
+with learner thread + mixin replay (impala.py:692-780), DDP-equivalent
+learner gang (core/learner/learner_group.py:103-115 +
+torch_learner.py:378-390).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib import (DiscreteConvModule, ImpalaConfig, PPOConfig,
+                           make_env)
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+
+
+class TestCatchPixels:
+    def test_atari_tensor_contract(self):
+        env = make_env("CatchPixels-v0")
+        obs, _ = env.reset(seed=0)
+        assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+        assert env.action_space.n == 3
+        obs, r, term, trunc, _ = env.step(1)
+        assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+        assert not term and r == 0.0
+
+    def test_catchable_and_missable(self):
+        env = make_env("CatchPixels-v0")
+        env.reset(seed=1)
+        ball_col = env._ball_col
+        # walk the paddle onto the ball column, then stay
+        total = 0.0
+        for _ in range(6):
+            delta = np.sign(ball_col - env._paddle)
+            _, r, term, _, _ = env.step(int(delta) + 1)
+            total += r
+            if term:
+                break
+        assert term and total == 1.0
+        # deliberately running away misses
+        env.reset(seed=1)
+        away = 0 if env._ball_col >= env._paddle else 2
+        for _ in range(6):
+            _, r, term, _, _ = env.step(away)
+            if term:
+                break
+        assert term and r == -1.0
+
+
+class TestConvModule:
+    def test_forward_shapes_uint8(self):
+        mod = DiscreteConvModule((84, 84, 4), 3)
+        params = mod.init_params(jax.random.PRNGKey(0))
+        obs = jnp.zeros((5, 84, 84, 4), jnp.uint8)
+        out = mod.forward_train(params, {"obs": obs})
+        assert out["action_dist_inputs"].shape == (5, 3)
+        assert out["vf_preds"].shape == (5,)
+        exp = mod.forward_exploration(params, {"obs": obs},
+                                      jax.random.PRNGKey(1))
+        assert exp["actions"].shape == (5,)
+
+    def test_default_catalog_picks_conv(self):
+        from ray_tpu.rllib.core.catalog import default_module_for
+        env = make_env("CatchPixels-v0")
+        mod = default_module_for(env.observation_space, env.action_space)
+        assert isinstance(mod, DiscreteConvModule)
+
+
+class TestMeshLearnerGang:
+    def test_full_batch_update_matches_local(self, ray_start):
+        """DDP equivalence: one full-batch step on a 2-rank mesh gang
+        produces the same weights as a single local learner (up to fp32
+        reduction-order noise)."""
+        from ray_tpu.rllib.algorithms.ppo.ppo import PPOLearner
+        from ray_tpu.rllib.core.catalog import DiscreteMLPModule
+
+        cfg = (PPOConfig().environment("CartPole-v1")
+               .training(train_batch_size=128))
+        module = DiscreteMLPModule(4, 2)
+
+        def factory():
+            return PPOLearner(module, cfg)
+
+        rng = np.random.default_rng(0)
+        batch = {
+            "obs": rng.standard_normal((128, 4)).astype(np.float32),
+            "actions": rng.integers(0, 2, 128),
+            "advantages": rng.standard_normal(128).astype(np.float32),
+            "value_targets": rng.standard_normal(128).astype(np.float32),
+            "action_logp": np.full(128, -0.69, np.float32),
+            "vf_preds": np.zeros(128, np.float32),
+        }
+        local = LearnerGroup(factory, num_learners=0, seed=5)
+        s_local = local.update(dict(batch), minibatch_size=None,
+                               num_iters=1, seed=0)
+        w_local = local.get_weights()
+
+        gang = LearnerGroup(factory, num_learners=2, seed=5)
+        try:
+            s_gang = gang.update(dict(batch), minibatch_size=None,
+                                 num_iters=1, seed=0)
+            w_gang = gang.get_weights()
+            assert abs(s_local["total_loss"] - s_gang["total_loss"]) < 1e-3
+            diffs = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda a, b: float(np.max(np.abs(
+                    np.asarray(a) - np.asarray(b)))), w_local, w_gang))
+            assert max(diffs) < 1e-3, f"gang diverged from DDP: {max(diffs)}"
+        finally:
+            gang.shutdown()
+
+    def test_minibatch_updates_learn(self, ray_start):
+        """Minibatched mesh updates drive the loss down on a fixed
+        supervised-ish objective."""
+        from ray_tpu.rllib.algorithms.ppo.ppo import PPOLearner
+        from ray_tpu.rllib.core.catalog import DiscreteMLPModule
+
+        cfg = (PPOConfig().environment("CartPole-v1")
+               .training(train_batch_size=128, lr=5e-3))
+        module = DiscreteMLPModule(4, 2)
+        gang = LearnerGroup(lambda: PPOLearner(module, cfg),
+                            num_learners=2, seed=1)
+        try:
+            rng = np.random.default_rng(1)
+            obs = rng.standard_normal((128, 4)).astype(np.float32)
+            batch = {
+                "obs": obs,
+                "actions": (obs[:, 0] > 0).astype(np.int64),
+                "advantages": np.ones(128, np.float32),
+                "value_targets": np.zeros(128, np.float32),
+                "action_logp": np.full(128, -0.69, np.float32),
+                "vf_preds": np.zeros(128, np.float32),
+            }
+            losses = [gang.update(dict(batch), minibatch_size=64,
+                                  num_iters=1, seed=i)["policy_loss"]
+                      for i in range(8)]
+            assert losses[-1] < losses[0], losses
+        finally:
+            gang.shutdown()
+
+
+class TestAsyncImpala:
+    def test_async_pipeline_trains(self, ray_start):
+        """Async mode: fragments buffer to train_batch_size, the
+        background learner consumes them, weights version-sync to the
+        contributing runners."""
+        config = (ImpalaConfig()
+                  .environment("CartPole-v1")
+                  .env_runners(num_env_runners=2,
+                               num_envs_per_env_runner=2,
+                               rollout_fragment_length=16)
+                  .training(train_batch_size=128, lr=5e-4,
+                            replay_proportion=0.5,
+                            replay_buffer_num_slots=8)
+                  .debugging(seed=0))
+        algo = config.build()
+        try:
+            deadline = time.time() + 120
+            trained = 0
+            while time.time() < deadline and trained == 0:
+                result = algo.train()
+                trained = result.get("num_env_steps_trained", 0)
+                assert result.get("learner_queue_depth", 0) <= \
+                    config.learner_queue_size
+            assert trained > 0, "background learner never trained a batch"
+            assert result["num_healthy_env_runners"] == 2
+        finally:
+            algo.stop()
+
+
+@pytest.mark.slow
+class TestLearning:
+    def test_impala_cartpole_mesh_learners(self, ray_start):
+        """VERDICT item 4 acceptance: IMPALA CartPole with mesh-coupled
+        learners reaches reward >= 150."""
+        config = (ImpalaConfig()
+                  .environment("CartPole-v1")
+                  .env_runners(num_env_runners=2,
+                               num_envs_per_env_runner=4,
+                               rollout_fragment_length=32)
+                  .training(train_batch_size=512, lr=5e-3,
+                            entropy_coeff=0.003,
+                            vf_loss_coeff=0.25)
+                  .learners(num_learners=2)
+                  .debugging(seed=0))
+        algo = config.build()
+        try:
+            best = -np.inf
+            deadline = time.time() + 900
+            while time.time() < deadline:
+                result = algo.train()
+                reward = result.get("episode_reward_mean", -np.inf)
+                best = max(best, reward)
+                if best >= 150:
+                    break
+            assert best >= 150, f"IMPALA plateaued at {best}"
+        finally:
+            algo.stop()
+
+    def test_ppo_catch_pixels_learns(self, ray_start):
+        """Conv-net PPO on the image env: reward climbs well above the
+        random-play baseline (≈ -0.7)."""
+        config = (PPOConfig()
+                  .environment("CatchPixels-v0")
+                  .env_runners(num_env_runners=0,
+                               num_envs_per_env_runner=8,
+                               rollout_fragment_length=64)
+                  .training(train_batch_size=512, minibatch_size=128,
+                            num_epochs=4, lr=5e-4, entropy_coeff=0.01,
+                            vf_clip_param=10000.0)
+                  .debugging(seed=0))
+        algo = config.build()
+        try:
+            best = -np.inf
+            deadline = time.time() + 900
+            while time.time() < deadline:
+                result = algo.train()
+                best = max(best, result.get("episode_reward_mean", -np.inf))
+                if best >= 0.8:
+                    break
+            assert best >= 0.3, f"PPO on pixels plateaued at {best}"
+        finally:
+            algo.stop()
