@@ -1,0 +1,119 @@
+"""Mixture-of-Experts FFN with expert-parallel dispatch.
+
+The reference has no in-tree MoE/expert parallelism (SURVEY.md §2.4 "EP:
+Absent"); this is the TPU-native capability filling that row: a
+Switch/GShard-style top-k router with bounded expert capacity, dispatch
+and combine expressed as einsums over an [tokens, experts, capacity]
+one-hot — the formulation GSPMD partitions cleanly over the "expert" mesh
+axis (the einsums lower to all-to-alls on ICI), per the public MoE
+sharding pattern (PAPERS.md / scaling-book; patterns only).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.parallel.sharding import with_logical_constraint
+
+# Logical specs for shard_pytree / make_train_step param placement.
+MOE_PARAM_SPECS = {
+    "w_router": ("embed", None),
+    "w_up": ("expert", "embed", "mlp"),
+    "w_down": ("expert", "mlp", "embed"),
+}
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int
+                    ) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = (2.0 / d_model) ** 0.5
+    scale_out = (2.0 / d_ff) ** 0.5
+    return {
+        "w_router": jax.random.normal(
+            k1, (d_model, n_experts), jnp.float32) * 0.02,
+        "w_up": jax.random.normal(
+            k2, (n_experts, d_model, d_ff), jnp.float32) * scale_in,
+        "w_down": jax.random.normal(
+            k3, (n_experts, d_ff, d_model), jnp.float32) * scale_out,
+    }
+
+
+def moe_ffn(params: Dict[str, Any], x, *, num_selected: int = 2,
+            capacity_factor: float = 1.25,
+            rules: Optional[Any] = None) -> Tuple[Any, Any]:
+    """Top-k routed expert FFN.
+
+    x: [tokens, d_model] (flatten [B,T,D] before calling). Returns
+    (y [tokens, d_model], aux_loss scalar) where aux_loss is the standard
+    load-balancing loss (mean router prob × mean dispatch fraction × E).
+    Tokens over a full expert's capacity are dropped (contribute zero) —
+    the Switch capacity contract.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_tokens, d_model = x.shape
+    n_experts = params["w_router"].shape[1]
+    k = min(num_selected, n_experts)
+    capacity = max(1, int(capacity_factor * n_tokens * k / n_experts))
+
+    logits = x @ params["w_router"]                     # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    # top-k selection per token
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)       # [N, k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+
+    # Position of each token within its expert's capacity buffer, per
+    # selection slot (cumsum over tokens of the one-hot selection).
+    onehot = jax.nn.one_hot(gate_idx, n_experts, dtype=jnp.float32)
+    # [k, N, E] cumulative counts: slot 0 fills first, then slot 1, ...
+    sel = jnp.swapaxes(onehot, 0, 1)                    # [k, N, E]
+    flat = sel.reshape(k * n_tokens, n_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat          # [k*N, E]
+    pos = pos_flat.reshape(k, n_tokens, n_experts)
+    within = (pos < capacity)
+    keep = jnp.swapaxes((sel * within), 0, 1)           # [N, k, E]
+    pos_k = jnp.swapaxes((pos * sel).sum(-1), 0, 1)     # [N, k]
+
+    # dispatch [N, E, C] / combine [N, E, C]
+    cap_onehot = jax.nn.one_hot(pos_k.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)
+    dispatch = jnp.einsum("nke,nkc->nec", keep, cap_onehot)
+    combine = jnp.einsum("nke,nkc,nk->nec", keep, cap_onehot, gate_vals)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)  # [E, C, D]
+    expert_in = with_logical_constraint(
+        expert_in, ("expert", None, None), rules=rules)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"]))
+    h = with_logical_constraint(h, ("expert", None, "act_mlp"), rules=rules)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])
+    y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+
+    # load-balancing aux loss (Switch eq. 4): E * mean_frac · mean_prob
+    frac = dispatch.sum(axis=(0, 2)) / jnp.maximum(n_tokens * k, 1)
+    mean_prob = probs.mean(axis=0)
+    aux_loss = n_experts * jnp.sum(frac * mean_prob)
+    return y, aux_loss
+
+
+def moe_ffn_dense_reference(params: Dict[str, Any], x, *,
+                            num_selected: int = 2):
+    """Un-capacitated dense check: every token runs every selected expert
+    (no drops). Used by tests to validate the dispatch math."""
+    import jax
+    import jax.numpy as jnp
+
+    probs = jax.nn.softmax(x @ params["w_router"], axis=-1)
+    k = min(num_selected, params["w_router"].shape[1])
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+    h = jax.nn.gelu(jnp.einsum("nd,edf->nef", x, params["w_up"]))
+    all_out = jnp.einsum("nef,efd->ned", h, params["w_down"])
+    gates = jnp.zeros(probs.shape).at[
+        jnp.arange(x.shape[0])[:, None], gate_idx].set(gate_vals)
+    return jnp.einsum("ne,ned->nd", gates, all_out)

@@ -1,0 +1,102 @@
+"""Pipeline parallelism: microbatched SPMD pipeline over the "pipe" axis.
+
+The reference has no in-tree pipeline engine (SURVEY.md §2.4 "PP:
+Absent"); this fills that row TPU-natively. Instead of a torch-style
+scheduler object issuing forward/backward ops per rank, the whole
+pipeline is ONE spmd program: stage params are sharded over the "pipe"
+mesh axis, the forward is a fori_loop whose per-tick activation hand-off
+is a lax.ppermute ring shift, and jax AD differentiates through the loop
+— the reversed ppermutes ARE the backward pipeline, and XLA schedules
+both (the compiler-scheduled equivalent of a hand-written 1F1B; same
+math, same per-stage memory scaling in n_micro).
+
+Cost model: T = n_micro + n_stages - 1 ticks; every stage computes every
+tick, so utilization is n_micro / T — the standard pipeline bubble.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ray_tpu.parallel.mesh import AXIS_PIPE
+
+
+def make_pipeline_fn(stage_fn: Callable[[Any, Any], Any],
+                     n_stages: int, n_micro: int, mesh,
+                     loss_fn: Optional[Callable[[Any, Any], Any]] = None):
+    """Build pipelined(params_stacked, x_micro, y_micro) -> mean loss.
+
+    stage_fn(stage_params, x) -> x'   (one stage's chunk of layers)
+    params_stacked: pytree whose leaves have leading dim n_stages (the
+    "layers"→"pipe" sharded stack). x_micro: [n_micro, mb, ...] inputs.
+    loss_fn(final_out, y) -> per-microbatch scalar (required: the
+    pipeline's product is the scalar objective to differentiate; per-
+    microbatch outputs never leave the last stage). The mean over
+    microbatches is returned, identical to running the unpipelined model.
+    """
+    if loss_fn is None:
+        raise ValueError("make_pipeline_fn requires loss_fn: the pipeline "
+                         "returns the differentiable scalar objective")
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_stage(params, x_micro, y_micro):
+        # params: this stage's pytree (leading stage dim stripped by
+        # shard_map's P(AXIS_PIPE, ...) spec → local leaves [1, ...]).
+        params = jax.tree.map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(AXIS_PIPE)
+        n_ticks = n_micro + n_stages - 1
+        mb_shape = x_micro.shape[1:]
+
+        def tick(t, carry):
+            buf, losses = carry
+            # stage 0 ingests microbatch t (garbage after the last one —
+            # masked out because its results fall past the drain window)
+            feed = x_micro[jnp.minimum(t, n_micro - 1)]
+            inp = jnp.where(stage == 0, feed, buf)
+            out = stage_fn(params, inp)
+            # last stage finishes microbatch m = t - (n_stages - 1)
+            if loss_fn is not None:
+                m = t - (n_stages - 1)
+                valid = jnp.logical_and(stage == n_stages - 1,
+                                        jnp.logical_and(m >= 0,
+                                                        m < n_micro))
+                y = y_micro[jnp.clip(m, 0, n_micro - 1)]
+                step_loss = jnp.where(valid, loss_fn(out, y), 0.0)
+                losses = losses + step_loss
+            nxt = jax.lax.ppermute(out, AXIS_PIPE, fwd_perm)
+            return (nxt, losses)
+
+        del mb_shape
+        # carry shape/dtype comes from one dry stage application
+        buf0 = stage_fn(params, x_micro[0]) * 0.0
+        losses0 = jnp.zeros(())
+        buf, losses = jax.lax.fori_loop(0, n_ticks, tick, (buf0, losses0))
+        # total loss lives on the last stage; share it with every stage
+        total = jax.lax.psum(losses, AXIS_PIPE) / n_micro
+        return total[None]
+
+    pipelined = shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(P(AXIS_PIPE), P(), P()),
+        out_specs=P(AXIS_PIPE),
+        check_rep=False)
+
+    def run(params_stacked, x_micro, y_micro):
+        out = pipelined(params_stacked, x_micro, y_micro)
+        return out.mean()  # identical replicated per-stage values
+
+    return run
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """[stage0_tree, stage1_tree, ...] → one tree with leading stage dim
+    (shard it ("layers", ...) → pipe)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

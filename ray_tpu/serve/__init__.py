@@ -1,0 +1,18 @@
+"""ray_tpu.serve: online model serving (Serve equivalent).
+
+reference parity: python/ray/serve — deployments reconciled by a
+controller actor (serve/_private/controller.py:87, deployment_state
+.py:1149), power-of-two-choices routing (router.py:290,893), per-node
+HTTP ingress (proxy.py:122), queue-depth autoscaling
+(autoscaling_policy.py). Scaled to this runtime: one controller actor,
+replica actors with in-flight accounting, a threaded HTTP proxy actor.
+"""
+
+from ray_tpu.serve.api import (Application, Deployment,  # noqa: F401
+                               DeploymentHandle, delete, deployment,
+                               get_handle, run, shutdown, start_http)
+
+__all__ = [
+    "deployment", "Deployment", "Application", "DeploymentHandle",
+    "run", "get_handle", "delete", "shutdown", "start_http",
+]

@@ -1,0 +1,208 @@
+"""Serve public API: @deployment, run, handles, HTTP ingress.
+
+reference parity: python/ray/serve/api.py (serve.deployment / serve.run)
++ handle API (serve/handle.py). The controller is a named actor; handles
+resolve replica sets through it and route power-of-two-choices
+(reference router.py:893 PowerOfTwoChoicesReplicaScheduler).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import (CONTROLLER_NAME, ServeController,
+                                      replica_ping)
+
+_NAMESPACE = "serve"
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+    except Exception:  # noqa: BLE001 - not running yet
+        pass
+    cls = ray_tpu.remote(ServeController)
+    try:
+        return cls.options(name=CONTROLLER_NAME, namespace=_NAMESPACE,
+                           num_cpus=0.1).remote()
+    except ValueError:
+        # raced another creator; the name is now taken
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+
+
+@dataclass
+class AutoscalingConfig:
+    """reference serve/config.py AutoscalingConfig (queue-depth driven)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+
+@dataclass
+class Deployment:
+    """The declarative unit (reference serve/deployment.py Deployment)."""
+
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    max_concurrent_queries: int = 16
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+
+    def options(self, **kwargs: Any) -> "Deployment":
+        import copy
+        new = copy.copy(self)
+        for k, v in kwargs.items():
+            if not hasattr(new, k):
+                raise ValueError(f"unknown deployment option {k!r}")
+            setattr(new, k, v)
+        return new
+
+    def bind(self, *args: Any, **kwargs: Any) -> "Application":
+        return Application(self, args, kwargs)
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+def deployment(_func_or_class: Any = None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 16,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[AutoscalingConfig] = None):
+    """@serve.deployment decorator (reference api.py:deployment)."""
+
+    def wrap(target: Any) -> Deployment:
+        return Deployment(
+            func_or_class=target,
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling_config=autoscaling_config)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+class DeploymentHandle:
+    """Client-side handle with power-of-two-choices routing (reference
+    router.py:893): pick two random replicas, send to the one with fewer
+    locally-tracked in-flight requests."""
+
+    REFRESH_PERIOD_S = 2.0
+
+    def __init__(self, deployment_name: str, controller=None):
+        self.deployment_name = deployment_name
+        self._controller = controller or _get_or_create_controller()
+        self._replicas: List[Any] = []
+        self._in_flight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+        self._refresh(force=True)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._last_refresh < self.REFRESH_PERIOD_S:
+            return
+        self._last_refresh = now
+        replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self.deployment_name),
+            timeout=30)
+        with self._lock:
+            self._replicas = replicas
+            self._in_flight = {i: self._in_flight.get(i, 0)
+                               for i in range(len(replicas))}
+
+    def _pick(self) -> int:
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas")
+            if n == 1:
+                return 0
+            a, b = random.sample(range(n), 2)
+            return a if self._in_flight.get(a, 0) <= \
+                self._in_flight.get(b, 0) else b
+
+    def remote(self, *args: Any, **kwargs: Any):
+        self._refresh()
+        i = self._pick()
+        with self._lock:
+            replica = self._replicas[i]
+            self._in_flight[i] = self._in_flight.get(i, 0) + 1
+        ref = replica.handle_request.remote(args, kwargs)
+
+        def _done(_f):
+            with self._lock:
+                self._in_flight[i] = max(0, self._in_flight.get(i, 1) - 1)
+        fut = ref.future()
+        fut.add_done_callback(_done)
+        return ref
+
+
+def run(app: Any, *, name: Optional[str] = None) -> DeploymentHandle:
+    """Deploy and wait ready (reference serve.run)."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    d = app.deployment
+    controller = _get_or_create_controller()
+    import cloudpickle
+    ray_tpu.get(controller.deploy.remote(
+        name=name or d.name,
+        target_blob=cloudpickle.dumps(d.func_or_class),
+        init_args=app.init_args, init_kwargs=app.init_kwargs,
+        num_replicas=d.num_replicas,
+        max_concurrent_queries=d.max_concurrent_queries,
+        ray_actor_options=d.ray_actor_options,
+        autoscaling=d.autoscaling_config), timeout=300)
+    return DeploymentHandle(name or d.name, controller)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str) -> None:
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=120)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=_NAMESPACE)
+    except Exception:  # noqa: BLE001
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=120)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def start_http(port: int = 8000) -> Any:
+    """Start the HTTP ingress actor (reference proxy.py HTTPProxy): POST
+    /<deployment> with a JSON body calls the deployment and returns the
+    JSON result."""
+    from ray_tpu.serve.proxy import HTTPProxyActor
+    cls = ray_tpu.remote(HTTPProxyActor)
+    proxy = cls.options(num_cpus=0.1).remote(port)
+    ray_tpu.get(proxy.ready.remote(), timeout=60)
+    return proxy

@@ -1,0 +1,220 @@
+"""Serve controller: reconciles deployment state to replica actors.
+
+reference parity: serve/_private/controller.py:87 (ServeController actor)
++ deployment_state.py:1149 (DeploymentState reconciliation: target
+replicas vs running replicas, health checks, replacements) +
+autoscaling_policy.py (queue-depth driven scaling between min/max).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def replica_ping(replica) -> bool:
+    import ray_tpu
+    try:
+        return ray_tpu.get(replica.ping.remote(), timeout=10) == "pong"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class Replica:
+    """The per-replica actor: hosts one instance of the user deployment
+    (reference serve/_private/replica.py)."""
+
+    def __init__(self, target_blob: bytes, init_args: tuple,
+                 init_kwargs: Dict[str, Any]):
+        import cloudpickle
+        target = cloudpickle.loads(target_blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+        self._in_flight = 0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def ping(self) -> str:
+        return "pong"
+
+    def handle_request(self, args: tuple, kwargs: Dict[str, Any]) -> Any:
+        with self._lock:
+            self._in_flight += 1
+            self._total += 1
+        try:
+            fn = self._callable
+            if not callable(fn):
+                raise TypeError(f"deployment target {fn!r} is not callable")
+            return fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._in_flight -= 1
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {"in_flight": self._in_flight, "total": self._total}
+
+
+@dataclass
+class _DeploymentState:
+    name: str
+    target_blob: bytes
+    init_args: tuple
+    init_kwargs: Dict[str, Any]
+    target_replicas: int
+    max_concurrent_queries: int
+    ray_actor_options: Dict[str, Any]
+    autoscaling: Optional[Any] = None
+    replicas: List[Any] = field(default_factory=list)
+    last_scale_up: float = 0.0
+    last_scale_down: float = 0.0
+
+
+class ServeController:
+    """Named actor owning all deployment state; a reconcile thread keeps
+    running replicas == target and applies autoscaling decisions."""
+
+    RECONCILE_PERIOD_S = 1.0
+
+    def __init__(self) -> None:
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._reconcile_loop, daemon=True,
+                         name="serve-reconcile").start()
+
+    # ---- API --------------------------------------------------------
+
+    def deploy(self, name: str, target_blob: bytes, init_args: tuple,
+               init_kwargs: Dict[str, Any], num_replicas: int,
+               max_concurrent_queries: int,
+               ray_actor_options: Dict[str, Any],
+               autoscaling: Optional[Any] = None) -> None:
+        with self._lock:
+            old = self._deployments.get(name)
+            state = _DeploymentState(
+                name=name, target_blob=target_blob, init_args=init_args,
+                init_kwargs=init_kwargs, target_replicas=num_replicas,
+                max_concurrent_queries=max_concurrent_queries,
+                ray_actor_options=dict(ray_actor_options),
+                autoscaling=autoscaling)
+            if old is not None:
+                state.replicas = []  # old code: replace every replica
+                self._stop_replicas(old.replicas)
+            self._deployments[name] = state
+        self._reconcile_one(state)
+
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            state = self._deployments.get(name)
+            return list(state.replicas) if state else []
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {n: {"target_replicas": s.target_replicas,
+                        "running_replicas": len(s.replicas)}
+                    for n, s in self._deployments.items()}
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+        if state is not None:
+            self._stop_replicas(state.replicas)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            states = list(self._deployments.values())
+            self._deployments.clear()
+        for s in states:
+            self._stop_replicas(s.replicas)
+
+    # ---- reconciliation --------------------------------------------
+
+    def _start_replica(self, state: _DeploymentState):
+        import ray_tpu
+        cls = ray_tpu.remote(Replica)
+        opts: Dict[str, Any] = {"num_cpus": 0.1}
+        opts.update(state.ray_actor_options)
+        opts["max_concurrency"] = state.max_concurrent_queries
+        return cls.options(**opts).remote(
+            state.target_blob, state.init_args, state.init_kwargs)
+
+    def _stop_replicas(self, replicas: List[Any]) -> None:
+        import ray_tpu
+        for r in replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _reconcile_one(self, state: _DeploymentState) -> None:
+        import ray_tpu
+        # replace dead replicas (reference deployment_state health checks)
+        with self._lock:
+            replicas = list(state.replicas)
+        alive = []
+        for r in replicas:
+            if replica_ping(r):
+                alive.append(r)
+        while len(alive) < state.target_replicas:
+            alive.append(self._start_replica(state))
+        extra = alive[state.target_replicas:]
+        alive = alive[:state.target_replicas]
+        self._stop_replicas(extra)
+        # wait for newly started replicas to answer
+        for r in alive:
+            try:
+                ray_tpu.get(r.ping.remote(), timeout=120)
+            except Exception:  # noqa: BLE001
+                pass
+        with self._lock:
+            state.replicas = alive
+
+    def _autoscale_one(self, state: _DeploymentState) -> None:
+        import ray_tpu
+        cfg = state.autoscaling
+        if cfg is None or not state.replicas:
+            return
+        try:
+            stats = ray_tpu.get(
+                [r.stats.remote() for r in state.replicas], timeout=30)
+        except Exception:  # noqa: BLE001
+            return
+        avg_in_flight = sum(s["in_flight"] for s in stats) / len(stats)
+        now = time.time()
+        if avg_in_flight > cfg.target_ongoing_requests and \
+                state.target_replicas < cfg.max_replicas and \
+                now - state.last_scale_up > cfg.upscale_delay_s:
+            state.target_replicas += 1
+            state.last_scale_up = now
+            logger.info("serve: scaling %s up to %d (avg in-flight %.1f)",
+                        state.name, state.target_replicas, avg_in_flight)
+        elif avg_in_flight < cfg.target_ongoing_requests / 2 and \
+                state.target_replicas > cfg.min_replicas and \
+                now - state.last_scale_down > cfg.downscale_delay_s:
+            state.target_replicas -= 1
+            state.last_scale_down = now
+            logger.info("serve: scaling %s down to %d",
+                        state.name, state.target_replicas)
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop.wait(self.RECONCILE_PERIOD_S):
+            with self._lock:
+                states = list(self._deployments.values())
+            for state in states:
+                try:
+                    self._autoscale_one(state)
+                    self._reconcile_one(state)
+                except Exception:  # noqa: BLE001
+                    logger.exception("serve reconcile failed for %s",
+                                     state.name)

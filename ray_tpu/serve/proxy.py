@@ -1,0 +1,78 @@
+"""HTTP ingress for serve deployments.
+
+reference parity: serve/_private/proxy.py:122 (per-node HTTP proxy
+routing requests into deployment handles). POST/GET /<deployment-name>
+with a JSON body; the body (an object → kwargs, anything else → single
+positional arg) is passed to the deployment and the JSON result returned.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+
+class HTTPProxyActor:
+    def __init__(self, port: int = 8000):
+        from ray_tpu.serve.api import DeploymentHandle
+
+        self._handles: Dict[str, Any] = {}
+        self._handles_lock = threading.Lock()
+        proxy = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _handle(self, body: Any) -> None:
+                import ray_tpu
+                name = self.path.strip("/").split("/")[0]
+                if not name:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no deployment in path"}')
+                    return
+                try:
+                    with proxy._handles_lock:
+                        handle = proxy._handles.get(name)
+                        if handle is None:
+                            handle = DeploymentHandle(name)
+                            proxy._handles[name] = handle
+                    if isinstance(body, dict):
+                        ref = handle.remote(**body)
+                    elif body is None:
+                        ref = handle.remote()
+                    else:
+                        ref = handle.remote(body)
+                    result = ray_tpu.get(ref, timeout=120)
+                    payload = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._handle(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                body = json.loads(raw) if raw else None
+                self._handle(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="serve-http").start()
+
+    def ready(self) -> int:
+        return self.port
+
+    def stop(self) -> None:
+        self._server.shutdown()
