@@ -1,0 +1,7 @@
+"""`python -m ray_tpu <command>` → the cluster CLI."""
+
+import sys
+
+from ray_tpu.scripts.cli import main
+
+sys.exit(main())

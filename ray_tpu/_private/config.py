@@ -45,6 +45,10 @@ class Config:
     # Chaos testing: inject random handler delays up to this many micros
     # (reference: RAY_testing_asio_delay_us, asio_chaos.cc).
     testing_rpc_delay_us = _define("testing_rpc_delay_us", 0, int)
+    # OOM defense (reference memory_usage_threshold, ray_config_def.h:77)
+    memory_usage_threshold = _define("memory_usage_threshold", 0.95, float)
+    memory_monitor_refresh_ms = _define("memory_monitor_refresh_ms",
+                                        1000, int)
 
 
 def get(name: str) -> Any:

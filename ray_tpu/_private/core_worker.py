@@ -91,6 +91,10 @@ class CoreWorker:
         # Owner-side object directory: oid hex -> (tag, ...) location
         self.objects: Dict[str, Tuple] = {}
         self.object_events: Dict[str, threading.Event] = {}
+        # oid hex -> [callback]: fired once when the object becomes ready
+        # (value or error), without a blocking get (used by handle-style
+        # consumers to observe completion cheaply).
+        self._done_callbacks: Dict[str, List[Any]] = {}
         # Reference counting (reference reference_count.h): local refs,
         # submitted-task arg pins, and borrower registration — a process
         # holding a ref it doesn't own registers a pin with the owner
@@ -231,6 +235,35 @@ class CoreWorker:
             except Exception:  # noqa: BLE001
                 pass
         self.objects[oid_hex] = (FREED,)
+
+    def add_done_callback(self, ref: ObjectRef, cb: Any) -> None:
+        """Invoke cb() once when the owned object is no longer pending.
+        Fires immediately if already resolved. Callbacks must be cheap
+        (they run on completion-handling threads)."""
+        h = ref.hex()
+        with self._lock:
+            loc = self.objects.get(h)
+            if loc is None or loc[0] != PENDING:
+                fire_now = True
+            else:
+                self._done_callbacks.setdefault(h, []).append(cb)
+                fire_now = False
+        if fire_now:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                logger.exception("done callback failed")
+
+    def _fire_done_callbacks(self, oid_hexes) -> None:
+        cbs: List[Any] = []
+        with self._lock:
+            for h in oid_hexes:
+                cbs.extend(self._done_callbacks.pop(h, []))
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                logger.exception("done callback failed")
 
     def _borrow_release_loop(self) -> None:
         while not self._shutdown:
@@ -680,6 +713,7 @@ class CoreWorker:
                     ev.set()
         self._unpin_args(entry.spec.arg_object_refs)
         self.task_events.record(h, state="FINISHED", ts_finished=_ev_now())
+        self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
         if lease_id is not None:
             self._return_lease(lease_id, entry)
 
@@ -722,6 +756,7 @@ class CoreWorker:
         self.task_events.record(task_hex, state="FAILED",
                                 ts_finished=_ev_now(),
                                 error=f"{error_type}: {message}"[:500])
+        self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
 
     # ------------------------------------------------------------------
     # Actor submission (reference direct_actor_task_submitter.h)

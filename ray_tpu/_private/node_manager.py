@@ -69,6 +69,7 @@ class _WorkerHandle:
     # Set while leased/executing
     lease_id: Optional[str] = None
     current_task: Optional[TaskSpec] = None
+    task_started_at: float = 0.0
     is_actor: bool = False
     actor_id_hex: Optional[str] = None
     registered: bool = False
@@ -155,6 +156,15 @@ class NodeManager:
             target=self._resource_report_loop, daemon=True,
             name=f"nm-report-{self.node_id.hex()[:6]}")
         self._report_thread.start()
+        # OOM defense (reference memory_monitor.h + worker killing
+        # policies): above the usage threshold, kill the newest retriable
+        # normal task's worker — its owner retries it, and the node
+        # survives instead of the kernel OOM-killing the daemon.
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        self.memory_monitor = MemoryMonitor(
+            self._kill_worker_for_memory,
+            threshold=Config.memory_usage_threshold,
+            period_s=Config.memory_monitor_refresh_ms / 1000.0)
 
     # ---- resource sync ---------------------------------------------------
 
@@ -459,6 +469,7 @@ class NodeManager:
                     continue
                 handle.lease_id = pl.lease_id
                 handle.current_task = pl.spec
+                handle.task_started_at = time.time()
                 self.leases[pl.lease_id] = handle.worker_id.hex()
                 granted.append((pl, handle))
             self.pending = remaining
@@ -618,6 +629,30 @@ class NodeManager:
                 "num_pending_leases": len(self.pending),
             }
 
+    def _kill_worker_for_memory(self) -> bool:
+        """Retriable-FIFO policy (worker_killing_policy_retriable_fifo.h):
+        prefer the newest-started retriable NORMAL task; fall back to the
+        newest actor. Returns True when something was killed."""
+        with self._lock:
+            busy = [h for h in self.workers.values()
+                    if h.current_task is not None and h.proc is not None]
+            normal = [h for h in busy if not h.is_actor
+                      and h.current_task.max_retries != 0]
+            pool = normal or [h for h in busy if h.is_actor]
+            if not pool:
+                return False
+            victim = max(pool, key=lambda h: h.task_started_at)
+        logger.warning(
+            "memory pressure: killing worker %s running %s",
+            victim.worker_id.hex()[:12],
+            victim.current_task.function_name
+            if victim.current_task else "?")
+        try:
+            victim.proc.kill()
+        except OSError:
+            return False
+        return True
+
     def list_workers(self) -> List[Dict[str, Any]]:
         """Worker-level metadata for the state API (`ray list workers`)."""
         with self._lock:
@@ -639,6 +674,10 @@ class NodeManager:
         if self._dead:
             return
         self._dead = True
+        try:
+            self.memory_monitor.stop()
+        except AttributeError:
+            pass
         with self._lock:
             workers = list(self.workers.values())
         for handle in workers:

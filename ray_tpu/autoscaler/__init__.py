@@ -1,0 +1,15 @@
+"""Autoscaler: demand-driven node scale-up/down over a NodeProvider.
+
+reference parity: python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler + resource_demand_scheduler bin-packing over a
+NodeProvider ABC) and the fake-multinode provider
+(autoscaler/_private/fake_multi_node/node_provider.py) used for
+provider-free testing — here LocalNodeProvider spawns real node-manager
+processes on this machine.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (LocalNodeProvider,  # noqa: F401
+                                           NodeProvider,
+                                           StandardAutoscaler)
+
+__all__ = ["NodeProvider", "LocalNodeProvider", "StandardAutoscaler"]

@@ -1,0 +1,175 @@
+"""StandardAutoscaler + NodeProvider implementations.
+
+reference parity: autoscaler/_private/autoscaler.py (StandardAutoscaler:
+poll load → launch/terminate through a provider), node_provider.py (the
+provider ABC), fake_multi_node/node_provider.py ("nodes" are local
+processes). Demand here = queued worker leases reported by node
+managers; idle = a worker node with no busy workers and no queue for
+idle_timeout_s.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ProviderNode:
+    provider_id: str
+    node_id_hex: Optional[str] = None    # filled once registered in GCS
+    created_at: float = field(default_factory=time.time)
+    handle: Any = None                   # provider-private
+
+
+class NodeProvider:
+    """reference node_provider.py ABC, reduced to the scaling contract."""
+
+    def create_node(self, resources: Dict[str, float]) -> ProviderNode:
+        raise NotImplementedError
+
+    def terminate_node(self, node: ProviderNode) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[ProviderNode]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Nodes are `node_main` subprocesses joining the GCS (the fake-
+    multinode pattern: scale tests without a cloud)."""
+
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._nodes: Dict[str, ProviderNode] = {}
+
+    def create_node(self, resources: Dict[str, float]) -> ProviderNode:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_main",
+             "--gcs-address", self.gcs_address,
+             "--resources", json.dumps(resources)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        line = proc.stdout.readline()
+        info = json.loads(line) if line else {}
+        node = ProviderNode(provider_id=uuid.uuid4().hex[:8],
+                            node_id_hex=info.get("node_id"), handle=proc)
+        self._nodes[node.provider_id] = node
+        return node
+
+    def terminate_node(self, node: ProviderNode) -> None:
+        self._nodes.pop(node.provider_id, None)
+        proc: subprocess.Popen = node.handle
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def non_terminated_nodes(self) -> List[ProviderNode]:
+        return [n for n in self._nodes.values()
+                if n.handle.poll() is None]
+
+
+class StandardAutoscaler:
+    """Polls cluster load via the GCS; scales worker nodes between
+    min_workers and max_workers. Scale-up when leases are queued anywhere
+    (work the current nodes can't place); scale-down when a provider node
+    sits idle past idle_timeout_s."""
+
+    def __init__(self, gcs_address: str, provider: NodeProvider, *,
+                 resources_per_node: Optional[Dict[str, float]] = None,
+                 min_workers: int = 0, max_workers: int = 4,
+                 idle_timeout_s: float = 30.0, poll_period_s: float = 2.0):
+        from ray_tpu._private import rpc as rpc_lib
+
+        host, port = gcs_address.rsplit(":", 1)
+        self._gcs = rpc_lib.RpcClient((host, int(port)), timeout=60)
+        self._pool = rpc_lib.ClientPool(timeout=30)
+        self.provider = provider
+        self.resources_per_node = dict(resources_per_node or {"CPU": 2.0})
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_period_s = poll_period_s
+        self._idle_since: Dict[str, float] = {}
+        self.num_scale_ups = 0
+        self.num_scale_downs = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _cluster_load(self) -> Dict[str, Any]:
+        """Queued leases + busy workers per alive node."""
+        out: Dict[str, Any] = {"pending": 0, "busy_by_node": {}}
+        try:
+            nodes = [n for n in self._gcs.call("get_all_nodes") if n.alive]
+        except Exception:  # noqa: BLE001
+            return out
+        for n in nodes:
+            try:
+                info = self._pool.get(tuple(n.address)).call("nm_get_info")
+                workers = self._pool.get(tuple(n.address)).call(
+                    "nm_list_workers")
+            except Exception:  # noqa: BLE001
+                continue
+            out["pending"] += info.get("num_pending_leases", 0)
+            out["busy_by_node"][n.node_id.hex()] = sum(
+                1 for w in workers if not w["idle"])
+        return out
+
+    def run_once(self) -> None:
+        load = self._cluster_load()
+        nodes = self.provider.non_terminated_nodes()
+        # ---- scale up (reference resource_demand_scheduler: demand the
+        # cluster can't place right now → launch) --------------------
+        if (load["pending"] > 0 or len(nodes) < self.min_workers) \
+                and len(nodes) < self.max_workers:
+            logger.info("autoscaler: %d queued leases, launching node "
+                        "(%d -> %d)", load["pending"], len(nodes),
+                        len(nodes) + 1)
+            self.provider.create_node(self.resources_per_node)
+            self.num_scale_ups += 1
+            return
+        # ---- scale down idle provider nodes ------------------------
+        now = time.time()
+        for node in nodes:
+            if len(self.provider.non_terminated_nodes()) <= \
+                    self.min_workers:
+                break
+            busy = load["busy_by_node"].get(node.node_id_hex, 0)
+            if busy == 0 and load["pending"] == 0:
+                first_idle = self._idle_since.setdefault(
+                    node.provider_id, now)
+                if now - first_idle >= self.idle_timeout_s:
+                    logger.info("autoscaler: terminating idle node %s",
+                                node.provider_id)
+                    self.provider.terminate_node(node)
+                    self._idle_since.pop(node.provider_id, None)
+                    self.num_scale_downs += 1
+            else:
+                self._idle_since.pop(node.provider_id, None)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_period_s):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscaler iteration failed")

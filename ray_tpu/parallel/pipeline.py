@@ -60,20 +60,20 @@ def make_pipeline_fn(stage_fn: Callable[[Any, Any], Any],
             inp = jnp.where(stage == 0, feed, buf)
             out = stage_fn(params, inp)
             # last stage finishes microbatch m = t - (n_stages - 1)
-            if loss_fn is not None:
-                m = t - (n_stages - 1)
-                valid = jnp.logical_and(stage == n_stages - 1,
-                                        jnp.logical_and(m >= 0,
-                                                        m < n_micro))
-                y = y_micro[jnp.clip(m, 0, n_micro - 1)]
-                step_loss = jnp.where(valid, loss_fn(out, y), 0.0)
-                losses = losses + step_loss
+            m = t - (n_stages - 1)
+            valid = jnp.logical_and(stage == n_stages - 1,
+                                    jnp.logical_and(m >= 0, m < n_micro))
+            y = y_micro[jnp.clip(m, 0, n_micro - 1)]
+            losses = losses + jnp.where(valid, loss_fn(out, y), 0.0)
             nxt = jax.lax.ppermute(out, AXIS_PIPE, fwd_perm)
             return (nxt, losses)
 
         del mb_shape
-        # carry shape/dtype comes from one dry stage application
-        buf0 = stage_fn(params, x_micro[0]) * 0.0
+        # carry shape/dtype via eval_shape — an actual x*0.0 application
+        # would cost one extra stage computation per invocation (XLA can't
+        # fold float x*0 because of NaN/Inf semantics)
+        out_shape = jax.eval_shape(stage_fn, params, x_micro[0])
+        buf0 = jnp.zeros(out_shape.shape, out_shape.dtype)
         losses0 = jnp.zeros(())
         buf, losses = jax.lax.fori_loop(0, n_ticks, tick, (buf0, losses0))
         # total loss lives on the last stage; share it with every stage

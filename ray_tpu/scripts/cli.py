@@ -1,0 +1,310 @@
+"""The ray_tpu CLI: `python -m ray_tpu <command>`.
+
+reference parity: python/ray/scripts/scripts.py — start (:548), stop
+(:1024), status (:1971), timeline (:1856), memory (:1921),
+microbenchmark (:1842), plus `list ...`/`summary` from the state CLI
+(util/state/state_cli.py) and `job ...` from dashboard/modules/job/cli.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Any, Dict, List
+
+ADDRESS_ENV = "RAY_TPU_ADDRESS"
+HEAD_INFO_PATH = "/tmp/ray_tpu_head.json"
+
+
+def _address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get(ADDRESS_ENV)
+    if not addr and os.path.exists(HEAD_INFO_PATH):
+        with open(HEAD_INFO_PATH) as f:
+            addr = json.load(f).get("gcs_address")
+    if not addr:
+        raise SystemExit(
+            "no cluster address: pass --address, set RAY_TPU_ADDRESS, or "
+            "run `ray_tpu start --head` on this machine first")
+    return addr
+
+
+def _connect(args):
+    import ray_tpu
+    ray_tpu.init(_address(args), ignore_reinit_error=True)
+    return ray_tpu
+
+
+def _print_table(rows: List[Dict[str, Any]], columns: List[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    header = "  ".join(c.ljust(widths[c]) for c in columns)
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c])
+                        for c in columns))
+
+
+# ---- commands --------------------------------------------------------
+
+
+def cmd_start(args) -> int:
+    if args.head:
+        from ray_tpu._private.worker import HeadNode
+        head = HeadNode(
+            num_cpus=args.num_cpus,
+            resources=json.loads(args.resources) if args.resources else None)
+        info = {
+            "gcs_address": f"{head.gcs.address[0]}:{head.gcs.address[1]}",
+            "node_manager_address":
+                f"{head.node_manager.address[0]}:{head.node_manager.address[1]}",
+            "session_dir": head.session_dir,
+            "pid": os.getpid(),
+        }
+        with open(HEAD_INFO_PATH, "w") as f:
+            json.dump(info, f)
+        print(json.dumps(info))
+        print(f"head started; connect with ray_tpu.init("
+              f"\"{info['gcs_address']}\")", flush=True)
+        if not args.block:
+            print("(running until killed; use --block in scripts)")
+        stop = []
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: stop.append(1))
+        while not stop:
+            time.sleep(0.2)
+        head.shutdown()
+        return 0
+    # joining node: delegate to node_main
+    from ray_tpu._private import node_main
+    argv = ["--gcs-address", _address(args)]
+    if args.resources:
+        argv += ["--resources", args.resources]
+    return node_main.main(argv)
+
+
+def cmd_stop(args) -> int:
+    import subprocess
+    patterns = ["ray_tpu._private.worker_main",
+                "ray_tpu._private.node_main",
+                "ray_tpu.*start --head"]
+    for pat in patterns:
+        subprocess.run(["pkill", "-f", pat], check=False)
+    if os.path.exists(HEAD_INFO_PATH):
+        try:
+            with open(HEAD_INFO_PATH) as f:
+                pid = json.load(f).get("pid")
+            if pid:
+                os.kill(pid, signal.SIGTERM)
+        except (OSError, ValueError):
+            pass
+        os.unlink(HEAD_INFO_PATH)
+    print("stopped")
+    return 0
+
+
+def cmd_status(args) -> int:
+    rt = _connect(args)
+    nodes = rt.nodes()
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    print(f"nodes: {sum(1 for n in nodes if n['Alive'])} alive / "
+          f"{len(nodes)} total")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state as s
+    kind = args.kind.replace("-", "_")
+    if kind in ("task", "tasks"):
+        rows = s.list_tasks()
+        cols = ["task_id", "name", "state", "type", "node_id"]
+        rows = [{**r, "task_id": r.get("task_id", "")[:16],
+                 "node_id": (r.get("node_id") or "")[:12]} for r in rows]
+    elif kind in ("actor", "actors"):
+        rows = s.list_actors()
+        cols = ["actor_id", "class_name", "state", "name", "num_restarts"]
+        rows = [{**r, "actor_id": r["actor_id"][:16]} for r in rows]
+    elif kind in ("node", "nodes"):
+        rows = s.list_nodes()
+        cols = ["node_id", "state", "is_head", "resources_total"]
+        rows = [{**r, "node_id": r["node_id"][:16]} for r in rows]
+    elif kind in ("worker", "workers"):
+        rows = s.list_workers()
+        cols = ["worker_id", "pid", "is_actor", "idle", "current_task"]
+        rows = [{**r, "worker_id": r["worker_id"][:16]} for r in rows]
+    elif kind in ("object", "objects"):
+        rows = s.list_objects()
+        cols = ["object_id", "size", "pinned", "spilled", "node_id"]
+        rows = [{**r, "object_id": r["object_id"][:20],
+                 "node_id": r["node_id"][:12]} for r in rows]
+    elif kind in ("placement_group", "placement_groups"):
+        rows = s.list_placement_groups()
+        cols = ["placement_group_id", "state", "strategy", "bundles"]
+        rows = [{**r, "placement_group_id": r["placement_group_id"][:16]}
+                for r in rows]
+    else:
+        raise SystemExit(f"unknown list kind {args.kind!r}")
+    _print_table(rows, cols)
+    return 0
+
+
+def cmd_summary(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state as s
+    for state, count in sorted(s.summarize_tasks().items()):
+        print(f"{state}: {count}")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    rt = _connect(args)
+    events = rt.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state as s
+    for st in s.object_store_stats():
+        print(f"node {st['node_id'][:12]}: "
+              f"{st['used']}/{st['capacity']} bytes, "
+              f"{st['num_objects']} objects, "
+              f"spilled {st['num_spilled']}, restored {st['num_restored']}")
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    """reference _private/ray_perf.py:93 suites, reduced."""
+    import numpy as np
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+    def bench(name, fn, n):
+        fn()  # warm
+        t0 = time.time()
+        fn()
+        dt = time.time() - t0
+        print(f"{name}: {n / dt:,.0f} /s")
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    n = args.num_ops
+    bench("tasks (submit+get, serial batches)",
+          lambda: ray_tpu.get([tiny.remote() for _ in range(n)]), n)
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return b"ok"
+
+    a = A.options(num_cpus=0.1).remote()
+    bench("actor calls (pipelined)",
+          lambda: ray_tpu.get([a.m.remote() for _ in range(n)]), n)
+
+    arr = np.zeros(1 << 20, dtype=np.uint8)  # 1 MiB
+    bench("put 1MiB",
+          lambda: [ray_tpu.put(arr) for _ in range(n // 10)], n // 10)
+    refs = [ray_tpu.put(arr) for _ in range(n // 10)]
+    bench("get 1MiB", lambda: ray_tpu.get(refs), n // 10)
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_job(args) -> int:
+    from ray_tpu.job import JobSubmissionClient
+    client = JobSubmissionClient(_address(args))
+    if args.job_cmd == "submit":
+        # argparse puts the first entrypoint token into job_id's slot
+        tokens = ([args.job_id] if args.job_id else []) + args.entrypoint
+        job_id = client.submit_job(
+            entrypoint=" ".join(tokens),
+            working_dir=args.working_dir)
+        print(f"submitted: {job_id}")
+        if args.wait:
+            status = client.wait(job_id)
+            print(f"status: {status}")
+            print(client.get_job_logs(job_id))
+            return 0 if status == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+        return 0
+    if args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id))
+        return 0
+    if args.job_cmd == "list":
+        _print_table(client.list_jobs(),
+                     ["job_id", "status", "entrypoint"])
+        return 0
+    raise SystemExit(f"unknown job command {args.job_cmd!r}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or joining node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None,
+                   help="GCS address to join (non-head)")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default=None, help="JSON dict")
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop local ray_tpu processes")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary),
+                     ("memory", cmd_memory)):
+        p = sub.add_parser(name)
+        p.add_argument("--address", default=None)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", help="tasks|actors|nodes|workers|objects|"
+                                "placement-groups")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline", help="dump Chrome-trace timeline")
+    p.add_argument("--output", "-o", default="/tmp/ray_tpu_timeline.json")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("microbenchmark")
+    p.add_argument("--num-ops", type=int, default=200)
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("job", help="job submission")
+    p.add_argument("job_cmd", choices=["submit", "status", "logs", "list"])
+    p.add_argument("job_id", nargs="?", default=None)
+    p.add_argument("--address", default=None)
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("entrypoint", nargs="*",
+                   help="after --: the command to run")
+    p.set_defaults(fn=cmd_job)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,7 +108,10 @@ class DeploymentHandle:
         self.deployment_name = deployment_name
         self._controller = controller or _get_or_create_controller()
         self._replicas: List[Any] = []
-        self._in_flight: Dict[int, int] = {}
+        # in-flight keyed by replica ACTOR id (stable across replica-set
+        # refreshes; index-keyed counts would drift onto the wrong actor
+        # whenever the controller replaces a dead replica)
+        self._in_flight: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._refresh(force=True)
@@ -123,34 +126,39 @@ class DeploymentHandle:
             timeout=30)
         with self._lock:
             self._replicas = replicas
-            self._in_flight = {i: self._in_flight.get(i, 0)
-                               for i in range(len(replicas))}
+            live = {r._actor_id.hex() for r in replicas}
+            self._in_flight = {k: v for k, v in self._in_flight.items()
+                               if k in live}
 
-    def _pick(self) -> int:
+    def _pick(self):
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no replicas")
             if n == 1:
-                return 0
-            a, b = random.sample(range(n), 2)
-            return a if self._in_flight.get(a, 0) <= \
-                self._in_flight.get(b, 0) else b
+                return self._replicas[0]
+            a, b = random.sample(self._replicas, 2)
+            ka, kb = a._actor_id.hex(), b._actor_id.hex()
+            return a if self._in_flight.get(ka, 0) <= \
+                self._in_flight.get(kb, 0) else b
 
     def remote(self, *args: Any, **kwargs: Any):
         self._refresh()
-        i = self._pick()
+        replica = self._pick()
+        key = replica._actor_id.hex()
         with self._lock:
-            replica = self._replicas[i]
-            self._in_flight[i] = self._in_flight.get(i, 0) + 1
+            self._in_flight[key] = self._in_flight.get(key, 0) + 1
         ref = replica.handle_request.remote(args, kwargs)
 
-        def _done(_f):
+        def _done() -> None:
             with self._lock:
-                self._in_flight[i] = max(0, self._in_flight.get(i, 1) - 1)
-        fut = ref.future()
-        fut.add_done_callback(_done)
+                self._in_flight[key] = max(
+                    0, self._in_flight.get(key, 1) - 1)
+
+        # completion observer — no extra thread, no second result fetch
+        import ray_tpu._private.worker as worker_mod
+        worker_mod.global_worker().core_worker.add_done_callback(ref, _done)
         return ref
 
 

@@ -75,8 +75,14 @@ class _DeploymentState:
     ray_actor_options: Dict[str, Any]
     autoscaling: Optional[Any] = None
     replicas: List[Any] = field(default_factory=list)
-    last_scale_up: float = 0.0
-    last_scale_down: float = 0.0
+    deleted: bool = False
+    # sustained-condition tracking for autoscaling delays
+    high_since: Optional[float] = None
+    low_since: Optional[float] = None
+    # serializes reconciliation per deployment: deploy()/delete() on RPC
+    # threads race the background reconcile loop otherwise, double-
+    # starting replicas and orphaning the losers
+    op_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 class ServeController:
@@ -107,10 +113,13 @@ class ServeController:
                 max_concurrent_queries=max_concurrent_queries,
                 ray_actor_options=dict(ray_actor_options),
                 autoscaling=autoscaling)
-            if old is not None:
-                state.replicas = []  # old code: replace every replica
-                self._stop_replicas(old.replicas)
             self._deployments[name] = state
+        if old is not None:
+            # redeploy = replace every replica (new code version)
+            old.deleted = True
+            with old.op_lock:
+                self._stop_replicas(old.replicas)
+                old.replicas = []
         self._reconcile_one(state)
 
     def get_replicas(self, name: str) -> List[Any]:
@@ -128,7 +137,10 @@ class ServeController:
         with self._lock:
             state = self._deployments.pop(name, None)
         if state is not None:
-            self._stop_replicas(state.replicas)
+            state.deleted = True
+            with state.op_lock:  # wait out any in-flight reconcile
+                self._stop_replicas(state.replicas)
+                state.replicas = []
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -159,26 +171,36 @@ class ServeController:
 
     def _reconcile_one(self, state: _DeploymentState) -> None:
         import ray_tpu
-        # replace dead replicas (reference deployment_state health checks)
-        with self._lock:
-            replicas = list(state.replicas)
-        alive = []
-        for r in replicas:
-            if replica_ping(r):
-                alive.append(r)
-        while len(alive) < state.target_replicas:
-            alive.append(self._start_replica(state))
-        extra = alive[state.target_replicas:]
-        alive = alive[:state.target_replicas]
-        self._stop_replicas(extra)
-        # wait for newly started replicas to answer
-        for r in alive:
-            try:
-                ray_tpu.get(r.ping.remote(), timeout=120)
-            except Exception:  # noqa: BLE001
-                pass
-        with self._lock:
-            state.replicas = alive
+        with state.op_lock:
+            if state.deleted:
+                return
+            # replace dead replicas (reference deployment_state checks)
+            with self._lock:
+                replicas = list(state.replicas)
+            alive = []
+            for r in replicas:
+                if replica_ping(r):
+                    alive.append(r)
+            while len(alive) < state.target_replicas:
+                alive.append(self._start_replica(state))
+            extra = alive[state.target_replicas:]
+            alive = alive[:state.target_replicas]
+            self._stop_replicas(extra)
+            # wait for newly started replicas to answer
+            for r in alive:
+                try:
+                    ray_tpu.get(r.ping.remote(), timeout=120)
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._lock:
+                if state.deleted:
+                    pending_stop = alive
+                    state.replicas = []
+                else:
+                    pending_stop = []
+                    state.replicas = alive
+        if pending_stop:  # deleted while we were reconciling
+            self._stop_replicas(pending_stop)
 
     def _autoscale_one(self, state: _DeploymentState) -> None:
         import ray_tpu
@@ -192,18 +214,23 @@ class ServeController:
             return
         avg_in_flight = sum(s["in_flight"] for s in stats) / len(stats)
         now = time.time()
-        if avg_in_flight > cfg.target_ongoing_requests and \
-                state.target_replicas < cfg.max_replicas and \
-                now - state.last_scale_up > cfg.upscale_delay_s:
+        # Sustained-condition delays (reference autoscaling_policy): the
+        # breach must HOLD for the delay window, not merely postdate the
+        # previous scaling event — one bursty sample must not scale.
+        high = avg_in_flight > cfg.target_ongoing_requests
+        low = avg_in_flight < cfg.target_ongoing_requests / 2
+        state.high_since = (state.high_since or now) if high else None
+        state.low_since = (state.low_since or now) if low else None
+        if high and state.target_replicas < cfg.max_replicas and \
+                now - state.high_since >= cfg.upscale_delay_s:
             state.target_replicas += 1
-            state.last_scale_up = now
+            state.high_since = now
             logger.info("serve: scaling %s up to %d (avg in-flight %.1f)",
                         state.name, state.target_replicas, avg_in_flight)
-        elif avg_in_flight < cfg.target_ongoing_requests / 2 and \
-                state.target_replicas > cfg.min_replicas and \
-                now - state.last_scale_down > cfg.downscale_delay_s:
+        elif low and state.target_replicas > cfg.min_replicas and \
+                now - state.low_since >= cfg.downscale_delay_s:
             state.target_replicas -= 1
-            state.last_scale_down = now
+            state.low_since = now
             logger.info("serve: scaling %s down to %d",
                         state.name, state.target_replicas)
 

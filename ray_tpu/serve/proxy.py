@@ -63,7 +63,17 @@ class HTTPProxyActor:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n) if n else b""
-                body = json.loads(raw) if raw else None
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError as e:
+                    payload = json.dumps(
+                        {"error": f"invalid JSON body: {e}"}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 self._handle(body)
 
         self._server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)

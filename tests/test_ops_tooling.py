@@ -1,0 +1,175 @@
+"""Ops tooling: CLI, job submission, autoscaler, memory monitor.
+
+reference parity: scripts/scripts.py (CLI), dashboard/modules/job
+(job submission), autoscaler/_private (StandardAutoscaler over a fake
+provider), common/memory_monitor.h + worker killing policies.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(*argv, address=None, timeout=120):
+    env = dict(os.environ)
+    if address:
+        env["RAY_TPU_ADDRESS"] = address
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *argv],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.fixture()
+def gcs_address(ray_start):
+    return ray_start.get_gcs_address()
+
+
+def test_cli_status_and_list(gcs_address):
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    ray_tpu.get(touch.remote())
+    out = _cli("status", address=gcs_address)
+    assert out.returncode == 0, out.stderr
+    assert "alive" in out.stdout and "CPU" in out.stdout
+
+    out = _cli("list", "nodes", address=gcs_address)
+    assert out.returncode == 0, out.stderr
+    assert "ALIVE" in out.stdout
+
+    time.sleep(1.5)  # task event flush
+    out = _cli("list", "tasks", address=gcs_address)
+    assert out.returncode == 0, out.stderr
+    assert "touch" in out.stdout
+
+    out = _cli("summary", address=gcs_address)
+    assert out.returncode == 0 and "FINISHED" in out.stdout
+
+
+def test_cli_timeline_and_memory(gcs_address, tmp_path):
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    time.sleep(1.5)
+    out_file = str(tmp_path / "tl.json")
+    out = _cli("timeline", "-o", out_file, address=gcs_address)
+    assert out.returncode == 0, out.stderr
+    assert json.load(open(out_file)), "empty timeline"
+    out = _cli("memory", address=gcs_address)
+    assert out.returncode == 0 and "bytes" in out.stdout
+
+
+def test_job_submission_end_to_end(gcs_address, tmp_path):
+    from ray_tpu.job import JobSubmissionClient
+
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import os, ray_tpu\n"
+        "ray_tpu.init(os.environ['RAY_TPU_ADDRESS'])\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x + 1\n"
+        "print('JOB RESULT:', ray_tpu.get(f.remote(41)))\n"
+        "ray_tpu.shutdown()\n")
+    client = JobSubmissionClient(gcs_address)
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}")
+    status = client.wait(job_id, timeout=180)
+    logs = client.get_job_logs(job_id)
+    assert status == "SUCCEEDED", logs
+    assert "JOB RESULT: 42" in logs
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id and j["status"] == "SUCCEEDED"
+               for j in jobs)
+
+
+def test_job_failure_status(gcs_address):
+    from ray_tpu.job import JobSubmissionClient
+    client = JobSubmissionClient(gcs_address)
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait(job_id, timeout=120) == "FAILED"
+
+
+def test_memory_monitor_kills_newest_retriable_task(ray_start):
+    """Forced memory pressure kills the running retriable task's worker;
+    the owner retries it and the node survives."""
+    marker = f"/tmp/oom_marker_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=2)
+    def hog(path):
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            time.sleep(30)  # killed mid-run by the monitor
+            return "survived?"
+        return "retried"
+
+    w = ray_tpu._private.worker.global_worker()
+    nm = w.node.node_manager
+    ref = hog.remote(marker)
+    # wait until the task is actually running
+    deadline = time.time() + 30
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.1)
+    assert os.path.exists(marker)
+    os.environ["RAY_TPU_testing_fake_memory_usage"] = "0.99"
+    try:
+        assert ray_tpu.get(ref, timeout=90) == "retried"
+        assert nm.memory_monitor.num_kills >= 1
+    finally:
+        os.environ.pop("RAY_TPU_testing_fake_memory_usage", None)
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+@pytest.mark.slow
+def test_autoscaler_scales_up_and_down():
+    """Queued leases launch a provider node; idleness reclaims it."""
+    from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)  # tiny head: parallel work must queue
+    try:
+        gcs = ray_tpu.get_gcs_address()
+        provider = LocalNodeProvider(gcs)
+        scaler = StandardAutoscaler(
+            gcs, provider, resources_per_node={"CPU": 2.0},
+            min_workers=0, max_workers=2, idle_timeout_s=5.0,
+            poll_period_s=1.0)
+        scaler.start()
+
+        @ray_tpu.remote
+        def slow(i):
+            time.sleep(3)
+            return i
+
+        refs = [slow.remote(i) for i in range(6)]
+        assert sorted(ray_tpu.get(refs, timeout=300)) == list(range(6))
+        assert scaler.num_scale_ups >= 1, "autoscaler never scaled up"
+        assert len(ray_tpu.nodes()) >= 2
+
+        deadline = time.time() + 120
+        while time.time() < deadline and \
+                provider.non_terminated_nodes():
+            time.sleep(1)
+        assert not provider.non_terminated_nodes(), \
+            "idle nodes never reclaimed"
+        assert scaler.num_scale_downs >= 1
+        scaler.stop()
+    finally:
+        ray_tpu.shutdown()

@@ -44,10 +44,14 @@ def test_class_deployment_with_state_and_replicas(serve_session):
             return f"{self.greeting} {name} from {self.pid}"
 
     handle = serve.run(Greeter.bind("hello"))
-    outs = ray_tpu.get([handle.remote(f"u{i}") for i in range(8)])
-    assert all(o.startswith("hello u") for o in outs)
-    # both replicas serve traffic (power-of-two routing spreads load)
-    pids = {o.rsplit(" ", 1)[1] for o in outs}
+    # both replicas serve traffic (power-of-two routing spreads load);
+    # sequential tie-break is random, so issue batches until both appear
+    pids = set()
+    deadline = time.time() + 30
+    while len(pids) < 2 and time.time() < deadline:
+        outs = ray_tpu.get([handle.remote(f"u{i}") for i in range(8)])
+        assert all(o.startswith("hello u") for o in outs)
+        pids |= {o.rsplit(" ", 1)[1] for o in outs}
     assert len(pids) == 2, f"expected both replicas used, saw {pids}"
 
 
