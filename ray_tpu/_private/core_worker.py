@@ -512,8 +512,10 @@ class CoreWorker:
                     # from real loss (which lineage recovery then handles).
                     bufs = self.store.get([oid_hex], timeout=5)
                 else:
-                    self.store.pull(oid_hex, store_addr, size)
-                    bufs = self.store.get([oid_hex], timeout=60)
+                    # use the pulled buffer directly (for arena-layout
+                    # replicas it's an owned copy, safe across eviction)
+                    bufs = {oid_hex: self.store.pull(
+                        oid_hex, store_addr, size)}
             except ObjectStoreFullError:
                 raise
             except Exception as e:  # noqa: BLE001 - peer store refused/died

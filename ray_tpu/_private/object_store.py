@@ -1,19 +1,26 @@
 """Per-node shared-memory object store (plasma equivalent).
 
 reference parity: src/ray/object_manager/plasma/store.h (PlasmaStore),
-object_lifecycle_manager.h, eviction_policy.h (LRU), plus the node-to-node
+object_lifecycle_manager.h, eviction_policy.h (LRU), plasma_allocator.h
+(the dlmalloc shm arena — here ray_tpu/native/store_arena.cpp, a C++
+boundary-tag allocator over ONE mmap'd arena file), plus the node-to-node
 chunked transfer of src/ray/object_manager/{push,pull}_manager.h.
 
-Design: every node manager hosts a StoreServer. Object payloads live as
-mmap-able files under /dev/shm/<session>/ so any process on the node maps
-them zero-copy; the server coordinates create/seal/wait/delete metadata,
-LRU-evicts unpinned sealed objects under memory pressure, and serves chunked
-reads so a peer store can pull objects across nodes. A later C++ arena
-allocator can replace the file-per-object layout behind the same client API.
+Design: every node manager hosts a StoreServer. Payloads live in a
+shared-memory arena that every process on the node maps once; objects
+are (offset, size) slices handed out by the native allocator, so client
+reads are zero-copy and object creation is an allocation, not a file
+create + per-object mmap. When the native toolchain is unavailable the
+server falls back to the original file-per-object layout transparently
+(location descriptors carry the layout: ("arena", path, offset, size) or
+("file", path, size)). The server LRU-evicts unpinned sealed objects
+under pressure, spills pinned primaries to disk, and serves chunked
+reads so a peer store can pull objects across nodes.
 """
 
 from __future__ import annotations
 
+import logging
 import mmap
 import os
 import threading
@@ -22,6 +29,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import rpc as rpc_lib
+
+logger = logging.getLogger(__name__)
 
 CHUNK_SIZE = 8 << 20  # 8 MiB transfer chunks (reference object_buffer_pool)
 
@@ -32,8 +41,9 @@ class ObjectStoreFullError(Exception):
 
 @dataclass
 class _Entry:
-    path: str
     size: int
+    offset: Optional[int] = None   # arena payload offset (arena layout)
+    path: Optional[str] = None     # backing file (file layout / spilled)
     sealed: bool = False
     pinned: int = 0          # pin count (owner pins while referenced)
     last_access: float = field(default_factory=time.time)
@@ -64,9 +74,22 @@ class StoreServer:
         self.num_spilled = 0
         self.num_restored = 0
         self._objects: Dict[str, _Entry] = {}
+        self._quarantine: List[Tuple[float, int]] = []  # (freed_at, offset)
         self._lock = threading.Lock()
         self._sealed_cv = threading.Condition(self._lock)
         self._pool = rpc_lib.ClientPool(timeout=60)
+
+        # Native arena (reference PlasmaAllocator); None → file layout.
+        self.arena = None
+        self.arena_path = os.path.join(self.dir, "arena")
+        try:
+            from ray_tpu.native import NativeArena
+            self.arena = NativeArena(self.arena_path,
+                                     capacity=capacity_bytes)
+        except Exception as e:  # noqa: BLE001 - no toolchain: fall back
+            logger.info("native arena unavailable (%s); using "
+                        "file-per-object store", e)
+
         self.server = rpc_lib.RpcServer({
             "store_create": self.create,
             "store_seal": self.seal,
@@ -83,52 +106,124 @@ class StoreServer:
         }, host=host)
         self.address = self.server.address
 
-    # -- lifecycle ---------------------------------------------------------
+    # -- layout helpers ------------------------------------------------
 
-    def _evict_until(self, needed: int) -> None:
-        """Free shm space: LRU-drop unpinned replicas first (reference
-        eviction_policy.h), then LRU-spill pinned primaries to disk
-        (reference local_object_manager.cc:161-334 SpillObjects)."""
+    def _descriptor(self, e: _Entry) -> Tuple:
+        if e.offset is not None:
+            return ("arena", self.arena_path, e.offset, e.size)
+        return ("file", e.path, e.size)
+
+    def _payload_view(self, e: _Entry) -> memoryview:
+        assert e.offset is not None
+        return self.arena.view(e.offset, e.size)
+
+    # -- space management ----------------------------------------------
+
+    # Freed arena blocks sit in a time-quarantine before real reuse: a
+    # reader may still hold a zero-copy view of the region (plasma solves
+    # this with a client release protocol; the quarantine bounds the
+    # hazard window instead). Holding the ObjectRef remains the
+    # guaranteed-safe contract for long-lived zero-copy values.
+    ARENA_FREE_DELAY_S = 10.0
+
+    def _arena_release_locked(self, offset: int) -> None:
+        self._quarantine.append((time.time(), offset))
+
+    def _drain_quarantine_locked(self, force: bool = False) -> None:
+        now = time.time()
+        keep = []
+        for t, off in self._quarantine:
+            if force or now - t >= self.ARENA_FREE_DELAY_S:
+                try:
+                    self.arena.free(off)
+                except ValueError:
+                    pass
+            else:
+                keep.append((t, off))
+        self._quarantine = keep
+
+    def _eviction_order_locked(self) -> List[str]:
+        """Victim order, computed ONCE per space request: LRU unpinned
+        replicas first (dropped), then LRU pinned primaries (spilled)."""
+        unpinned = sorted(
+            ((e.last_access, oid) for oid, e in self._objects.items()
+             if e.sealed and e.pinned == 0 and not e.spilled))
+        pinned = sorted(
+            ((e.last_access, oid) for oid, e in self._objects.items()
+             if e.sealed and e.pinned > 0 and not e.spilled))
+        return [oid for _, oid in unpinned] + [oid for _, oid in pinned]
+
+    def _evict_next_locked(self, order: List[str]) -> bool:
+        while order:
+            oid = order.pop(0)
+            e = self._objects.get(oid)
+            if e is None or e.spilled or not e.sealed:
+                continue
+            if e.pinned == 0:
+                self._delete_locked(oid)
+            else:
+                self._spill_locked(oid)
+            return True
+        return False
+
+    def _evict_until(self, needed: int,
+                     order: Optional[List[str]] = None) -> None:
+        """Free shm space (reference eviction_policy.h LRU +
+        local_object_manager.cc:161-334 SpillObjects)."""
         if self.used + needed <= self.capacity:
             return
-        victims = sorted(
-            ((e.last_access, oid) for oid, e in self._objects.items()
-             if e.sealed and e.pinned == 0 and not e.spilled),
-            key=lambda t: t[0])
-        for _, oid in victims:
-            if self.used + needed <= self.capacity:
-                return
-            self._delete_locked(oid)
-        # Still short: spill pinned, sealed primaries to disk. Their data
-        # survives and restores on next access; only shm space is released.
-        spillable = sorted(
-            ((e.last_access, oid) for oid, e in self._objects.items()
-             if e.sealed and not e.spilled),
-            key=lambda t: t[0])
-        for _, oid in spillable:
-            if self.used + needed <= self.capacity:
-                return
-            self._spill_locked(oid)
-        if self.used + needed > self.capacity:
-            raise ObjectStoreFullError(
-                f"object store full: need {needed}, used {self.used}/{self.capacity}")
+        if order is None:
+            order = self._eviction_order_locked()
+        while self.used + needed > self.capacity:
+            if not self._evict_next_locked(order):
+                raise ObjectStoreFullError(
+                    f"object store full: need {needed}, used "
+                    f"{self.used}/{self.capacity}")
+
+    def _alloc_locked(self, size: int) -> int:
+        """Arena allocation with eviction on both capacity pressure and
+        fragmentation (alloc can fail below capacity when no contiguous
+        block fits)."""
+        self._drain_quarantine_locked()
+        order = self._eviction_order_locked()
+        self._evict_until(size, order)
+        off = self.arena.alloc(size)
+        while off == 0:
+            if not self._evict_next_locked(order):
+                # last resort: reclaim quarantined blocks early
+                self._drain_quarantine_locked(force=True)
+                off = self.arena.alloc(size)
+                if off:
+                    return off
+                raise ObjectStoreFullError(
+                    f"object store fragmented/full allocating {size} "
+                    f"(used {self.used}/{self.capacity})")
+            off = self.arena.alloc(size)
+        return off
 
     def _spill_locked(self, object_id: str) -> None:
         e = self._objects.get(object_id)
         if e is None or not e.sealed or e.spilled:
             return
         spill_path = os.path.join(self.spill_dir, object_id)
-        # Copy (not rename): spill dir is on a different filesystem than shm.
-        with open(e.path, "rb") as src, open(spill_path, "wb") as dst:
-            while True:
-                chunk = src.read(CHUNK_SIZE)
-                if not chunk:
-                    break
-                dst.write(chunk)
-        try:
-            os.unlink(e.path)
-        except OSError:
-            pass
+        with open(spill_path, "wb") as dst:
+            if e.offset is not None:
+                dst.write(self._payload_view(e))
+            else:
+                with open(e.path, "rb") as src:
+                    while True:
+                        chunk = src.read(CHUNK_SIZE)
+                        if not chunk:
+                            break
+                        dst.write(chunk)
+        if e.offset is not None:
+            self._arena_release_locked(e.offset)
+            e.offset = None
+        elif e.path:
+            try:
+                os.unlink(e.path)
+            except OSError:
+                pass
         e.path = spill_path
         e.spilled = True
         self.used -= e.size
@@ -140,20 +235,29 @@ class StoreServer:
         e = self._objects.get(object_id)
         if e is None or not e.spilled:
             return
-        self._evict_until(e.size)
-        shm_path = os.path.join(self.dir, object_id)
         spill_path = e.path
-        with open(spill_path, "rb") as src, open(shm_path, "wb") as dst:
-            while True:
-                chunk = src.read(CHUNK_SIZE)
-                if not chunk:
-                    break
-                dst.write(chunk)
+        if self.arena is not None:
+            off = self._alloc_locked(e.size)
+            with open(spill_path, "rb") as src:
+                view = self.arena.view(off, e.size)
+                src.readinto(view)  # type: ignore[arg-type]
+            e.offset = off
+            e.path = None
+        else:
+            self._evict_until(e.size)
+            shm_path = os.path.join(self.dir, object_id)
+            with open(spill_path, "rb") as src, \
+                    open(shm_path, "wb") as dst:
+                while True:
+                    chunk = src.read(CHUNK_SIZE)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+            e.path = shm_path
         try:
             os.unlink(spill_path)
         except OSError:
             pass
-        e.path = shm_path
         e.spilled = False
         e.last_access = time.time()
         self.used += e.size
@@ -165,43 +269,58 @@ class StoreServer:
             return
         if not e.spilled:
             self.used -= e.size
-        try:
-            os.unlink(e.path)
-        except OSError:
-            pass
+        if e.offset is not None:
+            self._arena_release_locked(e.offset)
+        elif e.path:
+            try:
+                os.unlink(e.path)
+            except OSError:
+                pass
 
-    def create(self, object_id: str, size: int, pin: bool = True) -> str:
-        """Allocate backing file; returns its path for the client to mmap.
+    # -- lifecycle -------------------------------------------------------
+
+    def create(self, object_id: str, size: int, pin: bool = True) -> Tuple:
+        """Allocate backing space; returns the location descriptor.
 
         Primary (owner-written) copies are created pinned so LRU eviction
-        can't drop an object the owner still references; delete() (driven by
-        the owner's refcount) removes them. Pulled replica copies are created
-        unpinned and evictable (the primary still exists elsewhere).
+        can't drop an object the owner still references; delete() (driven
+        by the owner's refcount) removes them. Pulled replica copies are
+        created unpinned and evictable (the primary exists elsewhere).
         """
         with self._lock:
             if object_id in self._objects:
                 e = self._objects[object_id]
                 if e.size == size and not e.spilled:
-                    return e.path
+                    return self._descriptor(e)
                 # Same id re-created with a different payload size (lineage
                 # re-execution of a nondeterministic task) or a spilled
-                # entry being rewritten: replace the backing file — mmap'ing
-                # a larger size over the old file would SIGBUS past EOF.
+                # entry being rewritten: replace the backing space.
                 self._delete_locked(object_id)
-            self._evict_until(size)
-            path = os.path.join(self.dir, object_id)
-            with open(path, "wb") as f:
-                f.truncate(max(size, 1))
-            self._objects[object_id] = _Entry(path=path, size=size,
-                                              pinned=1 if pin else 0)
+            if self.arena is not None:
+                off = self._alloc_locked(size)
+                entry = _Entry(size=size, offset=off,
+                               pinned=1 if pin else 0)
+            else:
+                self._evict_until(size)
+                path = os.path.join(self.dir, object_id)
+                with open(path, "wb") as f:
+                    f.truncate(max(size, 1))
+                entry = _Entry(size=size, path=path,
+                               pinned=1 if pin else 0)
+            self._objects[object_id] = entry
             self.used += size
-            return path
+            return self._descriptor(entry)
 
     def put_raw(self, object_id: str, data: bytes, pin: bool = False) -> None:
         """Create + write + seal in one RPC (remote pushes, small writers)."""
-        path = self.create(object_id, len(data), pin=pin)
-        with open(path, "r+b") as f:
-            f.write(data)
+        self.create(object_id, len(data), pin=pin)
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is not None and e.offset is not None:
+                self._payload_view(e)[:len(data)] = data
+            elif e is not None:
+                with open(e.path, "r+b") as f:
+                    f.write(data)
         self.seal(object_id)
 
     def seal(self, object_id: str) -> None:
@@ -215,8 +334,8 @@ class StoreServer:
             self._sealed_cv.notify_all()
 
     def wait(self, object_ids: List[str], timeout: Optional[float] = None,
-             num_required: Optional[int] = None) -> Dict[str, Tuple[str, int]]:
-        """Block until objects are sealed locally; returns {id: (path, size)}.
+             num_required: Optional[int] = None) -> Dict[str, Tuple]:
+        """Block until objects are sealed locally; returns {id: descriptor}.
         Objects not present locally are NOT fetched here (see pull)."""
         deadline = None if timeout is None else time.time() + timeout
         num_required = len(object_ids) if num_required is None else num_required
@@ -229,7 +348,7 @@ class StoreServer:
                         if e.spilled:
                             self._restore_locked(oid)
                         e.last_access = time.time()
-                        ready[oid] = (e.path, e.size)
+                        ready[oid] = self._descriptor(e)
                 if len(ready) >= num_required:
                     return ready
                 remaining = None if deadline is None else deadline - time.time()
@@ -266,33 +385,53 @@ class StoreServer:
             e = self._objects.get(object_id)
             if e is None or not e.sealed:
                 raise KeyError(f"read_chunk: {object_id} not sealed here")
-            path, size = e.path, e.size
             e.last_access = time.time()
+            length = min(length, e.size - offset)
+            if e.offset is not None:
+                return bytes(self.arena.view(e.offset + offset, length))
+            path = e.path
         with open(path, "rb") as f:
             f.seek(offset)
-            return f.read(min(length, size - offset))
+            return f.read(length)
 
     def pull(self, object_id: str, from_store: Tuple[str, int],
-             size: int) -> Tuple[str, int]:
+             size: int) -> Tuple:
         """Pull an object from a peer store into this one (chunked).
         reference parity: pull_manager.h / push_manager.h chunk streaming."""
         with self._lock:
             e = self._objects.get(object_id)
             if e is not None and e.sealed:
-                return e.path, e.size
-        path = self.create(object_id, size, pin=False)
+                if e.spilled:
+                    # a complete local copy exists on disk: restore it
+                    # instead of refetching (the peer may have evicted)
+                    self._restore_locked(object_id)
+                    e = self._objects[object_id]
+                return self._descriptor(e)
+        expected = self.create(object_id, size, pin=False)
         client = self._pool.get(tuple(from_store))
-        with open(path, "r+b") as f:
-            off = 0
-            while off < size:
-                chunk = client.call("store_read_chunk", object_id=object_id,
-                                    offset=off, length=CHUNK_SIZE)
-                f.write(chunk)
-                off += len(chunk)
-                if not chunk:
-                    raise IOError(f"short read pulling {object_id}")
+        off = 0
+        while off < size:
+            chunk = client.call("store_read_chunk", object_id=object_id,
+                                offset=off, length=CHUNK_SIZE)
+            if not chunk:
+                raise IOError(f"short read pulling {object_id}")
+            with self._lock:
+                e = self._objects.get(object_id)
+                if e is None or self._descriptor(e) != expected:
+                    # deleted or re-created (different allocation) while
+                    # we streamed: writing at the old offsets would land
+                    # inside other objects' blocks
+                    raise KeyError(f"{object_id} replaced mid-pull")
+                if e.offset is not None:
+                    self.arena.view(e.offset + off, len(chunk))[:] = chunk
+                else:
+                    with open(e.path, "r+b") as f:
+                        f.seek(off)
+                        f.write(chunk)
+            off += len(chunk)
         self.seal(object_id)
-        return path, size
+        with self._lock:
+            return self._descriptor(self._objects[object_id])
 
     def list_objects(self) -> List[Dict[str, Any]]:
         """Object-level metadata for the state API (`ray list objects`)."""
@@ -306,37 +445,61 @@ class StoreServer:
             return {"used": self.used, "capacity": self.capacity,
                     "num_objects": len(self._objects),
                     "num_spilled": self.num_spilled,
-                    "num_restored": self.num_restored}
+                    "num_restored": self.num_restored,
+                    "native_arena": self.arena is not None}
 
     def shutdown(self) -> None:
         self.server.stop()
         with self._lock:
             for oid in list(self._objects):
                 self._delete_locked(oid)
+        if self.arena is not None:
+            self.arena.close()
+            try:
+                os.unlink(self.arena_path)
+            except OSError:
+                pass
         import shutil as _shutil
         _shutil.rmtree(self.spill_dir, ignore_errors=True)
 
 
 class StoreClient:
-    """Per-process client: RPC for metadata, direct mmap for payload."""
+    """Per-process client: RPC for lifecycle, direct shared memory for
+    payloads (one arena mapping per store instead of one mmap per object)."""
 
     def __init__(self, store_address: Tuple[str, int]):
         self.address = tuple(store_address)
         self._rpc = rpc_lib.RpcClient(self.address, timeout=None)
-        # object id -> (mmap, view, inode). The inode detects a deleted-and-
-        # recreated object id (e.g. lineage re-execution after eviction):
-        # the cached map then points at the dead unlinked inode and must be
-        # replaced, or writes/reads silently hit stale data.
-        self._maps: Dict[str, Tuple[mmap.mmap, memoryview, int]] = {}
         self._lock = threading.Lock()
+        self._arenas: Dict[str, Any] = {}     # arena path -> NativeArena
+        # file-layout fallback: object id -> (mmap, view, inode)
+        self._maps: Dict[str, Tuple[mmap.mmap, memoryview, int]] = {}
 
-    def create(self, object_id: str, size: int) -> memoryview:
-        path = self._rpc.call("store_create", object_id=object_id, size=size)
-        return self._map(object_id, path, size, writable=True)
+    # -- descriptor resolution ----------------------------------------
 
-    def _map(self, object_id: str, path: str, size: int,
-             writable: bool = False) -> memoryview:
+    def _arena(self, path: str):
         with self._lock:
+            a = self._arenas.get(path)
+            if a is None:
+                from ray_tpu.native import NativeArena
+                a = NativeArena(path)
+                self._arenas[path] = a
+            return a
+
+    def _view(self, object_id: str, desc: Tuple,
+              writable: bool = False) -> memoryview:
+        if desc[0] == "arena":
+            _, path, offset, size = desc
+            return self._arena(path).view(offset, size)
+        _, path, size = desc
+        return self._map_file(object_id, path, size, writable)
+
+    def _map_file(self, object_id: str, path: str, size: int,
+                  writable: bool = False) -> memoryview:
+        with self._lock:
+            # The inode detects a deleted-and-recreated object id (e.g.
+            # lineage re-execution after eviction): a cached map would
+            # point at the dead unlinked inode.
             inode = os.stat(path).st_ino
             cached = self._maps.get(object_id)
             if cached is not None:
@@ -354,6 +517,13 @@ class StoreClient:
             self._maps[object_id] = (mm, view, inode)
             return view
 
+    # -- lifecycle ------------------------------------------------------
+
+    def create(self, object_id: str, size: int) -> memoryview:
+        desc = self._rpc.call("store_create", object_id=object_id,
+                              size=size)
+        return self._view(object_id, desc, writable=True)
+
     def seal(self, object_id: str) -> None:
         self._rpc.call("store_seal", object_id=object_id)
 
@@ -367,19 +537,25 @@ class StoreClient:
 
     def get(self, object_ids: List[str], timeout: Optional[float] = None
             ) -> Dict[str, memoryview]:
-        meta = self._rpc.call("store_wait", object_ids=object_ids,
-                              timeout=timeout)
-        return {oid: self._map(oid, path, size)
-                for oid, (path, size) in meta.items()}
+        descs = self._rpc.call("store_wait", object_ids=object_ids,
+                               timeout=timeout)
+        return {oid: self._view(oid, desc)
+                for oid, desc in descs.items()}
 
     def contains(self, object_id: str) -> bool:
         return self._rpc.call("store_contains", object_id=object_id)
 
     def pull(self, object_id: str, from_store: Tuple[str, int], size: int
              ) -> memoryview:
-        path, size = self._rpc.call("store_pull", object_id=object_id,
-                                    from_store=tuple(from_store), size=size)
-        return self._map(object_id, path, size)
+        desc = self._rpc.call("store_pull", object_id=object_id,
+                              from_store=tuple(from_store), size=size)
+        view = self._view(object_id, desc)
+        if desc[0] == "arena":
+            # Replicas are LRU-evictable and their arena blocks get
+            # reused; hand the caller an owned copy rather than a view
+            # that could be rewritten underneath a zero-copy array.
+            return memoryview(bytes(view))
+        return view
 
     def delete(self, object_ids: List[str]) -> None:
         self._release(object_ids)
@@ -404,3 +580,11 @@ class StoreClient:
 
     def close(self) -> None:
         self._rpc.close()
+        with self._lock:
+            arenas = list(self._arenas.values())
+            self._arenas.clear()
+        for a in arenas:
+            try:
+                a.close()
+            except Exception:  # noqa: BLE001
+                pass
