@@ -37,6 +37,9 @@ logger = logging.getLogger(__name__)
 # Object location tags (owner's object directory entries)
 INLINE, STORE, ERROR, PENDING, FREED = "inline", "store", "error", "pending", "freed"
 
+# Sentinel: materialization must be retried after in-flight recovery.
+_RETRY = object()
+
 
 @dataclass
 class _TaskEntry:
@@ -114,6 +117,7 @@ class CoreWorker:
         self._borrow_release_queue: "queue.Queue" = queue.Queue()
         self.tasks: Dict[str, _TaskEntry] = {}
         self.actors: Dict[str, _ActorState] = {}
+        self._store_map_cache = (0.0, {})
         self._put_index = 0
         self._fn_cache: Dict[str, Any] = {}
         self._subscriptions: Dict[Tuple[str, str], Any] = {}
@@ -135,6 +139,7 @@ class CoreWorker:
             "cw_task_done": self._on_task_done,
             "cw_task_failed": self._on_task_failed,
             "cw_get_object": self._on_get_object,
+            "cw_wait_object": self._on_wait_object,
             "cw_recover_object": self._on_recover_object,
             "cw_add_ref": self._on_add_ref,
             "cw_remove_ref": self._on_remove_ref,
@@ -434,69 +439,100 @@ class CoreWorker:
 
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         h = ref.hex()
-        recover_attempts = 0
-        while True:
-            with self._lock:
-                loc = self.objects.get(h)
-                if loc is not None and loc[0] == PENDING:
-                    ev = self.object_events.setdefault(h, threading.Event())
-                else:
-                    ev = None
-            if loc is None or loc[0] == PENDING:
-                if self._is_own(ref):
-                    if loc is None:
-                        raise exc.ObjectLostError(
-                            f"object {h[:16]} unknown to its owner (freed?)")
-                    # our own pending task result: wait on event
+        recover_attempts = [0]
+        # Long-polls park server-side for up to 30s; a dedicated
+        # per-get connection keeps them off the shared pooled client,
+        # where they would head-of-line-block every other call to that
+        # owner from this process (RpcClient serializes on one socket).
+        longpoll_client: Optional[rpc_lib.RpcClient] = None
+        try:
+            while True:
+                with self._lock:
+                    loc = self.objects.get(h)
+                    if loc is not None and loc[0] == PENDING:
+                        ev = self.object_events.setdefault(
+                            h, threading.Event())
+                    else:
+                        ev = None
+                if loc is None or loc[0] == PENDING:
+                    if self._is_own(ref):
+                        if loc is None:
+                            raise exc.ObjectLostError(
+                                f"object {h[:16]} unknown to its owner "
+                                "(freed?)")
+                        # our own pending task result: wait on event
+                        remaining = None if deadline is None \
+                            else deadline - time.time()
+                        if remaining is not None and remaining <= 0:
+                            raise exc.GetTimeoutError(
+                                f"get timed out waiting for {h[:16]}")
+                        ev.wait(timeout=min(remaining, 1.0)
+                                if remaining is not None else 1.0)
+                        continue
+                    # borrower: long-poll the owner (reference pubsub
+                    # long-poll; a 5ms busy-poll collapses at scale)
                     remaining = None if deadline is None \
                         else deadline - time.time()
                     if remaining is not None and remaining <= 0:
                         raise exc.GetTimeoutError(
                             f"get timed out waiting for {h[:16]}")
-                    ev.wait(timeout=min(remaining, 1.0)
-                            if remaining is not None else 1.0)
-                    continue
-                # borrower: poll the owner
-                try:
-                    loc = self._owner_client(ref).call("cw_get_object",
-                                                       oid_hex=h)
-                except rpc_lib.ConnectionLost:
-                    raise exc.OwnerDiedError(
-                        f"owner {ref.owner_address} of {h[:16]} died")
-                if loc[0] in (PENDING, "unknown"):
-                    if deadline is not None and time.time() > deadline:
-                        raise exc.GetTimeoutError(
-                            f"get timed out waiting for {h[:16]}")
-                    time.sleep(0.005)
-                    continue
-                with self._lock:
-                    self.objects.setdefault(h, loc)
-            try:
-                return self._materialize(h, loc)
-            except exc.ObjectFreedError:
-                raise
-            except exc.ObjectLostError:
-                # Lost from the store (evicted / node died): try lineage
-                # reconstruction, then loop back and wait for the new value.
-                recover_attempts += 1
-                if recover_attempts > 3:
-                    raise
-                if self._is_own(ref):
-                    if not self._recover_object(h):
-                        raise
-                else:
-                    with self._lock:
-                        self.objects.pop(h, None)  # drop stale cached loc
                     try:
-                        ok = self._owner_client(ref).call(
-                            "cw_recover_object", oid_hex=h)
-                    except Exception:  # noqa: BLE001
+                        if longpoll_client is None:
+                            longpoll_client = rpc_lib.RpcClient(
+                                ref.owner_address, timeout=120)
+                        loc = longpoll_client.call(
+                            "cw_wait_object", oid_hex=h,
+                            timeout=min(remaining or 30.0, 30.0))
+                    except rpc_lib.ConnectionLost:
                         raise exc.OwnerDiedError(
-                            f"owner {ref.owner_address} of {h[:16]} "
-                            "unreachable during recovery") from None
-                    if not ok:
-                        raise
-                time.sleep(0.01)
+                            f"owner {ref.owner_address} of {h[:16]} died")
+                    if loc[0] in (PENDING, "unknown"):
+                        if deadline is not None and time.time() > deadline:
+                            raise exc.GetTimeoutError(
+                                f"get timed out waiting for {h[:16]}")
+                        time.sleep(0.05 if loc[0] == "unknown" else 0.0)
+                        continue
+                    with self._lock:
+                        self.objects.setdefault(h, loc)
+                result = self._materialize_with_recovery(
+                    ref, h, loc, recover_attempts)
+                if result is _RETRY:
+                    continue
+                return result
+        finally:
+            if longpoll_client is not None:
+                longpoll_client.close()
+
+    def _materialize_with_recovery(self, ref, h, loc,
+                                   recover_attempts: List[int]) -> Any:
+        """Materialize, attempting lineage reconstruction on loss. Returns
+        _RETRY when recovery is in flight — the caller's loop re-reads the
+        (now PENDING) location and waits for the recomputed value."""
+        try:
+            return self._materialize(h, loc)
+        except exc.ObjectFreedError:
+            raise
+        except exc.ObjectLostError:
+            recover_attempts[0] += 1
+            if recover_attempts[0] > 3:
+                raise
+            if self._is_own(ref):
+                if not self._recover_object(h):
+                    raise
+            else:
+                with self._lock:
+                    self.objects.pop(h, None)  # drop stale cached loc
+                try:
+                    ok = self._owner_client(ref).call(
+                        "cw_recover_object", oid_hex=h)
+                except Exception:  # noqa: BLE001
+                    raise exc.OwnerDiedError(
+                        f"owner {ref.owner_address} of {h[:16]} "
+                        "unreachable during recovery") from None
+                if not ok:
+                    raise
+            time.sleep(0.01)
+            return _RETRY
 
     def _materialize(self, oid_hex: str, loc: Tuple) -> Any:
         tag = loc[0]
@@ -603,9 +639,40 @@ class CoreWorker:
             spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
             name=spec.function_name, type="NORMAL_TASK",
             job_id=spec.job_id.hex())
+        spec.locality_hints = self._locality_hints(spec.arg_object_refs)
         self._pin_args(spec.arg_object_refs)
         self._request_lease(spec)
         return [ObjectRef(oid, self.address) for oid in return_ids]
+
+    def _locality_hints(self, arg_ids: List[ObjectID]) -> Dict[str, float]:
+        """node id hex -> bytes of the task's args already resident there
+        (reference lease_policy.h:56). Uses the owner's location cache;
+        inline args contribute nothing (they travel in the spec)."""
+        if not arg_ids:
+            return {}
+        store_to_node = self._store_to_node_map()
+        hints: Dict[str, float] = {}
+        with self._lock:
+            for oid in arg_ids:
+                loc = self.objects.get(oid.hex())
+                if loc is not None and loc[0] == STORE:
+                    node = store_to_node.get(tuple(loc[1]))
+                    if node is not None:
+                        hints[node] = hints.get(node, 0.0) + float(loc[2])
+        return hints
+
+    def _store_to_node_map(self) -> Dict[Tuple[str, int], str]:
+        ts, cached = self._store_map_cache
+        if time.time() - ts < 5.0:
+            return cached
+        try:
+            nodes = self._gcs.call("get_all_nodes")
+        except Exception:  # noqa: BLE001
+            return cached
+        mapping = {tuple(n.store_address): n.node_id.hex()
+                   for n in nodes if n.alive}
+        self._store_map_cache = (time.time(), mapping)
+        return mapping
 
     def _on_lease_respill(self, task_id: TaskID,
                           nm_address: Tuple[str, int]) -> None:
@@ -959,6 +1026,24 @@ class CoreWorker:
         if loc[0] == PENDING:
             return (PENDING,)
         return loc
+
+    def _on_wait_object(self, oid_hex: str, timeout: float = 30.0) -> Tuple:
+        """Long-poll variant of cw_get_object (reference: the pubsub
+        long-poll object-location channel, core_worker.proto:441): parks
+        until the object resolves instead of making borrowers busy-poll."""
+        deadline = time.time() + min(timeout, 60.0)
+        while True:
+            with self._lock:
+                loc = self.objects.get(oid_hex)
+                if loc is not None and loc[0] == PENDING:
+                    ev = self.object_events.setdefault(
+                        oid_hex, threading.Event())
+                else:
+                    return loc if loc is not None else ("unknown",)
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return (PENDING,)
+            ev.wait(timeout=min(remaining, 1.0))
 
     def _on_add_ref(self, oid_hex: str,
                     borrower: Optional[Tuple[str, int]] = None) -> None:

@@ -12,6 +12,7 @@ backend can be swapped in (reference gcs_table_storage.h).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -35,6 +36,7 @@ class InMemoryStore:
     def put(self, table: str, key: str, value: Any) -> None:
         with self._lock:
             self._tables.setdefault(table, {})[key] = value
+            self._on_mutate_locked()
 
     def get(self, table: str, key: str) -> Any:
         with self._lock:
@@ -42,7 +44,10 @@ class InMemoryStore:
 
     def delete(self, table: str, key: str) -> bool:
         with self._lock:
-            return self._tables.get(table, {}).pop(key, None) is not None
+            hit = self._tables.get(table, {}).pop(key, None) is not None
+            if hit:
+                self._on_mutate_locked()
+            return hit
 
     def keys(self, table: str, prefix: str = "") -> List[str]:
         with self._lock:
@@ -52,6 +57,73 @@ class InMemoryStore:
         with self._lock:
             return list(self._tables.get(table, {}).items())
 
+    def _on_mutate_locked(self) -> None:
+        pass
+
+
+class PersistentStore(InMemoryStore):
+    """File-backed table storage (reference redis_store_client.h role:
+    GCS state survives a control-plane restart — gcs_table_storage.h:242,
+    reloaded like GcsInitData on boot). Snapshots the tables atomically
+    on mutation, debounced to one write per DEBOUNCE_S."""
+
+    DEBOUNCE_S = 0.2
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self._dirty = False
+        self._flush_lock = threading.Lock()
+        if os.path.exists(path):
+            import pickle as _pickle
+            try:
+                with open(path, "rb") as f:
+                    self._tables = _pickle.load(f)
+            except Exception:  # noqa: BLE001 - corrupt snapshot must not
+                # brick the control plane; set it aside and start fresh
+                corrupt = f"{path}.corrupt"
+                logger.error("GCS snapshot %s unreadable; moving to %s "
+                             "and starting empty", path, corrupt)
+                try:
+                    os.replace(path, corrupt)
+                except OSError:
+                    pass
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         daemon=True, name="gcs-persist")
+        self._stopped = False
+        self._flusher.start()
+
+    def _on_mutate_locked(self) -> None:
+        self._dirty = True
+
+    def flush(self) -> None:
+        import pickle as _pickle
+        # _flush_lock serializes writers (flusher thread vs stop()): both
+        # use the same tmp path, and interleaved writes would install a
+        # corrupt snapshot.
+        with self._flush_lock:
+            with self._lock:
+                if not self._dirty:
+                    return
+                blob = _pickle.dumps(self._tables)
+                self._dirty = False
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self.path)
+
+    def _flush_loop(self) -> None:
+        while not self._stopped:
+            time.sleep(self.DEBOUNCE_S)
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001
+                logger.exception("GCS persistence flush failed")
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.flush()
+
 
 class GcsServer:
     """The control-plane process (can be hosted in a thread or standalone)."""
@@ -59,8 +131,16 @@ class GcsServer:
     HEALTH_CHECK_PERIOD_S = 2.0
     HEALTH_CHECK_FAILURES_TO_DEAD = 3
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self.store = InMemoryStore()
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
+        # Pluggable storage (reference StoreClient): file-backed when a
+        # persist path is given (env RAY_TPU_GCS_PERSIST_PATH works too),
+        # so KV state — function table, job metadata, checkpoint pointers
+        # — survives a GCS restart.
+        persist_path = persist_path or os.environ.get(
+            "RAY_TPU_GCS_PERSIST_PATH")
+        self.store = PersistentStore(persist_path) if persist_path \
+            else InMemoryStore()
         self._pool = rpc_lib.ClientPool(timeout=30)
         self._lock = threading.Lock()
         # node_id hex -> NodeInfo
@@ -216,7 +296,10 @@ class GcsServer:
 
     def next_job_id(self) -> JobID:
         with self._lock:
-            self.job_counter += 1
+            # persisted so job ids stay unique across GCS restarts
+            counter = (self.store.get("meta", "job_counter") or 0) + 1
+            self.store.put("meta", "job_counter", counter)
+            self.job_counter = counter
             return JobID(self.job_counter.to_bytes(4, "big"))
 
     # ---- actors ----------------------------------------------------------
@@ -253,8 +336,10 @@ class GcsServer:
         with self._lock:
             view = {nid: dict(avail) for nid, avail in self.node_available.items()
                     if self.nodes[nid].alive}
+            labels = {nid: dict(self.nodes[nid].labels) for nid in view}
         return pick_node(view, required, spec.scheduling_strategy,
-                         local_node_id=None)
+                         local_node_id=None, labels=labels,
+                         locality_hints=spec.locality_hints)
 
     def _schedule_actor(self, actor_id_hex: str) -> None:
         spec = self.actor_specs[actor_id_hex]
@@ -556,3 +641,5 @@ class GcsServer:
         self._dead = True
         self.server.stop()
         self._pool.close_all()
+        if isinstance(self.store, PersistentStore):
+            self.store.stop()

@@ -26,8 +26,9 @@ from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import StoreServer
-from ray_tpu._private.scheduler import pick_node
+from ray_tpu._private.scheduler import _labels_match, pick_node
 from ray_tpu._private.state import (NodeAffinitySchedulingStrategy, NodeInfo,
+                                    NodeLabelSchedulingStrategy,
                                     PlacementGroupSchedulingStrategy,
                                     ResourceSet, TaskSpec, TaskType)
 
@@ -193,7 +194,7 @@ class NodeManager:
             candidates = [pl for pl in self.pending if pl.acquired is None]
         if not candidates:
             return
-        avail, totals, nodes = self._cluster_view()
+        avail, totals, nodes, labels = self._cluster_view()
         for pl in candidates:
             strategy = pl.spec.scheduling_strategy
             if isinstance(strategy, NodeAffinitySchedulingStrategy) \
@@ -202,7 +203,9 @@ class NodeManager:
             required = self._effective_resources(pl.spec)
             chosen = pick_node(avail, required, strategy,
                                local_node_id=self.node_id.hex(),
-                               totals=totals)
+                               totals=totals,
+                               locality_hints=pl.spec.locality_hints,
+                               labels=labels)
             logger.debug("respill: %s required=%s chosen=%s",
                          pl.spec.function_name, required.to_dict(),
                          chosen and chosen[:12])
@@ -223,11 +226,16 @@ class NodeManager:
 
     def _cluster_view(self) -> Tuple[Dict[str, Dict[str, float]],
                                      Dict[str, Dict[str, float]],
-                                     Dict[str, Tuple[str, int]]]:
+                                     Dict[str, Tuple[str, int]],
+                                     Dict[str, Dict[str, str]]]:
+        labels: Dict[str, Dict[str, str]] = {}
         try:
             view = self._gcs.call("get_cluster_resources")
-            nodes = {n.node_id.hex(): n.address
-                     for n in self._gcs.call("get_all_nodes") if n.alive}
+            nodes = {}
+            for n in self._gcs.call("get_all_nodes"):
+                if n.alive:
+                    nodes[n.node_id.hex()] = n.address
+                    labels[n.node_id.hex()] = dict(n.labels)
         except Exception:  # noqa: BLE001
             view, nodes = {}, {}
         avail = {nid: v["available"] for nid, v in view.items()}
@@ -236,13 +244,20 @@ class NodeManager:
             avail[self.node_id.hex()] = self.available.to_dict()
             totals[self.node_id.hex()] = self.resources_total.to_dict()
         nodes.setdefault(self.node_id.hex(), self.address)
-        return avail, totals, nodes
+        labels.setdefault(self.node_id.hex(),
+                          dict(self.info.labels))
+        return avail, totals, nodes, labels
 
     # ---- worker pool (reference worker_pool.cc) -------------------------
 
     def _runtime_env_key(self, spec: TaskSpec) -> str:
-        env_vars = (spec.runtime_env or {}).get("env_vars", {})
-        return repr(sorted(env_vars.items()))
+        """Worker-pool bucket key (reference worker_pool runtime-env-keyed
+        caching): a worker started for one env must not serve tasks whose
+        env_vars/working_dir/py_modules differ."""
+        renv = spec.runtime_env or {}
+        return repr((sorted((renv.get("env_vars") or {}).items()),
+                     renv.get("working_dir"),
+                     tuple(renv.get("py_modules") or ())))
 
     def _spawn_worker(self, runtime_env_key: str,
                       runtime_env: Optional[Dict[str, Any]]) -> _WorkerHandle:
@@ -261,6 +276,19 @@ class NodeManager:
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
             env[str(k)] = str(v)
+        # working_dir/py_modules (reference _private/runtime_env/
+        # working_dir.py, py_modules plugin): the worker starts in
+        # working_dir with it importable, and each py_module's parent on
+        # the path so `import <module>` works.
+        renv = runtime_env or {}
+        extra_paths = []
+        if renv.get("working_dir"):
+            extra_paths.append(os.path.abspath(renv["working_dir"]))
+        for mod in renv.get("py_modules") or ():
+            extra_paths.append(os.path.dirname(os.path.abspath(mod)))
+        if extra_paths:
+            env["PYTHONPATH"] = os.pathsep.join(
+                extra_paths + [env.get("PYTHONPATH", "")])
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"),
@@ -391,15 +419,17 @@ class NodeManager:
             # Hard affinity to another node: route there; it queues or
             # rejects. Never silently run elsewhere (reference
             # node_affinity_scheduling_policy.h semantics).
-            _, _, nodes = self._cluster_view()
+            _, _, nodes, _ = self._cluster_view()
             target = nodes.get(strategy.node_id)
             if target is None:
                 return ("infeasible",
                         f"hard-affinity node {strategy.node_id[:12]} is dead")
             return ("spill", target)
-        avail, totals, nodes = self._cluster_view()
+        avail, totals, nodes, labels = self._cluster_view()
         chosen = pick_node(avail, required, strategy,
-                           local_node_id=self.node_id.hex(), totals=totals)
+                           local_node_id=self.node_id.hex(), totals=totals,
+                           locality_hints=spec.locality_hints,
+                           labels=labels)
         if isinstance(strategy, NodeAffinitySchedulingStrategy) \
                 and not strategy.soft:
             chosen = self.node_id.hex()  # queue here (we are the target)
@@ -446,6 +476,15 @@ class NodeManager:
             remaining: List[_PendingLease] = []
             want_spawn: Dict[str, int] = {}
             for pl in self.pending:
+                # hard label constraints must hold on THIS node before a
+                # queued lease may dispatch locally (the cluster-level
+                # pick already respects them; local dispatch must too)
+                strategy = pl.spec.scheduling_strategy
+                if isinstance(strategy, NodeLabelSchedulingStrategy) \
+                        and strategy.hard and not _labels_match(
+                            self.info.labels, strategy.hard):
+                    remaining.append(pl)
+                    continue
                 if pl.acquired is None:
                     required = self._effective_resources(pl.spec)
                     if required.is_subset_of(self.available):

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private.state import (DefaultSchedulingStrategy,
                                     NodeAffinitySchedulingStrategy,
+                                    NodeLabelSchedulingStrategy,
                                     PlacementGroupSchedulingStrategy,
                                     ResourceSet, SchedulingStrategy,
                                     SpreadSchedulingStrategy)
@@ -36,16 +37,51 @@ def _utilization(total: ResourceSet, avail: Dict[str, float]) -> float:
     return util
 
 
+def _labels_match(node_labels: Dict[str, str],
+                  constraints: Dict[str, List[str]]) -> bool:
+    for key, allowed in constraints.items():
+        if key not in node_labels:
+            return False
+        if allowed and "" not in allowed and \
+                node_labels[key] not in allowed:
+            return False
+    return True
+
+
 def pick_node(view: Dict[str, Dict[str, float]], required: ResourceSet,
               strategy: SchedulingStrategy,
               local_node_id: Optional[str] = None,
               totals: Optional[Dict[str, Dict[str, float]]] = None,
-              rng: Optional[random.Random] = None) -> Optional[str]:
+              rng: Optional[random.Random] = None,
+              locality_hints: Optional[Dict[str, float]] = None,
+              labels: Optional[Dict[str, Dict[str, str]]] = None
+              ) -> Optional[str]:
     """Return the chosen node id hex, or None if nothing feasible now."""
     feasible = [nid for nid, avail in view.items() if _feasible(avail, required)]
     if not feasible:
         return None
     feasible.sort()  # determinism
+
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        # reference node_label_scheduling_policy.h: hard constraints
+        # filter; soft constraints prefer.
+        labels = labels or {}
+        hard_ok = [n for n in feasible
+                   if _labels_match(labels.get(n, {}), strategy.hard)]
+        if not hard_ok:
+            return None
+        soft_ok = [n for n in hard_ok
+                   if _labels_match(labels.get(n, {}), strategy.soft)]
+        return (soft_ok or hard_ok)[0]
+
+    # Object locality (reference lease_policy.h:56 LocalityAwareLeasePolicy
+    # + scorer.h): among feasible nodes, prefer the one already holding
+    # the most argument bytes — object-heavy pipelines (RL trajectories)
+    # then read args from local shm instead of pulling across nodes.
+    if locality_hints and isinstance(strategy, DefaultSchedulingStrategy):
+        best = max(feasible, key=lambda n: locality_hints.get(n, 0.0))
+        if locality_hints.get(best, 0.0) > 0.0:
+            return best
 
     if isinstance(strategy, NodeAffinitySchedulingStrategy):
         if strategy.node_id in view and _feasible(view[strategy.node_id],

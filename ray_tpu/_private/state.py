@@ -85,6 +85,17 @@ class PlacementGroupSchedulingStrategy(SchedulingStrategy):
     placement_group_capture_child_tasks: bool = False
 
 
+@dataclass
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    """Label-constrained placement (reference
+    util/scheduling_strategies.py:135 + node_label_scheduling_policy.h).
+    hard: {label_key: [allowed values]} — every key must match ("" in the
+    list means 'key exists'); soft: preferred but not required."""
+
+    hard: Dict[str, List[str]] = field(default_factory=dict)
+    soft: Dict[str, List[str]] = field(default_factory=dict)
+
+
 class TaskType(Enum):
     NORMAL_TASK = 0
     ACTOR_CREATION_TASK = 1
@@ -128,6 +139,10 @@ class TaskSpec:
     placement_group_bundle_index: int = -1
     # Runtime env (dict: env_vars, working_dir, ...)
     runtime_env: Optional[Dict[str, Any]] = None
+    # Data-locality hints: node id hex -> bytes of this task's args
+    # already resident there (reference lease_policy.h:56 locality-aware
+    # lease policy / scorer.h:25)
+    locality_hints: Dict[str, float] = field(default_factory=dict)
     # Misc
     name: str = ""
     namespace: str = ""

@@ -115,6 +115,11 @@ class ActorClass:
         return [m for m in dir(self._cls)
                 if not m.startswith("_") and callable(getattr(self._cls, m))]
 
+    def bind(self, *args: Any, **kwargs: Any):
+        """Lazy graph node (reference dag/class_node.py)."""
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self, args, kwargs)
+
     def remote(self, *args: Any, **kwargs: Any) -> ActorHandle:
         w = worker_mod.global_worker()
         cw = w.core_worker

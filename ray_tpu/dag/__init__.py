@@ -1,0 +1,145 @@
+"""ray_tpu.dag: lazy task graphs (DAGNode API).
+
+reference parity: python/ray/dag — DAGNode (dag_node.py:23),
+FunctionNode, ClassNode/ClassMethodNode, InputNode: `.bind()` builds the
+graph lazily; `.execute()` walks it, submitting each node as a task (or
+actor call) with upstream results passed as ObjectRefs — used by Serve
+app graphs and Workflow.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+_node_counter = [0]
+_counter_lock = threading.Lock()
+
+
+def _next_id() -> int:
+    with _counter_lock:
+        _node_counter[0] += 1
+        return _node_counter[0]
+
+
+class DAGNode:
+    """Base graph node. Subclasses define _execute_impl."""
+
+    def __init__(self, args: tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+        self._id = _next_id()
+
+    # -- traversal -----------------------------------------------------
+
+    def _children(self) -> List["DAGNode"]:
+        out = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        out += [v for v in self._bound_kwargs.values()
+                if isinstance(v, DAGNode)]
+        return out
+
+    def _resolve_args(self, memo: Dict[int, Any],
+                      dag_input: Any) -> Tuple[tuple, Dict[str, Any]]:
+        def res(x: Any) -> Any:
+            if isinstance(x, DAGNode):
+                return x._execute_memo(memo, dag_input)
+            return x
+        return (tuple(res(a) for a in self._bound_args),
+                {k: res(v) for k, v in self._bound_kwargs.items()})
+
+    def _execute_memo(self, memo: Dict[int, Any], dag_input: Any) -> Any:
+        if self._id not in memo:
+            memo[self._id] = self._execute_impl(memo, dag_input)
+        return memo[self._id]
+
+    def _execute_impl(self, memo: Dict[int, Any], dag_input: Any) -> Any:
+        raise NotImplementedError
+
+    def execute(self, dag_input: Any = None) -> Any:
+        """Run the graph; returns this node's result (an ObjectRef for
+        task/method nodes — ray_tpu.get() it)."""
+        return self._execute_memo({}, dag_input)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to execute() (reference
+    input_node.py)."""
+
+    def __init__(self) -> None:
+        super().__init__((), {})
+
+    def _execute_impl(self, memo, dag_input):
+        return dag_input
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+class FunctionNode(DAGNode):
+    """A @remote function bound into the graph (reference
+    function_node.py)."""
+
+    def __init__(self, remote_fn: Any, args: tuple,
+                 kwargs: Dict[str, Any]):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, memo, dag_input):
+        args, kwargs = self._resolve_args(memo, dag_input)
+        return self._remote_fn.remote(*args, **kwargs)
+
+    @property
+    def name(self) -> str:
+        return getattr(self._remote_fn, "_fn", self._remote_fn).__name__
+
+
+class ClassNode(DAGNode):
+    """An actor class bound into the graph (reference class_node.py);
+    attribute access yields bindable methods."""
+
+    def __init__(self, actor_cls: Any, args: tuple,
+                 kwargs: Dict[str, Any]):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+
+    def _execute_impl(self, memo, dag_input):
+        args, kwargs = self._resolve_args(memo, dag_input)
+        return self._actor_cls.remote(*args, **kwargs)
+
+    def __getattr__(self, method_name: str) -> "_BindableMethod":
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        return _BindableMethod(self, method_name)
+
+
+class _BindableMethod:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args: Any, **kwargs: Any) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name,
+                               args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 args: tuple, kwargs: Dict[str, Any]):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def _children(self) -> List[DAGNode]:
+        return super()._children() + [self._class_node]
+
+    def _execute_impl(self, memo, dag_input):
+        actor = self._class_node._execute_memo(memo, dag_input)
+        args, kwargs = self._resolve_args(memo, dag_input)
+        return getattr(actor, self._method_name).remote(*args, **kwargs)
+
+
+__all__ = ["DAGNode", "InputNode", "FunctionNode", "ClassNode",
+           "ClassMethodNode"]

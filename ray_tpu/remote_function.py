@@ -80,6 +80,12 @@ class RemoteFunction:
             f"remote function '{self._fn.__name__}' cannot be called "
             f"directly; use .remote()")
 
+    def bind(self, *args: Any, **kwargs: Any):
+        """Lazy graph node (reference dag/function_node.py): builds a
+        ray_tpu.dag.FunctionNode instead of submitting now."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args: Any, **kwargs: Any) -> Any:
         w = worker_mod.global_worker()
         cw = w.core_worker

@@ -7,6 +7,7 @@ this module is the public import path."""
 from ray_tpu._private.state import (  # noqa: F401
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     SchedulingStrategy,
     SpreadSchedulingStrategy,
@@ -15,5 +16,5 @@ from ray_tpu._private.state import (  # noqa: F401
 __all__ = [
     "SchedulingStrategy", "DefaultSchedulingStrategy",
     "SpreadSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "PlacementGroupSchedulingStrategy",
+    "PlacementGroupSchedulingStrategy", "NodeLabelSchedulingStrategy",
 ]

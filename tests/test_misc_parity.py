@@ -1,0 +1,201 @@
+"""Round-3 parity batch: GCS persistence, locality/label scheduling,
+runtime_env working_dir/py_modules, dag, workflow, long-poll gets.
+
+reference parity: redis_store_client.h (GCS persistence),
+lease_policy.h:56 (locality), node_label_scheduling_policy.h (labels),
+_private/runtime_env (working_dir/py_modules), python/ray/dag,
+python/ray/workflow.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_gcs_persistence_survives_restart(tmp_path):
+    from ray_tpu._private.gcs import GcsServer
+
+    path = str(tmp_path / "gcs_state.pkl")
+    g1 = GcsServer(persist_path=path)
+    g1.kv_put("fn:abc", b"function blob")
+    g1.kv_put("ckpt:latest", b"/some/path")
+    jid1 = g1.next_job_id()
+    g1.shutdown()
+
+    g2 = GcsServer(persist_path=path)
+    assert g2.kv_get("fn:abc") == b"function blob"
+    assert g2.kv_get("ckpt:latest") == b"/some/path"
+    jid2 = g2.next_job_id()
+    assert jid2.binary() != jid1.binary(), "job ids must stay unique"
+    g2.shutdown()
+
+
+def test_locality_hint_scheduling_unit():
+    from ray_tpu._private.scheduler import pick_node
+    from ray_tpu._private.state import (DefaultSchedulingStrategy,
+                                        ResourceSet)
+
+    view = {"aa": {"CPU": 4.0}, "bb": {"CPU": 4.0}}
+    required = ResourceSet({"CPU": 1.0})
+    # without hints the local node wins; with bytes resident on bb, bb wins
+    assert pick_node(view, required, DefaultSchedulingStrategy(),
+                     local_node_id="aa") == "aa"
+    chosen = pick_node(view, required, DefaultSchedulingStrategy(),
+                       local_node_id="aa",
+                       locality_hints={"bb": 10_000_000.0})
+    assert chosen == "bb"
+
+
+def test_node_label_scheduling_unit():
+    from ray_tpu._private.scheduler import pick_node
+    from ray_tpu._private.state import (NodeLabelSchedulingStrategy,
+                                        ResourceSet)
+
+    view = {"aa": {"CPU": 4.0}, "bb": {"CPU": 4.0}}
+    labels = {"aa": {"zone": "us-1", "tier": "spot"},
+              "bb": {"zone": "us-2"}}
+    required = ResourceSet({"CPU": 1.0})
+    s = NodeLabelSchedulingStrategy(hard={"zone": ["us-2"]})
+    assert pick_node(view, required, s, labels=labels) == "bb"
+    s = NodeLabelSchedulingStrategy(hard={"tier": [""]})  # key exists
+    assert pick_node(view, required, s, labels=labels) == "aa"
+    s = NodeLabelSchedulingStrategy(hard={"zone": ["eu-9"]})
+    assert pick_node(view, required, s, labels=labels) is None
+    # soft prefers but degrades
+    s = NodeLabelSchedulingStrategy(soft={"zone": ["us-2"]})
+    assert pick_node(view, required, s, labels=labels) == "bb"
+    s = NodeLabelSchedulingStrategy(soft={"zone": ["eu-9"]})
+    assert pick_node(view, required, s, labels=labels) in ("aa", "bb")
+
+
+def test_runtime_env_working_dir_and_py_modules(ray_start, tmp_path):
+    workdir = tmp_path / "wd"
+    workdir.mkdir()
+    (workdir / "data.txt").write_text("from-working-dir")
+    module_dir = tmp_path / "extra_mod"
+    module_dir.mkdir()
+    (module_dir / "__init__.py").write_text("MAGIC = 'from-py-module'\n")
+
+    @ray_tpu.remote(runtime_env={
+        "working_dir": str(workdir),
+        "py_modules": [str(module_dir)],
+    })
+    def probe():
+        import extra_mod
+        with open("data.txt") as f:
+            return f.read(), extra_mod.MAGIC
+
+    data, magic = ray_tpu.get(probe.remote())
+    assert data == "from-working-dir"
+    assert magic == "from-py-module"
+
+
+def test_dag_function_graph(ray_start):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    def plus(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def times(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        dag = times.bind(plus.bind(inp, 10), 2)
+    assert ray_tpu.get(dag.execute(5)) == 30
+    assert ray_tpu.get(dag.execute(0)) == 20
+
+
+def test_dag_diamond_executes_shared_node_once(ray_start):
+    counter = f"/tmp/dag_count_{os.getpid()}"
+    if os.path.exists(counter):
+        os.unlink(counter)
+
+    @ray_tpu.remote
+    def base(path):
+        with open(path, "a") as f:
+            f.write("x")
+        return 3
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    shared = base.bind(counter)
+    dag = add.bind(shared, shared)
+    assert ray_tpu.get(dag.execute()) == 6
+    assert os.path.getsize(counter) == 1, "shared node ran twice"
+    os.unlink(counter)
+
+
+def test_dag_actor_graph(ray_start):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    node = Acc.options(num_cpus=0.1).bind(100)
+    dag = node.add.bind(5)
+    assert ray_tpu.get(dag.execute()) == 105
+
+
+def test_workflow_resume_skips_completed_steps(ray_start, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    marker = str(tmp_path / "exec_count")
+
+    @ray_tpu.remote
+    def expensive(path, x):
+        with open(path, "a") as f:
+            f.write("x")
+        return x * 2
+
+    @ray_tpu.remote
+    def flaky(path, x):
+        if not os.path.exists(path + ".fixed"):
+            raise RuntimeError("transient failure")
+        return x + 1
+
+    with InputNode() as inp:
+        dag = flaky.bind(marker, expensive.bind(marker, inp))
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf1", storage=str(tmp_path),
+                     dag_input=21)
+    assert os.path.getsize(marker) == 1  # expensive completed once
+
+    open(marker + ".fixed", "w").write("1")
+    result = workflow.resume(dag, workflow_id="wf1",
+                             storage=str(tmp_path), dag_input=21)
+    assert result == 43
+    assert os.path.getsize(marker) == 1, \
+        "resume must not re-run the checkpointed step"
+    assert workflow.get_output("wf1", storage=str(tmp_path)) == 43
+
+
+def test_borrower_longpoll_get(ray_start):
+    """A borrower blocked on a pending object wakes via the owner's
+    long-poll, without ObjectLostError or timeout."""
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(2)
+        return "finally"
+
+    @ray_tpu.remote
+    def consume(refs):
+        return ray_tpu.get(refs[0])  # borrower waits on pending object
+
+    ref = slow_value.remote()
+    t0 = time.time()
+    assert ray_tpu.get(consume.remote([ref]), timeout=60) == "finally"
+    assert time.time() - t0 < 30
