@@ -1,0 +1,175 @@
+"""Dashboard head: HTTP JSON endpoints over the state API.
+
+reference parity: dashboard/head.py (aiohttp head hosting module routes)
++ dashboard/state_aggregator.py. Endpoints:
+
+    GET /             — HTML overview (auto-refreshing tables)
+    GET /api/cluster  — nodes + resource totals/available
+    GET /api/nodes    — state.list_nodes()
+    GET /api/tasks    — state.list_tasks() (+ ?state= filter)
+    GET /api/actors   — state.list_actors()
+    GET /api/workers  — state.list_workers()
+    GET /api/objects  — state.list_objects() + store stats
+    GET /api/jobs     — job table from the GCS KV
+    GET /api/summary  — task-state counts
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+from urllib.parse import parse_qs, urlparse
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body { font-family: monospace; margin: 2em; }
+ table { border-collapse: collapse; margin: 1em 0; }
+ td, th { border: 1px solid #999; padding: 2px 8px; text-align: left; }
+ h2 { margin-bottom: 0; }
+</style></head>
+<body><h1>ray_tpu dashboard</h1>
+<div id="content">loading…</div>
+<script>
+function esc(v) {
+  return String(v).replace(/[&<>"']/g,
+      c => '&#' + c.charCodeAt(0) + ';');
+}
+async function load() {
+  const [cluster, summary, actors] = await Promise.all([
+    fetch('/api/cluster').then(r => r.json()),
+    fetch('/api/summary').then(r => r.json()),
+    fetch('/api/actors').then(r => r.json())]);
+  let html = '<h2>cluster</h2><table>';
+  for (const [k, v] of Object.entries(cluster.resources_total)) {
+    html += `<tr><td>${esc(k)}</td>`
+          + `<td>${esc(cluster.resources_available[k] ?? 0)}`
+          + ` / ${esc(v)} available</td></tr>`;
+  }
+  html += `</table><h2>tasks</h2><table>`;
+  for (const [k, v] of Object.entries(summary)) {
+    html += `<tr><td>${esc(k)}</td><td>${esc(v)}</td></tr>`;
+  }
+  html += '</table><h2>actors</h2><table>'
+        + '<tr><th>id</th><th>class</th><th>state</th></tr>';
+  for (const a of actors.slice(0, 50)) {
+    html += `<tr><td>${esc(a.actor_id.slice(0,12))}</td>`
+          + `<td>${esc(a.class_name)}</td><td>${esc(a.state)}</td></tr>`;
+  }
+  html += '</table>';
+  document.getElementById('content').innerHTML = html;
+}
+load();
+</script></body></html>"""
+
+
+class _NoRoute(Exception):
+    """Unknown dashboard route (distinct from downstream KeyErrors, which
+    must surface as 500s, not 404s)."""
+
+
+class DashboardHead:
+    """Runs inside any process connected to the cluster (typically an
+    actor started by start_dashboard)."""
+
+    def __init__(self, port: int = 8265, host: str = "127.0.0.1"):
+        head = self
+        self._job_client = None
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, payload: Any, code: int = 200) -> None:
+                body = json.dumps(payload, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                route = parsed.path.rstrip("/") or "/"
+                try:
+                    if route == "/":
+                        body = _INDEX_HTML.encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/html")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    params = {k: v[0] for k, v
+                              in parse_qs(parsed.query).items()}
+                    self._json(head.route(route, params))
+                except _NoRoute:
+                    self._json({"error": f"no route {route}"}, 404)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": str(e)}, 500)
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="dashboard-http").start()
+
+    def route(self, route: str, params: Dict[str, str]) -> Any:
+        import ray_tpu
+        from ray_tpu.util import state as s
+        if route == "/api/cluster":
+            return {
+                "nodes": s.list_nodes(),
+                "resources_total": ray_tpu.cluster_resources(),
+                "resources_available": ray_tpu.available_resources(),
+            }
+        if route == "/api/nodes":
+            return s.list_nodes()
+        if route == "/api/tasks":
+            filters = {"state": params["state"]} if "state" in params \
+                else None
+            return s.list_tasks(filters=filters)
+        if route == "/api/actors":
+            return s.list_actors()
+        if route == "/api/workers":
+            return s.list_workers()
+        if route == "/api/objects":
+            return {"objects": s.list_objects(),
+                    "store_stats": s.object_store_stats()}
+        if route == "/api/summary":
+            return s.summarize_tasks()
+        if route == "/api/jobs":
+            if self._job_client is None:
+                from ray_tpu.job import JobSubmissionClient
+                self._job_client = JobSubmissionClient(
+                    ray_tpu.get_gcs_address())
+            return self._job_client.list_jobs()
+        raise _NoRoute(route)
+
+    def ready(self) -> int:
+        return self.port
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()  # release the listening socket fd
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1"):
+    """Start the dashboard as an actor pinned to THIS node (a free-
+    floating actor on a multi-node cluster would bind loopback on some
+    other machine and be reachable from nowhere); returns its handle
+    (call .ready.remote() for the bound port). Pass host="0.0.0.0" to
+    serve off-node."""
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    cls = ray_tpu.remote(DashboardHead)
+    here = ray_tpu.get_runtime_context().get_node_id()
+    dash = cls.options(
+        num_cpus=0.1, max_concurrency=4,
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=here)).remote(port, host)
+    ray_tpu.get(dash.ready.remote(), timeout=60)
+    return dash

@@ -1,0 +1,66 @@
+"""Dashboard HTTP endpoints (reference dashboard/head.py + modules)."""
+
+import json
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+
+def _get(port, route):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_dashboard_endpoints(ray_start):
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    @ray_tpu.remote
+    class Dummy:
+        def ping(self):
+            return "pong"
+
+    a = Dummy.options(num_cpus=0.1).remote()
+    ray_tpu.get([traced.remote(), a.ping.remote()])
+    time.sleep(1.5)  # task event flush
+
+    dash = start_dashboard(port=0)
+    port = ray_tpu.get(dash.ready.remote())
+    try:
+        cluster = _get(port, "/api/cluster")
+        assert cluster["resources_total"].get("CPU", 0) > 0
+        assert len(cluster["nodes"]) >= 1
+
+        tasks = _get(port, "/api/tasks")
+        assert any(t.get("name") == "traced" for t in tasks)
+        finished = _get(port, "/api/tasks?state=FINISHED")
+        assert finished and all(t["state"] == "FINISHED" for t in finished)
+
+        actors = _get(port, "/api/actors")
+        assert any(x["class_name"] == "Dummy" for x in actors)
+
+        summary = _get(port, "/api/summary")
+        assert summary.get("FINISHED", 0) >= 1
+
+        objects = _get(port, "/api/objects")
+        assert "store_stats" in objects
+
+        # HTML overview serves
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=30) as r:
+            assert b"ray_tpu dashboard" in r.read()
+
+        # unknown route → 404 JSON
+        try:
+            _get(port, "/api/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        ray_tpu.get(dash.stop.remote())
+        ray_tpu.kill(a)
+        ray_tpu.kill(dash)
