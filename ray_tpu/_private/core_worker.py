@@ -179,6 +179,32 @@ class CoreWorker:
     def set_current_task(self, task_id: Optional[TaskID]) -> None:
         self._tls.task_id = task_id
 
+    # ---- tracing (reference tracing_helper.py context propagation) ---
+
+    def current_trace_id(self) -> Optional[str]:
+        return getattr(self._tls, "trace_id", None)
+
+    def current_trace_name(self) -> Optional[str]:
+        return getattr(self._tls, "trace_name", None)
+
+    def set_current_trace(self, trace_id: Optional[str],
+                          name: Optional[str] = None) -> None:
+        self._tls.trace_id = trace_id
+        self._tls.trace_name = name
+
+    def _attach_trace(self, spec: TaskSpec) -> None:
+        """Child tasks inherit the caller's trace; a driver-side submit
+        outside any trace starts a fresh one."""
+        import uuid
+        spec.trace_id = self.current_trace_id() or uuid.uuid4().hex[:16]
+        parent = getattr(self._tls, "task_id", None)
+        if parent is not None:
+            spec.parent_task_id = parent.hex()
+        # the start_trace(name) label rides on this submitter's events
+        name = self.current_trace_name()
+        if name:
+            self.task_events.record(spec.task_id.hex(), trace_name=name)
+
     def next_put_index(self) -> int:
         with self._lock:
             self._put_index += 1
@@ -635,10 +661,12 @@ class CoreWorker:
             self.tasks[spec.task_id.hex()] = _TaskEntry(
                 spec=spec, retries_left=spec.max_retries,
                 return_ids=return_ids)
+        self._attach_trace(spec)
         self.task_events.record(
             spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
             name=spec.function_name, type="NORMAL_TASK",
-            job_id=spec.job_id.hex())
+            job_id=spec.job_id.hex(), trace_id=spec.trace_id,
+            parent_task_id=spec.parent_task_id)
         spec.locality_hints = self._locality_hints(spec.arg_object_refs)
         self._pin_args(spec.arg_object_refs)
         self._request_lease(spec)
@@ -837,12 +865,14 @@ class CoreWorker:
         with self._lock:
             self.actors[spec.actor_id.hex()] = _ActorState(
                 actor_id=spec.actor_id)
+        self._attach_trace(spec)
         self._gcs.call("register_actor", spec=spec, name=name,
                        namespace=namespace)
         self.task_events.record(
             spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
             name=f"{spec.function_name}.__init__", type="ACTOR_CREATION_TASK",
-            job_id=spec.job_id.hex())
+            job_id=spec.job_id.hex(), trace_id=spec.trace_id,
+            parent_task_id=spec.parent_task_id)
 
     def attach_actor(self, actor_id: ActorID) -> None:
         """Track an actor we only hold a handle to (named/deserialized)."""
@@ -862,6 +892,10 @@ class CoreWorker:
             resources={}, owner_address=self.address,
             owner_worker_id=self.worker_id, actor_id=actor_id,
             actor_method_name=method_name)
+        # before the spec becomes reachable by other threads: a queued
+        # spec can be popped+pickled by an in-flight _resolve_actor the
+        # moment the lock below releases
+        self._attach_trace(spec)
         return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
                       for i in range(num_returns)]
         with self._lock:
@@ -892,7 +926,8 @@ class CoreWorker:
         self.task_events.record(
             spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
             name=f"{method_name} [actor {actor_id.hex()[:8]}]",
-            type="ACTOR_TASK", job_id=spec.job_id.hex())
+            type="ACTOR_TASK", job_id=spec.job_id.hex(),
+            trace_id=spec.trace_id, parent_task_id=spec.parent_task_id)
         self._pin_args(arg_refs)
         if addr is not None:
             self._push_actor_task(addr, spec)
@@ -1278,6 +1313,7 @@ class _Executor:
             self._report_error(spec, exc.TaskCancelledError(spec.function_name))
             return
         cw.set_current_task(spec.task_id)
+        cw.set_current_trace(spec.trace_id)
         cw.task_events.record(spec.task_id.hex(), state="RUNNING",
                               ts_running=_ev_now(),
                               worker_id=cw.worker_id.hex(),
@@ -1332,6 +1368,7 @@ class _Executor:
         finally:
             cw.task_events.record(spec.task_id.hex(), ts_exec_end=_ev_now())
             cw.set_current_task(None)
+            cw.set_current_trace(None)
             if spec.task_type == TaskType.NORMAL_TASK:
                 cw.current_placement_group_id = None
 

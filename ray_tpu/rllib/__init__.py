@@ -9,9 +9,12 @@ set; the reference's 34-algo registry is tracked in SURVEY.md §8.3).
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala.impala import (Impala,  # noqa: F401
                                                     ImpalaConfig)
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.registry import (  # noqa: F401
+    get_algorithm_class, registered_algorithms)
 from ray_tpu.rllib.core.catalog import (DiscreteConvModule,  # noqa: F401
                                         DiscreteMLPModule)
 from ray_tpu.rllib.core.learner import Learner  # noqa: F401
@@ -24,7 +27,8 @@ from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
-    "ImpalaConfig", "Learner", "LearnerGroup", "RLModule",
+    "ImpalaConfig", "APPO", "APPOConfig", "get_algorithm_class",
+    "registered_algorithms", "Learner", "LearnerGroup", "RLModule",
     "DiscreteMLPModule", "DiscreteConvModule", "Env", "register_env",
     "make_env", "SingleAgentEnvRunner",
 ]

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig  # noqa: F401
+
+__all__ = ["APPO", "APPOConfig"]

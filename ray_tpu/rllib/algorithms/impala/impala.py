@@ -52,7 +52,9 @@ class ImpalaConfig(AlgorithmConfig):
 class ImpalaLearner(Learner):
     """V-trace actor-critic loss on time-major sequence batches."""
 
-    def compute_loss(self, params, batch, extra):
+    def _vtrace_prelude(self, params, batch):
+        """Shared forward + V-trace computation (used by IMPALA's
+        policy-gradient loss and APPO's clipped surrogate)."""
         import jax.numpy as jnp
 
         t, b = batch["actions"].shape
@@ -72,7 +74,13 @@ class ImpalaLearner(Learner):
             batch["bootstrap_value"],
             self.config.clip_rho_threshold,
             self.config.clip_pg_rho_threshold)
+        return dist, target_logp, log_rhos, values, vtrace
 
+    def compute_loss(self, params, batch, extra):
+        import jax.numpy as jnp
+
+        dist, target_logp, _log_rhos, values, vtrace = \
+            self._vtrace_prelude(params, batch)
         pg_loss = -jnp.mean(target_logp * vtrace.pg_advantages)
         vf_loss = 0.5 * jnp.mean((vtrace.vs - values) ** 2)
         entropy = jnp.mean(dist.entropy())

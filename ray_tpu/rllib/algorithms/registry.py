@@ -1,0 +1,43 @@
+"""Algorithm registry (reference rllib/algorithms/registry.py).
+
+The reference registers ~34 algorithms; the TPU build ships the
+north-star set (SURVEY §8.3: ppo, impala, + appo sharing IMPALA's
+machinery) behind the same lookup surface so `get_algorithm_class("PPO")`
+and Tuner-by-name work.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Type
+
+
+def _registry():
+    from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
+    from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
+    from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
+    return {
+        "PPO": (PPO, PPOConfig),
+        "IMPALA": (Impala, ImpalaConfig),
+        "APPO": (APPO, APPOConfig),
+    }
+
+
+def get_algorithm_class(name: str, return_config: bool = False):
+    """reference registry.py get_algorithm_class."""
+    entry = _registry().get(name.upper())
+    if entry is None:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: "
+            f"{sorted(_registry())}")
+    algo, config = entry
+    if return_config:
+        return algo, config()
+    return algo
+
+
+def get_algorithm_config(name: str):
+    return get_algorithm_class(name, return_config=True)[1]
+
+
+def registered_algorithms() -> Tuple[str, ...]:
+    return tuple(sorted(_registry()))
