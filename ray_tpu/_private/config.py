@@ -35,6 +35,9 @@ class Config:
     max_workers_per_node = _define("max_workers_per_node", 32, int)
     worker_register_timeout_s = _define("worker_register_timeout_s", 60.0, float)
     idle_worker_kill_timeout_s = _define("idle_worker_kill_timeout_s", 300.0, float)
+    # keep this many idle workers warm regardless of the timeout
+    # (reference worker_pool soft limit ~ num_cpus)
+    idle_worker_pool_floor = _define("idle_worker_pool_floor", 2, int)
     # Scheduling
     lease_request_timeout_s = _define("lease_request_timeout_s", 120.0, float)
     resource_report_period_s = _define("resource_report_period_s", 0.5, float)

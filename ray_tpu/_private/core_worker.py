@@ -145,6 +145,7 @@ class CoreWorker:
             "cw_remove_ref": self._on_remove_ref,
             "cw_pubsub_push": self._on_pubsub_push,
             "cw_kill_self": self._on_kill_self,
+            "cw_can_exit": self._on_can_exit,
             "cw_ping": lambda: "pong",
         }
         self.executor: Optional[_Executor] = None
@@ -1198,6 +1199,15 @@ class CoreWorker:
         self._subscriptions[(channel, token)] = callback
         self._gcs.call("subscribe", channel=channel, address=self.address,
                        token=token)
+
+    def _on_can_exit(self) -> bool:
+        """May this worker exit without stranding objects? False while
+        anyone holds a pin on objects we own (a driver's ref to a value
+        this worker put() makes us the owner — killing us would lose it;
+        reference: the raylet's cooperative idle Exit RPC that the core
+        worker declines while it owns in-scope objects)."""
+        with self._lock:
+            return not self.arg_pins and not self.borrower_pins
 
     def _on_kill_self(self) -> str:
         threading.Timer(0.05, lambda: os._exit(0)).start()

@@ -182,7 +182,57 @@ class NodeManager:
                 self._respill_pending()
             except Exception:  # noqa: BLE001
                 logger.warning("respill round failed", exc_info=True)
+            try:
+                self._reap_idle_workers()
+            except Exception:  # noqa: BLE001
+                logger.warning("idle reap failed", exc_info=True)
             time.sleep(Config.resource_report_period_s)
+
+    def _reap_idle_workers(self) -> None:
+        """Kill workers idle past idle_worker_kill_timeout_s while the
+        pool exceeds its floor (reference worker_pool.cc
+        TryKillingIdleWorkers: kill down to the soft limit only). Each
+        candidate is asked first (cw_can_exit) — a worker that OWNS
+        objects someone still references must not die, or those objects
+        are lost with it."""
+        timeout = Config.idle_worker_kill_timeout_s
+        if timeout <= 0:
+            return
+        floor = max(0, int(Config.idle_worker_pool_floor))
+        now = time.time()
+        candidates: List[_WorkerHandle] = []
+        with self._lock:
+            n_idle = sum(len(ids) for ids in self.idle.values())
+            for ids in self.idle.values():
+                for wid in list(ids):
+                    if n_idle - len(candidates) <= floor:
+                        break
+                    h = self.workers.get(wid)
+                    if h is not None and h.address is not None and \
+                            now - h.idle_since > timeout:
+                        candidates.append(h)
+        for h in candidates:
+            try:
+                can_exit = self._pool.get(h.address).call("cw_can_exit")
+            except Exception:  # noqa: BLE001 - unreachable == already dead
+                can_exit = True
+            if not can_exit:
+                continue
+            with self._lock:
+                # it may have been leased since the scan; only reap if
+                # still idle (remove from idle so it can't be re-leased,
+                # then let _monitor_worker -> _on_worker_death do the
+                # full cleanup every other kill path uses)
+                ids = self.idle.get(h.runtime_env_key, [])
+                if h.worker_id.hex() not in ids:
+                    continue
+                ids.remove(h.worker_id.hex())
+            logger.info("reaping idle worker %s", h.worker_id.hex()[:12])
+            if h.proc is not None:
+                try:
+                    h.proc.terminate()
+                except OSError:
+                    pass
 
     def _respill_pending(self) -> None:
         """Re-route queued leases that became feasible on another node

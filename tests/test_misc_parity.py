@@ -199,3 +199,49 @@ def test_borrower_longpoll_get(ray_start):
     t0 = time.time()
     assert ray_tpu.get(consume.remote([ref]), timeout=60) == "finally"
     assert time.time() - t0 < 30
+
+
+def test_idle_workers_reaped():
+    """Workers idle past idle_worker_kill_timeout_s are killed
+    (reference worker_pool.cc idle-worker reaping)."""
+    import subprocess
+    import sys
+    script = """
+import gc
+import time
+import ray_tpu
+from ray_tpu.util import state as state_api
+ray_tpu.init(num_cpus=4)
+@ray_tpu.remote
+def f():
+    return 1
+@ray_tpu.remote
+def put_owned():
+    return [ray_tpu.put(list(range(1000)))]  # worker owns the inner obj
+inner = ray_tpu.get(put_owned.remote())[0]
+assert ray_tpu.get([f.remote() for _ in range(3)]) == [1, 1, 1]
+# the owner of a still-referenced object must SURVIVE reaping
+time.sleep(6)
+assert len(state_api.list_workers()) >= 1, "object owner was reaped"
+assert sum(ray_tpu.get(inner)) == 499500
+# release the ref: now everything reaps to zero
+del inner
+gc.collect()
+deadline = time.time() + 30
+while time.time() < deadline and len(state_api.list_workers()) > 0:
+    time.sleep(0.5)
+assert len(state_api.list_workers()) == 0, state_api.list_workers()
+# pool refills on demand after reaping
+assert ray_tpu.get(f.remote()) == 1
+ray_tpu.shutdown()
+print("REAP_OK")
+"""
+    env = dict(os.environ)
+    env["RAY_TPU_idle_worker_kill_timeout_s"] = "2"
+    env["RAY_TPU_idle_worker_pool_floor"] = "0"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=180,
+                         cwd=repo)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "REAP_OK" in out.stdout
