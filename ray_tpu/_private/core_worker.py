@@ -668,27 +668,31 @@ class CoreWorker:
             name=spec.function_name, type="NORMAL_TASK",
             job_id=spec.job_id.hex(), trace_id=spec.trace_id,
             parent_task_id=spec.parent_task_id)
-        spec.locality_hints = self._locality_hints(spec.arg_object_refs)
+        spec.locality_hints, spec.arg_locations = \
+            self._locality_info(spec.arg_object_refs)
         self._pin_args(spec.arg_object_refs)
         self._request_lease(spec)
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
-    def _locality_hints(self, arg_ids: List[ObjectID]) -> Dict[str, float]:
-        """node id hex -> bytes of the task's args already resident there
-        (reference lease_policy.h:56). Uses the owner's location cache;
+    def _locality_info(self, arg_ids: List[ObjectID]):
+        """(node id hex -> resident arg bytes, oid -> (store, size)) from
+        the owner's location cache (reference lease_policy.h:56 +
+        the per-arg locations the raylet's dependency manager pulls);
         inline args contribute nothing (they travel in the spec)."""
         if not arg_ids:
-            return {}
+            return {}, {}
         store_to_node = self._store_to_node_map()
         hints: Dict[str, float] = {}
+        locations: Dict[str, Any] = {}
         with self._lock:
             for oid in arg_ids:
                 loc = self.objects.get(oid.hex())
                 if loc is not None and loc[0] == STORE:
+                    locations[oid.hex()] = (tuple(loc[1]), int(loc[2]))
                     node = store_to_node.get(tuple(loc[1]))
                     if node is not None:
                         hints[node] = hints.get(node, 0.0) + float(loc[2])
-        return hints
+        return hints, locations
 
     def _store_to_node_map(self) -> Dict[Tuple[str, int], str]:
         ts, cached = self._store_map_cache
@@ -867,6 +871,8 @@ class CoreWorker:
             self.actors[spec.actor_id.hex()] = _ActorState(
                 actor_id=spec.actor_id)
         self._attach_trace(spec)
+        spec.locality_hints, spec.arg_locations = \
+            self._locality_info(spec.arg_object_refs)
         self._gcs.call("register_actor", spec=spec, name=name,
                        namespace=namespace)
         self.task_events.record(

@@ -126,6 +126,7 @@ class NodeManager:
         self.leases: Dict[str, str] = {}                # lease id -> worker id hex
         self._starting = 0
         self._starting_by_key: Dict[str, int] = {}
+        self.num_args_prefetched = 0
         self._prepared: Dict[Tuple[str, int], Dict[str, float]] = {}
         self._committed: Dict[Tuple[str, int], Tuple] = {}
 
@@ -564,6 +565,8 @@ class NodeManager:
             self.pending = remaining
         for key, renv in spawns:
             self._spawn_worker(key, renv)
+        if granted:
+            self._prefetch_args([pl.spec for pl, _ in granted])
         for pl, handle in granted:
             try:
                 self._pool.get(pl.reply_to).call(
@@ -577,6 +580,33 @@ class NodeManager:
                 logger.warning("lease reply to %s failed; reclaiming",
                                pl.reply_to)
                 self.return_worker(pl.lease_id)
+
+    def _prefetch_args(self, specs: List[TaskSpec]) -> None:
+        """Pull the batch's remote args into the local store while the
+        lease replies are in flight (reference raylet DependencyManager +
+        PullManager: args land on the node before dispatch; without it
+        the worker stalls pulling them serially at execution time). One
+        thread per dispatch batch; the store dedups concurrent pulls of
+        the same object."""
+        remote_args = {}
+        for spec in specs:
+            for oid, (addr, size) in spec.arg_locations.items():
+                if tuple(addr) != self.store.address:
+                    remote_args[oid] = (tuple(addr), size)
+        if not remote_args:
+            return
+
+        def pull_all() -> None:
+            for oid, (addr, size) in remote_args.items():
+                try:
+                    self.store.pull(oid, addr, size)
+                    with self._lock:
+                        self.num_args_prefetched += 1
+                except Exception:  # noqa: BLE001 - worker's own pull (or
+                    pass  # lineage recovery) is the fallback path
+
+        threading.Thread(target=pull_all, daemon=True,
+                         name="arg-prefetch").start()
 
     def cancel_lease(self, lease_id: str) -> None:
         with self._lock:
@@ -633,6 +663,7 @@ class NodeManager:
             handle.is_actor = True
             handle.actor_id_hex = spec.actor_id.hex()
             handle.current_task = spec
+        self._prefetch_args([spec])
         try:
             self._pool.get(handle.address).call("w_push_task", spec=spec)
             return True
@@ -716,6 +747,7 @@ class NodeManager:
                 "available": self.available.to_dict(),
                 "num_workers": len(self.workers),
                 "num_pending_leases": len(self.pending),
+                "num_args_prefetched": self.num_args_prefetched,
             }
 
     def _kill_worker_for_memory(self) -> bool:

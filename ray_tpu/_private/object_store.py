@@ -75,6 +75,9 @@ class StoreServer:
         self.num_restored = 0
         self._objects: Dict[str, _Entry] = {}
         self._quarantine: List[Tuple[float, int]] = []  # (freed_at, offset)
+        # in-flight pull dedup: oid -> Event set when the transfer ends
+        # (N concurrent pulls of one object must stream it ONCE)
+        self._pulls_in_flight: Dict[str, threading.Event] = {}
         self._lock = threading.Lock()
         self._sealed_cv = threading.Condition(self._lock)
         self._pool = rpc_lib.ClientPool(timeout=60)
@@ -398,15 +401,33 @@ class StoreServer:
              size: int) -> Tuple:
         """Pull an object from a peer store into this one (chunked).
         reference parity: pull_manager.h / push_manager.h chunk streaming."""
-        with self._lock:
-            e = self._objects.get(object_id)
-            if e is not None and e.sealed:
-                if e.spilled:
-                    # a complete local copy exists on disk: restore it
-                    # instead of refetching (the peer may have evicted)
-                    self._restore_locked(object_id)
-                    e = self._objects[object_id]
-                return self._descriptor(e)
+        while True:
+            with self._lock:
+                e = self._objects.get(object_id)
+                if e is not None and e.sealed:
+                    if e.spilled:
+                        # a complete local copy exists on disk: restore
+                        # it instead of refetching (the peer may have
+                        # evicted its copy)
+                        self._restore_locked(object_id)
+                        e = self._objects[object_id]
+                    return self._descriptor(e)
+                in_flight = self._pulls_in_flight.get(object_id)
+                if in_flight is None:
+                    self._pulls_in_flight[object_id] = threading.Event()
+                    break
+            # another thread is streaming this object: wait, then re-check
+            in_flight.wait(timeout=300)
+        try:
+            return self._pull_stream(object_id, from_store, size)
+        finally:
+            with self._lock:
+                ev = self._pulls_in_flight.pop(object_id, None)
+            if ev is not None:
+                ev.set()
+
+    def _pull_stream(self, object_id: str, from_store: Tuple[str, int],
+                     size: int) -> Tuple:
         expected = self.create(object_id, size, pin=False)
         client = self._pool.get(tuple(from_store))
         off = 0

@@ -22,6 +22,16 @@ from typing import Any, Callable, Dict, Optional, Tuple
 _LEN = struct.Struct(">Q")
 
 
+def find_free_port(host: str = "127.0.0.1") -> int:
+    """Bind-and-release a port (rendezvous endpoints: jax coordinator,
+    torch MASTER_PORT, learner gangs)."""
+    sock = socket.socket()
+    sock.bind((host, 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
 class RpcError(Exception):
     """Remote handler raised; carries the remote traceback string."""
 

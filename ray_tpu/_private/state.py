@@ -143,6 +143,11 @@ class TaskSpec:
     # already resident there (reference lease_policy.h:56 locality-aware
     # lease policy / scorer.h:25)
     locality_hints: Dict[str, float] = field(default_factory=dict)
+    # arg oid hex -> (store address, size): lets the dispatching node
+    # manager PREFETCH remote args into its local store while the lease
+    # is granted (reference raylet DependencyManager + PullManager pull
+    # task args to the node before dispatch)
+    arg_locations: Dict[str, Any] = field(default_factory=dict)
     # Tracing (reference util/tracing/tracing_helper.py: context rides
     # inside the task spec): all tasks of one logical request share a
     # trace id; parent_task_id links the causal chain.

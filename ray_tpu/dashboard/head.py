@@ -12,6 +12,7 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
     GET /api/objects  — state.list_objects() + store stats
     GET /api/jobs     — job table from the GCS KV
     GET /api/summary  — task-state counts
+    GET /metrics      — Prometheus exposition of this process's metrics
 """
 
 from __future__ import annotations
@@ -95,6 +96,16 @@ class DashboardHead:
                 parsed = urlparse(self.path)
                 route = parsed.path.rstrip("/") or "/"
                 try:
+                    if route == "/metrics":
+                        from ray_tpu.util.metrics import prometheus_text
+                        body = prometheus_text().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/plain; version=0.0.4")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     if route == "/":
                         body = _INDEX_HTML.encode()
                         self.send_response(200)

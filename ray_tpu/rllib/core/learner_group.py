@@ -84,12 +84,8 @@ class _MeshLearnerActor:
 
 
 def _free_port() -> int:
-    import socket
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from ray_tpu._private.rpc import find_free_port
+    return find_free_port()
 
 
 class LearnerGroup:

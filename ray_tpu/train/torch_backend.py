@@ -1,0 +1,85 @@
+"""Torch backend: gloo process-group bootstrap for TorchTrainer.
+
+reference parity: python/ray/train/torch/config.py:22,148-200 —
+_TorchBackend.on_start broadcasts rank-0's address and runs
+dist.init_process_group on every worker. On this framework the primary
+compute path is jax over ICI (JaxConfig); the torch backend exists for
+CPU/gloo workloads and API parity (§8.4 trainer inventory). NCCL is
+deliberately absent — no CUDA anywhere in the tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Type
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.jax_backend import _get_node_ip
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    timeout_s: int = 300
+
+    @property
+    def backend_cls(self) -> Type["Backend"]:
+        return _TorchBackend
+
+
+def _free_port() -> int:
+    from ray_tpu._private.rpc import find_free_port
+    return find_free_port()
+
+
+def _init_process_group(master_addr: str, master_port: int, backend: str,
+                        world_size: int, rank: int,
+                        timeout_s: int) -> None:
+    import datetime
+    import os
+
+    import torch.distributed as dist
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    dist.init_process_group(
+        backend=backend,
+        init_method=f"tcp://{master_addr}:{master_port}",
+        world_size=world_size, rank=rank,
+        timeout=datetime.timedelta(seconds=timeout_s))
+
+
+def _destroy_process_group() -> None:
+    import torch.distributed as dist
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: TorchConfig) -> None:
+        # world_size=1 still gets a process group so dist.* calls in the
+        # user loop work unchanged (reference _TorchBackend does too).
+        # rank 0's node hosts the rendezvous (reference
+        # torch/config.py:106-112 picks MASTER_ADDR from worker 0)
+        ip = worker_group.execute_single(0, _get_node_ip)
+        port = worker_group.execute_single(0, _free_port)
+        import ray_tpu
+        ray_tpu.get([
+            w.apply.remote(_init_process_group, ip, port,
+                           backend_config.backend, len(worker_group),
+                           rank, backend_config.timeout_s)
+            for rank, w in enumerate(worker_group.workers)
+        ], timeout=backend_config.timeout_s + 60)
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: TorchConfig) -> None:
+        import ray_tpu
+        try:
+            ray_tpu.get([w.apply.remote(_destroy_process_group)
+                         for w in worker_group.workers], timeout=60)
+        except Exception:  # noqa: BLE001 - workers may already be dead
+            pass

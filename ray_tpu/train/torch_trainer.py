@@ -1,0 +1,37 @@
+"""TorchTrainer: torch train loops over a gloo process group.
+
+reference parity: python/ray/train/torch/torch_trainer.py — a
+DataParallelTrainer whose backend wires torch.distributed instead of the
+jax coordinator (§8.4 trainer inventory row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.torch_backend import TorchConfig
+
+
+class TorchTrainer(DataParallelTrainer):
+    _backend_config_cls = TorchConfig
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=torch_config or TorchConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)

@@ -120,6 +120,58 @@ def collect() -> List[Dict]:
     return [m.snapshot() for m in metrics]
 
 
+def _esc_label(v: str) -> str:
+    """Prometheus exposition label escaping (\\ " and newline): one bad
+    label value would otherwise abort the entire scrape."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_tags(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_esc_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """This process's metrics in Prometheus exposition format (reference:
+    the per-node metrics agent exporting to Prometheus,
+    _private/metrics_agent.py + prometheus_exporter.py)."""
+    lines: List[str] = []
+    for snap in collect():
+        name = snap["name"]
+        if snap.get("description"):
+            desc = str(snap["description"]).replace("\n", " ")
+            lines.append(f"# HELP {name} {desc}")
+        if snap["kind"] == "histogram":
+            lines.append(f"# TYPE {name} histogram")
+            for key, buckets in snap["buckets"].items():
+                cum = 0
+                for bound, count in zip(snap["boundaries"], buckets):
+                    cum += count
+                    tags = dict(key)
+                    tags["le"] = str(bound)
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_tags(tuple(sorted(tags.items())))} {cum}")
+                cum += buckets[-1]
+                tags = dict(key)
+                tags["le"] = "+Inf"
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_fmt_tags(tuple(sorted(tags.items())))} {cum}")
+                lines.append(f"{name}_sum{_fmt_tags(key)} "
+                             f"{snap['sum'][key]}")
+                lines.append(f"{name}_count{_fmt_tags(key)} "
+                             f"{snap['count'][key]}")
+            continue
+        lines.append(f"# TYPE {name} {snap['kind']}")
+        for key, value in snap["values"].items():
+            lines.append(f"{name}{_fmt_tags(key)} {value}")
+    return "\n".join(lines) + "\n"
+
+
 def clear() -> None:
     with _REGISTRY_LOCK:
         _REGISTRY.clear()

@@ -245,3 +245,77 @@ print("REAP_OK")
                          cwd=repo)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "REAP_OK" in out.stdout
+
+
+def test_torch_trainer_gloo_allreduce(ray_start):
+    """TorchTrainer parity row (§8.4): gloo process group over the gang,
+    DDP-style gradient averaging on CPU torch."""
+    from ray_tpu.train import ScalingConfig, TorchTrainer, report
+
+    def loop():
+        import torch
+        import torch.distributed as dist
+        rank = dist.get_rank()
+        world = dist.get_world_size()
+        t = torch.ones(2) * (rank + 1)
+        dist.all_reduce(t)  # 1+2 = 3 per element
+        model = torch.nn.Linear(4, 1)
+        # identical init across ranks (broadcast rank 0's params)
+        for p in model.parameters():
+            dist.broadcast(p.data, src=0)
+        x = torch.randn(8, 4, generator=torch.Generator().manual_seed(rank))
+        loss = model(x).pow(2).mean()
+        loss.backward()
+        for p in model.parameters():  # DDP-style grad averaging
+            dist.all_reduce(p.grad)
+            p.grad /= world
+        g0 = float(next(model.parameters()).grad.abs().sum())
+        report({"allreduce0": float(t[0]), "world": world, "gsum": g0})
+
+    trainer = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 0.5}))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["world"] == 2
+    assert result.metrics["allreduce0"] == 3.0
+    assert result.metrics["gsum"] > 0.0
+
+
+def test_arg_prefetch_across_nodes():
+    """The dispatching node pulls a task's remote args into its local
+    store before execution (reference DependencyManager/PullManager)."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"resources": {"CPU": 2}})
+    try:
+        node2 = cluster.add_node(resources={"CPU": 2})
+        ray_tpu.init(cluster.address)
+
+        big = ray_tpu.put(np.arange(300_000, dtype=np.float64))
+
+        @ray_tpu.remote
+        def consume(x):
+            return float(np.asarray(x).sum())
+
+        strat = NodeAffinitySchedulingStrategy(node_id=node2.node_id_hex)
+        out = ray_tpu.get(
+            consume.options(scheduling_strategy=strat).remote(big),
+            timeout=120)
+        assert out == float(np.arange(300_000).sum())
+
+        from ray_tpu._private import rpc as rpc_lib
+        host, port = node2.node_manager_address.rsplit(":", 1)
+        info = rpc_lib.RpcClient((host, int(port)), timeout=30).call(
+            "nm_get_info")
+        assert info["num_args_prefetched"] >= 1, info
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
