@@ -446,8 +446,16 @@ class CoreWorker:
             if not entry.done:
                 return True  # original execution still in flight
             entry.done = False
-            for rid in entry.return_ids:
-                rh = rid.hex()
+            # reset every object this task produced — declared returns AND
+            # dynamic-return children (any oid embedding this task id) —
+            # so getters wait for the recomputation instead of re-failing
+            # on the stale location
+            task_prefix = oid.task_id().hex()
+            produced = [rid.hex() for rid in entry.return_ids]
+            produced += [h2 for h2 in self.objects
+                         if h2.startswith(task_prefix)
+                         and h2 not in produced]
+            for rh in produced:
                 if self.objects.get(rh, (PENDING,))[0] not in (FREED, INLINE,
                                                               ERROR):
                     self.objects[rh] = (PENDING,)
@@ -792,13 +800,23 @@ class CoreWorker:
             pass
 
     def _on_task_done(self, task_id: TaskID, results: List[Tuple],
-                      lease_id: Optional[str] = None) -> None:
+                      lease_id: Optional[str] = None,
+                      dynamic_children: Optional[List[Tuple]] = None
+                      ) -> None:
         h = task_id.hex()
         with self._lock:
             entry = self.tasks.get(h)
             duplicate = entry is None or entry.done
             if not duplicate:
                 entry.done = True
+                # dynamic-return children become owned objects of ours,
+                # registered before the generator handle resolves so a
+                # get() of a child ref never races its registration
+                for oid, loc in (dynamic_children or []):
+                    self.objects[oid.hex()] = tuple(loc)
+                    ev = self.object_events.get(oid.hex())
+                    if ev is not None:  # recovery getters waiting
+                        ev.set()
         if duplicate:
             # Late/duplicate completion (e.g. after cancel or retry): the
             # first writer won; just hand back any lease that rode in.
@@ -1360,6 +1378,26 @@ class _Executor:
                     args, kwargs = self._resolve_args(spec)
                     out = method(*args, **kwargs)
                     values = self._split_returns(out, spec.num_returns)
+                elif spec.dynamic_returns:
+                    # generator task (reference dynamic returns): store
+                    # each yielded value as its own object; the declared
+                    # return resolves to the list of child refs.
+                    fn = cw.import_function(spec.function_key)
+                    args, kwargs = self._resolve_args(spec)
+                    children = []
+                    for i, item in enumerate(fn(*args, **kwargs)):
+                        child = ObjectID.for_task_return(spec.task_id,
+                                                         i + 2)
+                        loc = cw.store_blob(child.hex(), ser.pack(item))
+                        children.append((child, loc))
+                    self._report_done(
+                        spec,
+                        [(INLINE,
+                          ser.pack([ObjectRef(oid, spec.owner_address,
+                                              _register=False)
+                                    for oid, _ in children]))],
+                        dynamic_children=children)
+                    return
                 else:
                     fn = cw.import_function(spec.function_key)
                     args, kwargs = self._resolve_args(spec)
@@ -1401,12 +1439,14 @@ class _Executor:
                 f"{len(out_list)} values")
         return out_list
 
-    def _report_done(self, spec: TaskSpec, results: List[Tuple]) -> None:
+    def _report_done(self, spec: TaskSpec, results: List[Tuple],
+                     dynamic_children: Optional[List[Tuple]] = None
+                     ) -> None:
         lease_id = getattr(spec, "_lease_id", None)
         try:
             self.cw._pool.get(spec.owner_address).call(
                 "cw_task_done", task_id=spec.task_id, results=results,
-                lease_id=lease_id)
+                lease_id=lease_id, dynamic_children=dynamic_children)
         except Exception:  # noqa: BLE001
             logger.warning("owner %s unreachable for task result",
                            spec.owner_address)

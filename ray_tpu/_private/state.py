@@ -132,6 +132,11 @@ class TaskSpec:
     # Normal-task fields
     max_retries: int = 0
     retry_exceptions: bool = False
+    # num_returns="dynamic" (reference _raylet.pyx:269
+    # StreamingObjectRefGenerator): the task yields a variable number of
+    # values; each becomes its own object, and the single declared
+    # return resolves to the list of their refs.
+    dynamic_returns: bool = False
     # Scheduling
     scheduling_strategy: SchedulingStrategy = field(
         default_factory=DefaultSchedulingStrategy)

@@ -36,6 +36,18 @@ class Worker:
 
 
 _global_worker: Optional[Worker] = None
+# Thin-client session when connected via ray_tpu.init("ray://host:port")
+# (reference util/client worker.py global client context).
+_client_context = None
+
+
+def client_context():
+    return _client_context
+
+
+def set_client_context(ctx) -> None:
+    global _client_context
+    _client_context = ctx
 
 
 def global_worker() -> Worker:
@@ -98,6 +110,17 @@ def init(address: Optional[str] = None, *,
          _session_root: Optional[str] = None) -> Worker:
     """Connect this process as a driver; bootstrap a head if no address."""
     global _global_worker
+    if address is not None and address.startswith("ray://"):
+        # client mode (reference ray.init("ray://...")): no local core
+        # worker; everything proxies through the cluster-side server
+        from ray_tpu.client.worker import connect
+        if _client_context is not None:
+            if ignore_reinit_error:
+                return _client_context
+            raise RuntimeError("already connected in client mode")
+        ctx = connect(address[len("ray://"):])
+        set_client_context(ctx)
+        return ctx
     if _global_worker is not None:
         if ignore_reinit_error:
             return _global_worker
@@ -147,6 +170,9 @@ def init(address: Optional[str] = None, *,
 
 def shutdown() -> None:
     global _global_worker
+    if _client_context is not None:
+        _client_context.disconnect()
+        set_client_context(None)
     w = _global_worker
     if w is None:
         return
@@ -165,4 +191,4 @@ def shutdown() -> None:
 
 
 def is_initialized() -> bool:
-    return _global_worker is not None
+    return _global_worker is not None or _client_context is not None

@@ -100,6 +100,7 @@ class ActorClass:
         if bad:
             raise ValueError(f"invalid actor options: {sorted(bad)}")
         self._fn_key: Optional[str] = None
+        self._client_ac = None  # cached thin-client wrapper (ray:// mode)
 
     def options(self, **kwargs: Any) -> "ActorClass":
         ac = ActorClass(self._cls, {**self._options, **kwargs})
@@ -121,6 +122,14 @@ class ActorClass:
         return ClassNode(self, args, kwargs)
 
     def remote(self, *args: Any, **kwargs: Any) -> ActorHandle:
+        ctx = worker_mod.client_context()
+        if ctx is not None:
+            # thin-client session: proxy actor creation (call-time mode
+            # resolution; see RemoteFunction.remote); cached so the class
+            # ships once
+            if self._client_ac is None or self._client_ac._ctx is not ctx:
+                self._client_ac = ctx.remote(self._cls, **self._options)
+            return self._client_ac.remote(*args, **kwargs)
         w = worker_mod.global_worker()
         cw = w.core_worker
         opts = self._options

@@ -32,6 +32,9 @@ def is_initialized() -> bool:
 def remote(*args: Any, **options: Any):
     """@remote decorator for functions and classes (reference worker.py:3157)."""
     def make(target: Any):
+        # Always build the local wrappers: they defer client-vs-direct
+        # routing to CALL time, so modules may decorate at import before
+        # init("ray://...") connects.
         if inspect.isclass(target):
             return ActorClass(target, options)
         return RemoteFunction(target, options)
@@ -45,11 +48,17 @@ def remote(*args: Any, **options: Any):
 
 
 def put(value: Any) -> ObjectRef:
+    ctx = worker_mod.client_context()
+    if ctx is not None:
+        return ctx.put(value)
     return worker_mod.global_worker().core_worker.put(value)
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None) -> Any:
+    ctx = worker_mod.client_context()
+    if ctx is not None:
+        return ctx.get(refs, timeout=timeout)
     cw = worker_mod.global_worker().core_worker
     if isinstance(refs, ObjectRef):
         return cw.get([refs], timeout=timeout)[0]
@@ -63,11 +72,19 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None):
     if isinstance(refs, ObjectRef):
         raise TypeError("ray_tpu.wait takes a list of ObjectRefs")
+    ctx = worker_mod.client_context()
+    if ctx is not None:
+        return ctx.wait(list(refs), num_returns=num_returns,
+                        timeout=timeout)
     cw = worker_mod.global_worker().core_worker
     return cw.wait(list(refs), num_returns=num_returns, timeout=timeout)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    ctx = worker_mod.client_context()
+    if ctx is not None:
+        ctx.kill(actor, no_restart=no_restart)
+        return
     cw = worker_mod.global_worker().core_worker
     cw.kill_actor(actor._actor_id, no_restart=no_restart)
 

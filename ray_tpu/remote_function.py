@@ -67,6 +67,7 @@ class RemoteFunction:
         if bad:
             raise ValueError(f"invalid task options: {sorted(bad)}")
         self._fn_key: Optional[str] = None
+        self._client_rf = None  # cached thin-client wrapper (ray:// mode)
         functools.update_wrapper(self, fn)
 
     def options(self, **kwargs: Any) -> "RemoteFunction":
@@ -87,6 +88,15 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def remote(self, *args: Any, **kwargs: Any) -> Any:
+        ctx = worker_mod.client_context()
+        if ctx is not None:
+            # thin-client session: proxy the call (mode resolved at call
+            # time so decoration may precede init("ray://...")); cache
+            # the wrapper so the function ships/registers once, not per
+            # submission
+            if self._client_rf is None or self._client_rf._ctx is not ctx:
+                self._client_rf = ctx.remote(self._fn, **self._options)
+            return self._client_rf.remote(*args, **kwargs)
         w = worker_mod.global_worker()
         cw = w.core_worker
         if self._fn_key is None:
@@ -97,6 +107,9 @@ class RemoteFunction:
             DefaultSchedulingStrategy()
         pg_id, bundle_idx = _extract_pg(opts, strategy)
         num_returns = opts.get("num_returns", 1)
+        dynamic = num_returns in ("dynamic", "streaming")
+        if dynamic:
+            num_returns = 1  # the generator handle itself
         spec = TaskSpec(
             task_id=TaskID.of(cw.job_id), job_id=cw.job_id,
             task_type=TaskType.NORMAL_TASK, function_key=self._fn_key,
@@ -112,6 +125,7 @@ class RemoteFunction:
             placement_group_bundle_index=bundle_idx,
             runtime_env=opts.get("runtime_env"),
             name=opts.get("name") or self._fn.__name__)
+        spec.dynamic_returns = dynamic
         refs = cw.submit_task(spec)
         if num_returns == 1:
             return refs[0]

@@ -225,6 +225,12 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def cmd_client_server(args) -> int:
+    from ray_tpu.client import serve_forever
+    serve_forever(_address(args), host=args.host, port=args.port)
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu.job import JobSubmissionClient
     client = JobSubmissionClient(_address(args))
@@ -291,6 +297,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("microbenchmark")
     p.add_argument("--num-ops", type=int, default=200)
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("client-server",
+                       help="serve thin clients (ray:// mode)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--port", type=int, default=10001)
+    p.add_argument("--host", default="0.0.0.0",
+                   help="bind host (remote thin clients need non-loopback)")
+    p.set_defaults(fn=cmd_client_server)
 
     p = sub.add_parser("job", help="job submission")
     p.add_argument("job_cmd", choices=["submit", "status", "logs", "list"])

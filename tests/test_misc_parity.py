@@ -313,9 +313,59 @@ def test_arg_prefetch_across_nodes():
 
         from ray_tpu._private import rpc as rpc_lib
         host, port = node2.node_manager_address.rsplit(":", 1)
-        info = rpc_lib.RpcClient((host, int(port)), timeout=30).call(
-            "nm_get_info")
-        assert info["num_args_prefetched"] >= 1, info
+        nm = rpc_lib.RpcClient((host, int(port)), timeout=30)
+        # the prefetch daemon increments after its pull returns — the
+        # worker's dedup'd pull may deliver the result first, so poll
+        import time as _t
+        deadline = _t.time() + 20
+        info = {}
+        while _t.time() < deadline:
+            info = nm.call("nm_get_info")
+            if info.get("num_args_prefetched", 0) >= 1:
+                break
+            _t.sleep(0.2)
+        assert info.get("num_args_prefetched", 0) >= 1, info
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_dynamic_generator_returns(ray_start):
+    """num_returns="dynamic" (reference ObjectRefGenerator): a generator
+    task stores each yielded value as its own object; the handle
+    resolves to the list of refs."""
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield np.full(4, i)
+
+    handle = gen.remote(5)
+    refs = ray_tpu.get(handle)
+    assert len(refs) == 5
+    for i, r in enumerate(refs):
+        np.testing.assert_array_equal(ray_tpu.get(r), np.full(4, i))
+    # children are first-class objects: usable as args to other tasks
+    @ray_tpu.remote
+    def total(x):
+        return float(np.asarray(x).sum())
+    assert ray_tpu.get(total.remote(refs[3])) == 12.0
+
+
+def test_dynamic_child_recovers_via_lineage(ray_start):
+    """A lost dynamic-return child reconstructs by re-executing the
+    generator task (lineage covers dynamic children too)."""
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen():
+        for i in range(3):
+            yield np.full(64 * 1024, i, dtype=np.float64)  # STORE-sized
+
+    refs = ray_tpu.get(gen.remote())
+    first = np.asarray(ray_tpu.get(refs[1])).copy()
+    w = ray_tpu._private.worker.global_worker()
+    w.core_worker.store.delete([refs[1].id.hex()])
+    again = ray_tpu.get(refs[1], timeout=60)
+    np.testing.assert_array_equal(first, np.asarray(again))
