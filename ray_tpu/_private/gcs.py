@@ -161,6 +161,10 @@ class GcsServer:
         # merged task records keyed by task id, FIFO-capped.
         self.task_events: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self.task_events_dropped = 0
+        # Structured cluster events (reference util/event.h → the
+        # dashboard event module): bounded ring of lifecycle records.
+        self.cluster_events: List[Dict[str, Any]] = []
+        self.CLUSTER_EVENTS_MAX = 4096
         self._dead = False
 
         self.server = rpc_lib.RpcServer({
@@ -195,6 +199,9 @@ class GcsServer:
             # task events (reference TaskInfoGcsService / GcsTaskManager)
             "add_task_events": self.add_task_events,
             "list_tasks": self.list_tasks,
+            # structured events (reference ReportEventService)
+            "add_events": self.add_events,
+            "list_events": self.list_events,
             # pubsub (reference InternalPubSubGcsService)
             "subscribe": self.subscribe,
             "ping": lambda: "pong",
@@ -247,6 +254,9 @@ class GcsServer:
                            and a.state in ("ALIVE", "PENDING", "RESTARTING")]
         log = logger.info if reason == "unregistered" else logger.warning
         log("GCS: node %s dead (%s)", node_id_hex[:12], reason)
+        self._emit("NODE_DEAD", reason,
+                   severity="INFO" if reason == "unregistered"
+                   else "WARNING", node_id=node_id_hex)
         self.publish("node", ("DEAD", info))
         for a in dead_actors:
             self.report_actor_death(a.actor_id.hex(),
@@ -405,10 +415,15 @@ class GcsServer:
             logger.warning("GCS: restarting actor %s (%d/%s): %s",
                            actor_id_hex[:12], info.num_restarts,
                            info.max_restarts, reason)
+            self._emit("ACTOR_RESTARTING", reason, severity="WARNING",
+                       actor_id=actor_id_hex,
+                       restart=info.num_restarts)
             self.publish("actor", ("RESTARTING", info))
             threading.Thread(target=self._schedule_actor,
                              args=(actor_id_hex,), daemon=True).start()
         else:
+            self._emit("ACTOR_DEAD", info.death_cause, severity="INFO",
+                       actor_id=actor_id_hex)
             self.publish("actor", ("DEAD", info))
 
     def get_actor_info(self, actor_id_hex: str) -> Optional[ActorInfo]:
@@ -474,6 +489,32 @@ class GcsServer:
             records = [r for r in records
                        if all(r.get(k) == v for k, v in filters.items())]
         return records[-limit:]
+
+    # ---- structured events (reference util/event.h sink) ----------------
+
+    def add_events(self, events: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            self.cluster_events.extend(events)
+            overflow = len(self.cluster_events) - self.CLUSTER_EVENTS_MAX
+            if overflow > 0:
+                del self.cluster_events[:overflow]
+
+    def list_events(self, event_type: Optional[str] = None,
+                    severity: Optional[str] = None,
+                    limit: int = 1000) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self.cluster_events)
+        if event_type:
+            out = [e for e in out if e.get("event_type") == event_type]
+        if severity:
+            out = [e for e in out if e.get("severity") == severity]
+        return out[-limit:]
+
+    def _emit(self, event_type: str, message: str,
+              severity: str = "INFO", **fields: Any) -> None:
+        from ray_tpu._private.events import build_event
+        self.add_events([build_event("gcs", event_type, message,
+                                     severity, **fields)])
 
     # ---- pubsub ----------------------------------------------------------
 

@@ -763,15 +763,26 @@ class NodeManager:
             if not pool:
                 return False
             victim = max(pool, key=lambda h: h.task_started_at)
+        fn = victim.current_task.function_name \
+            if victim.current_task else "?"
         logger.warning(
             "memory pressure: killing worker %s running %s",
-            victim.worker_id.hex()[:12],
-            victim.current_task.function_name
-            if victim.current_task else "?")
+            victim.worker_id.hex()[:12], fn)
         try:
             victim.proc.kill()
         except OSError:
             return False
+        # record AFTER the successful kill, off-thread: a blocking GCS
+        # RPC here would delay memory relief exactly when the node is
+        # under pressure
+        from ray_tpu._private.events import emit_via
+        threading.Thread(
+            target=emit_via,
+            args=(self._gcs.call, "node_manager", "OOM_KILL",
+                  f"killed worker running {fn} under memory pressure"),
+            kwargs={"severity": "WARNING", "node_id": self.node_id.hex(),
+                    "worker_id": victim.worker_id.hex()},
+            daemon=True, name="oom-event").start()
         return True
 
     def list_workers(self) -> List[Dict[str, Any]]:

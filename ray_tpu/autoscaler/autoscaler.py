@@ -145,6 +145,9 @@ class StandardAutoscaler:
             logger.info("autoscaler: %d queued leases, launching node "
                         "(%d -> %d)", load["pending"], len(nodes),
                         len(nodes) + 1)
+            self._emit("AUTOSCALER_SCALE_UP",
+                       f"{load['pending']} queued leases",
+                       nodes_before=len(nodes))
             self.provider.create_node(self.resources_per_node)
             self.num_scale_ups += 1
             return
@@ -161,11 +164,19 @@ class StandardAutoscaler:
                 if now - first_idle >= self.idle_timeout_s:
                     logger.info("autoscaler: terminating idle node %s",
                                 node.provider_id)
+                    self._emit("AUTOSCALER_SCALE_DOWN",
+                               f"node {node.provider_id} idle "
+                               f"{self.idle_timeout_s:.0f}s")
                     self.provider.terminate_node(node)
                     self._idle_since.pop(node.provider_id, None)
                     self.num_scale_downs += 1
             else:
                 self._idle_since.pop(node.provider_id, None)
+
+    def _emit(self, event_type: str, message: str, **fields) -> None:
+        from ray_tpu._private.events import emit_via
+        emit_via(self._gcs.call, "autoscaler", event_type, message,
+                 **fields)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_period_s):

@@ -151,6 +151,10 @@ class DashboardHead:
                     "store_stats": s.object_store_stats()}
         if route == "/api/summary":
             return s.summarize_tasks()
+        if route == "/api/events":
+            return s.list_cluster_events(
+                event_type=params.get("type"),
+                severity=params.get("severity"))
         if route == "/api/jobs":
             if self._job_client is None:
                 from ray_tpu.job import JobSubmissionClient

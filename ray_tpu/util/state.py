@@ -106,6 +106,24 @@ def summarize_tasks() -> Dict[str, int]:
     return counts
 
 
+def emit_event(event_type: str, message: str = "",
+               severity: str = "INFO", **fields: Any) -> None:
+    """Application-level structured event into the cluster event table
+    (reference util/event.h RayEvent / python event_logger)."""
+    from ray_tpu._private.events import build_event
+    _gcs().call("add_events", events=[build_event(
+        "app", event_type, message, severity, **fields)])
+
+
+def list_cluster_events(event_type: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        limit: int = 1000) -> List[Dict[str, Any]]:
+    """Structured lifecycle events (reference dashboard event module):
+    node deaths, actor restarts, OOM kills, autoscaling actions."""
+    return _gcs().call("list_events", event_type=event_type,
+                       severity=severity, limit=limit)
+
+
 def object_store_stats() -> List[Dict[str, Any]]:
     """Per-node store stats incl. spill/restore counters (`ray memory`)."""
     out = []

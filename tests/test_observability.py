@@ -124,3 +124,40 @@ def test_metrics_counter_gauge_histogram():
     with pytest.raises(ValueError):
         c.inc(tags={"bad_key": "x"})
     metrics_mod.clear()
+
+
+def test_cluster_events_lifecycle(ray_start):
+    """Structured events (reference util/event.h → dashboard events):
+    actor deaths and restarts land in the GCS event table."""
+    @ray_tpu.remote(max_restarts=1)
+    class Flappy:
+        def pid(self):
+            import os
+            return os.getpid()
+
+    a = Flappy.options(num_cpus=0.1).remote()
+    pid = ray_tpu.get(a.pid.remote())
+    import os
+    import signal
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.time() + 30
+    restarts = []
+    while time.time() < deadline and not restarts:
+        # filter by actor id: the shared session cluster accumulates
+        # restart events from earlier chaos tests
+        restarts = [e for e in state_api.list_cluster_events(
+                        event_type="ACTOR_RESTARTING")
+                    if e.get("actor_id") == a._actor_id.hex()]
+        time.sleep(0.3)
+    assert restarts, "no ACTOR_RESTARTING event recorded"
+    assert restarts[-1]["severity"] == "WARNING"
+    assert "exited" in restarts[-1]["message"]
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    dead = []
+    while time.time() < deadline and not dead:
+        dead = [e for e in state_api.list_cluster_events(
+                    event_type="ACTOR_DEAD")
+                if e.get("actor_id") == a._actor_id.hex()]
+        time.sleep(0.3)
+    assert dead, "no ACTOR_DEAD event recorded"
