@@ -502,6 +502,8 @@ class GcsServer:
     def list_events(self, event_type: Optional[str] = None,
                     severity: Optional[str] = None,
                     limit: int = 1000) -> List[Dict[str, Any]]:
+        if limit <= 0:  # out[-0:] would mean "everything"
+            return []
         with self._lock:
             out = list(self.cluster_events)
         if event_type:

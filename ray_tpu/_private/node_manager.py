@@ -772,17 +772,25 @@ class NodeManager:
             victim.proc.kill()
         except OSError:
             return False
-        # record AFTER the successful kill, off-thread: a blocking GCS
-        # RPC here would delay memory relief exactly when the node is
-        # under pressure
-        from ray_tpu._private.events import emit_via
-        threading.Thread(
-            target=emit_via,
-            args=(self._gcs.call, "node_manager", "OOM_KILL",
-                  f"killed worker running {fn} under memory pressure"),
-            kwargs={"severity": "WARNING", "node_id": self.node_id.hex(),
-                    "worker_id": victim.worker_id.hex()},
-            daemon=True, name="oom-event").start()
+        # record AFTER the successful kill, off-thread, on a DEDICATED
+        # short-timeout connection: the shared GCS client serializes
+        # calls, so a slow control plane here would otherwise stall the
+        # resource-report heartbeat and get the node marked dead
+        def _oom_event() -> None:
+            from ray_tpu._private import rpc as rpc_lib
+            from ray_tpu._private.events import emit_via
+            client = rpc_lib.RpcClient(self.gcs_address, timeout=5)
+            try:
+                emit_via(client.call, "node_manager", "OOM_KILL",
+                         f"killed worker running {fn} under memory "
+                         "pressure", severity="WARNING",
+                         node_id=self.node_id.hex(),
+                         worker_id=victim.worker_id.hex())
+            finally:
+                client.close()
+
+        threading.Thread(target=_oom_event, daemon=True,
+                         name="oom-event").start()
         return True
 
     def list_workers(self) -> List[Dict[str, Any]]:

@@ -113,8 +113,11 @@ class DeploymentHandle:
         # whenever the controller replaces a dead replica)
         self._in_flight: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # Lazy first refresh (on first .remote()): an eager call home
+        # would deadlock when a handle is reconstructed INSIDE the
+        # controller's own handler thread (deployment composition passes
+        # handles through deploy()'s init args).
         self._last_refresh = 0.0
-        self._refresh(force=True)
 
     def _refresh(self, force: bool = False) -> None:
         now = time.time()
@@ -129,6 +132,12 @@ class DeploymentHandle:
             live = {r._actor_id.hex() for r in replicas}
             self._in_flight = {k: v for k, v in self._in_flight.items()
                                if k in live}
+
+    def __reduce__(self):
+        # picklable so deployments can compose: a replica holding a
+        # handle to a downstream deployment (reference serve app graphs)
+        # reconstructs it against its own controller connection
+        return (DeploymentHandle, (self.deployment_name,))
 
     def _pick(self):
         with self._lock:

@@ -109,10 +109,10 @@ def summarize_tasks() -> Dict[str, int]:
 def emit_event(event_type: str, message: str = "",
                severity: str = "INFO", **fields: Any) -> None:
     """Application-level structured event into the cluster event table
-    (reference util/event.h RayEvent / python event_logger)."""
-    from ray_tpu._private.events import build_event
-    _gcs().call("add_events", events=[build_event(
-        "app", event_type, message, severity, **fields)])
+    (reference util/event.h RayEvent / python event_logger). Best
+    effort — telemetry must never break the caller."""
+    from ray_tpu._private.events import emit_via
+    emit_via(_gcs().call, "app", event_type, message, severity, **fields)
 
 
 def list_cluster_events(event_type: Optional[str] = None,

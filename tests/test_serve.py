@@ -141,3 +141,26 @@ def test_autoscaling_scales_up_under_load(serve_session):
         scaled = info["slow"]["target_replicas"] > 1
     assert scaled, "autoscaler never scaled up under sustained load"
     ray_tpu.get(refs, timeout=120)
+
+
+def test_deployment_composition(serve_session):
+    """Deployments calling deployments through handles (reference serve
+    app graphs): handles pickle into replicas and reconnect there."""
+
+    @serve.deployment(name="embedder")
+    def embedder(text):
+        return len(text)
+
+    @serve.deployment(name="ranker")
+    class Ranker:
+        def __init__(self, downstream):
+            self.downstream = downstream  # DeploymentHandle
+
+        def __call__(self, texts):
+            refs = [self.downstream.remote(t) for t in texts]
+            return sorted(ray_tpu.get(refs), reverse=True)
+
+    emb_handle = serve.run(embedder)
+    ranker_handle = serve.run(Ranker.bind(emb_handle))
+    out = ray_tpu.get(ranker_handle.remote(["aa", "bbbb", "c"]))
+    assert out == [4, 2, 1]
