@@ -49,6 +49,12 @@ class _TaskEntry:
     lease_node: Optional[Tuple[str, int]] = None
     node_id_hex: Optional[str] = None  # node the lease was granted on
     done: bool = False
+    # streaming generator returns: children reported incrementally,
+    # KEYED by return index (reference StreamingObjectRefGenerator,
+    # _raylet.pyx:269) — index keying makes retries/recovery re-reports
+    # idempotent instead of appending duplicates
+    dynamic_arrived: Dict[int, ObjectID] = field(default_factory=dict)
+    dynamic_event: threading.Event = field(default_factory=threading.Event)
 
 
 @dataclass
@@ -138,6 +144,7 @@ class CoreWorker:
             "cw_lease_respill": self._on_lease_respill,
             "cw_task_done": self._on_task_done,
             "cw_task_failed": self._on_task_failed,
+            "cw_dynamic_child": self._on_dynamic_child,
             "cw_get_object": self._on_get_object,
             "cw_wait_object": self._on_wait_object,
             "cw_recover_object": self._on_recover_object,
@@ -833,9 +840,27 @@ class CoreWorker:
                     ev.set()
         self._unpin_args(entry.spec.arg_object_refs)
         self.task_events.record(h, state="FINISHED", ts_finished=_ev_now())
+        entry.dynamic_event.set()  # wake streaming iterators: task over
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
         if lease_id is not None:
             self._return_lease(lease_id, entry)
+
+    def _on_dynamic_child(self, task_id: TaskID, child: ObjectID,
+                          loc: Tuple) -> None:
+        """Streaming generator child: register the object the moment the
+        executor stores it so iterators see it before the task ends."""
+        with self._lock:
+            entry = self.tasks.get(task_id.hex())
+            if entry is None:
+                return
+            if self.objects.get(child.hex(), (PENDING,))[0] != FREED:
+                self.objects[child.hex()] = tuple(loc)
+            entry.dynamic_arrived[child.return_index()] = child
+            entry.dynamic_event.set()
+            ev = self.object_events.get(child.hex())
+        if ev is not None:
+            ev.set()
+        self._fire_done_callbacks([child.hex()])
 
     def _on_task_failed(self, task_id: TaskID, error_type: str,
                         message: str) -> None:
@@ -876,6 +901,7 @@ class CoreWorker:
         self.task_events.record(task_hex, state="FAILED",
                                 ts_finished=_ev_now(),
                                 error=f"{error_type}: {message}"[:500])
+        entry.dynamic_event.set()
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
 
     # ------------------------------------------------------------------
@@ -1381,15 +1407,43 @@ class _Executor:
                 elif spec.dynamic_returns:
                     # generator task (reference dynamic returns): store
                     # each yielded value as its own object; the declared
-                    # return resolves to the list of child refs.
+                    # return resolves to the list of child refs. Each
+                    # child is ALSO reported as it lands so streaming
+                    # consumers iterate before the task finishes.
                     fn = cw.import_function(spec.function_key)
                     args, kwargs = self._resolve_args(spec)
+                    # incremental reports go through a background drainer
+                    # so a slow owner never blocks the producing
+                    # generator; the task-end batch is the safety net
+                    report_q: "queue.Queue" = queue.Queue()
+
+                    def _report_children() -> None:
+                        owner = cw._pool.get(spec.owner_address)
+                        while True:
+                            item = report_q.get()
+                            if item is None:
+                                return
+                            child, loc = item
+                            try:
+                                owner.call("cw_dynamic_child",
+                                           task_id=spec.task_id,
+                                           child=child, loc=loc)
+                            except Exception:  # noqa: BLE001
+                                return  # batch report covers the rest
+
+                    reporter = threading.Thread(
+                        target=_report_children, daemon=True,
+                        name="dynamic-child-report")
+                    reporter.start()
                     children = []
                     for i, item in enumerate(fn(*args, **kwargs)):
                         child = ObjectID.for_task_return(spec.task_id,
                                                          i + 2)
                         loc = cw.store_blob(child.hex(), ser.pack(item))
                         children.append((child, loc))
+                        report_q.put((child, loc))
+                    report_q.put(None)
+                    reporter.join(timeout=30)
                     self._report_done(
                         spec,
                         [(INLINE,

@@ -111,3 +111,55 @@ class ObjectRef:
 def _deserialize_ref(object_id: ObjectID,
                      owner_address: Optional[Tuple[str, int]]) -> ObjectRef:
     return ObjectRef(object_id, owner_address)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming generator task's child refs, yielding
+    each as it is produced (reference StreamingObjectRefGenerator,
+    _raylet.pyx:269). Iterable only in the owner process (the one that
+    submitted the task); the handle ref still resolves to the full list
+    for batch consumers."""
+
+    def __init__(self, handle_ref: ObjectRef):
+        self._handle = handle_ref
+        self._task_hex = handle_ref.task_id().hex()
+        self._i = 0
+
+    @property
+    def handle(self) -> ObjectRef:
+        return self._handle
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from ray_tpu._private import worker as worker_mod
+        cw = worker_mod.global_worker().core_worker
+        entry = cw.tasks.get(self._task_hex)
+        if entry is None:
+            raise RuntimeError(
+                "ObjectRefGenerator can only iterate in the process that "
+                "submitted the task")
+        # children are keyed by return index (2-based: index 1 is the
+        # handle); iterate strictly in index order so a dropped or
+        # re-ordered incremental report can't skip/duplicate a child
+        want = self._i + 2
+        while True:
+            with cw._lock:
+                child = entry.dynamic_arrived.get(want)
+                if child is not None:
+                    self._i += 1
+                    return ObjectRef(child, cw.address)
+                if entry.done:
+                    break
+                entry.dynamic_event.clear()
+            entry.dynamic_event.wait(timeout=1.0)
+        # task over: surface any error via the handle, else serve any
+        # child whose incremental report was lost from the final batch
+        # (position i in the list IS index i+2 by construction)
+        remaining = cw.get([self._handle], timeout=60)[0]
+        if self._i < len(remaining):
+            ref = remaining[self._i]
+            self._i += 1
+            return ref
+        raise StopIteration

@@ -127,6 +127,12 @@ class RemoteFunction:
             name=opts.get("name") or self._fn.__name__)
         spec.dynamic_returns = dynamic
         refs = cw.submit_task(spec)
+        if dynamic and opts.get("num_returns") == "streaming":
+            # iterate children as the task yields them (reference
+            # StreamingObjectRefGenerator); "dynamic" keeps the batch
+            # list-of-refs handle semantics
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(refs[0])
         if num_returns == 1:
             return refs[0]
         return refs

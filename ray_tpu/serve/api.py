@@ -113,6 +113,7 @@ class DeploymentHandle:
         # whenever the controller replaces a dead replica)
         self._in_flight: Dict[str, int] = {}
         self._lock = threading.Lock()
+        self._refresh_lock = threading.Lock()
         # Lazy first refresh (on first .remote()): an eager call home
         # would deadlock when a handle is reconstructed INSIDE the
         # controller's own handler thread (deployment composition passes
@@ -121,17 +122,25 @@ class DeploymentHandle:
 
     def _refresh(self, force: bool = False) -> None:
         now = time.time()
-        if not force and now - self._last_refresh < self.REFRESH_PERIOD_S:
+        # the freshness short-circuit only applies once we HAVE replicas:
+        # a concurrent first caller must block for the in-flight fetch
+        # rather than race ahead into an empty replica list
+        if not force and self._replicas and \
+                now - self._last_refresh < self.REFRESH_PERIOD_S:
             return
-        self._last_refresh = now
-        replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self.deployment_name),
-            timeout=30)
-        with self._lock:
-            self._replicas = replicas
-            live = {r._actor_id.hex() for r in replicas}
-            self._in_flight = {k: v for k, v in self._in_flight.items()
-                               if k in live}
+        with self._refresh_lock:
+            if self._replicas and \
+                    time.time() - self._last_refresh < self.REFRESH_PERIOD_S:
+                return  # another thread refreshed while we waited
+            replicas = ray_tpu.get(
+                self._controller.get_replicas.remote(self.deployment_name),
+                timeout=30)
+            with self._lock:
+                self._replicas = replicas
+                live = {r._actor_id.hex() for r in replicas}
+                self._in_flight = {k: v for k, v in self._in_flight.items()
+                                   if k in live}
+            self._last_refresh = time.time()
 
     def __reduce__(self):
         # picklable so deployments can compose: a replica holding a

@@ -369,3 +369,25 @@ def test_dynamic_child_recovers_via_lineage(ray_start):
     w.core_worker.store.delete([refs[1].id.hex()])
     again = ray_tpu.get(refs[1], timeout=60)
     np.testing.assert_array_equal(first, np.asarray(again))
+
+
+def test_streaming_generator_iterates_before_completion(ray_start):
+    """num_returns="streaming" (reference StreamingObjectRefGenerator):
+    children are consumable while the generator task is still running."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen(n):
+        import time as _t
+        for i in range(n):
+            yield i * 3
+            _t.sleep(0.8)
+
+    t0 = time.time()
+    gen = slow_gen.remote(4)
+    first = next(iter(gen))
+    first_at = time.time() - t0
+    assert ray_tpu.get(first) == 0
+    # the first child arrived well before the ~3.2s total runtime
+    assert first_at < 2.5, f"first child only after {first_at:.1f}s"
+    rest = [ray_tpu.get(r) for r in gen]
+    assert rest == [3, 6, 9]
