@@ -119,6 +119,7 @@ def init(address: Optional[str] = None, *,
                 return _client_context
             raise RuntimeError("already connected in client mode")
         ctx = connect(address[len("ray://"):])
+        ctx.namespace = namespace  # default for get_actor lookups
         set_client_context(ctx)
         return ctx
     if _global_worker is not None:

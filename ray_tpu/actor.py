@@ -182,6 +182,9 @@ class ActorClass:
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:
     """Look up a named actor (reference ray.get_actor)."""
+    ctx = worker_mod.client_context()
+    if ctx is not None:
+        return ctx.get_actor(name, namespace=namespace)
     w = worker_mod.global_worker()
     info = w.core_worker._gcs.call("get_named_actor", name=name,
                                    namespace=namespace or w.namespace)

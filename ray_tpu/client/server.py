@@ -28,7 +28,9 @@ class ClientProxyServer:
         self._client_refs: Dict[str, Dict[str, Any]] = {}
         # client id -> {fn key -> RemoteFunction}
         self._client_fns: Dict[str, Dict[str, Any]] = {}
-        # client id -> {actor id hex -> ActorHandle}
+        # client id -> {actor id hex -> (ActorHandle, created_by_client)}
+        # created_by_client distinguishes actors the client made (killed
+        # on disconnect) from named actors it merely looked up
         self._client_actors: Dict[str, Dict[str, Any]] = {}
 
         self.server = rpc_lib.RpcServer({
@@ -40,6 +42,7 @@ class ClientProxyServer:
             "cl_create_actor": self.create_actor,
             "cl_actor_call": self.actor_call,
             "cl_kill_actor": self.kill_actor,
+            "cl_get_named_actor": self.get_named_actor,
             "cl_release": self.release,
             "cl_disconnect": self.disconnect,
             "cl_cluster_info": self.cluster_info,
@@ -159,26 +162,46 @@ class ClientProxyServer:
             ac = ac.options(**options)
         args, kwargs = self._materialize_args(client_id, args_blob)
         handle = ac.remote(*args, **kwargs)
+        # get_if_exists may have returned a PRE-EXISTING shared actor:
+        # treat those as not-ours so disconnect can't kill an actor other
+        # clients rely on (conservative: a genuinely fresh get_if_exists
+        # actor then outlives the client, which matches its
+        # shared-by-name intent)
+        created = not options.get("get_if_exists", False)
         with self._lock:
-            self._client_actors.setdefault(
-                client_id, {})[handle._actor_id.hex()] = handle
+            table = self._client_actors.setdefault(client_id, {})
+            prev = table.get(handle._actor_id.hex())
+            table[handle._actor_id.hex()] = (
+                handle, created if prev is None else prev[1])
         return handle._actor_id.binary()
 
     def actor_call(self, client_id: str, actor_id_bin: bytes,
                    method_name: str, args_blob: bytes) -> List[bytes]:
         with self._lock:
-            handle = self._client_actors[client_id][actor_id_bin.hex()]
+            handle, _ = self._client_actors[client_id][actor_id_bin.hex()]
         args, kwargs = self._materialize_args(client_id, args_blob)
         ref = getattr(handle, method_name).remote(*args, **kwargs)
         return self._track(client_id, [ref])
 
+    def get_named_actor(self, client_id: str, name: str,
+                        namespace: str = "") -> bytes:
+        handle = self._rt.get_actor(name, namespace=namespace)
+        with self._lock:
+            table = self._client_actors.setdefault(client_id, {})
+            prev = table.get(handle._actor_id.hex())
+            # looking up an actor this client CREATED must not demote it
+            # to not-ours (it would leak past disconnect)
+            table[handle._actor_id.hex()] = (
+                handle, False if prev is None else prev[1])
+        return handle._actor_id.binary()
+
     def kill_actor(self, client_id: str, actor_id_bin: bytes,
                    no_restart: bool = True) -> None:
         with self._lock:
-            handle = self._client_actors.get(client_id, {}).pop(
+            entry = self._client_actors.get(client_id, {}).pop(
                 actor_id_bin.hex(), None)
-        if handle is not None:
-            self._rt.kill(handle, no_restart=no_restart)
+        if entry is not None:
+            self._rt.kill(entry[0], no_restart=no_restart)
 
     def release(self, client_id: str, ref_bins: List[bytes]) -> None:
         with self._lock:
@@ -191,7 +214,9 @@ class ClientProxyServer:
             self._client_refs.pop(client_id, None)
             self._client_fns.pop(client_id, None)
             actors = self._client_actors.pop(client_id, {})
-        for handle in actors.values():
+        for handle, created in actors.values():
+            if not created:
+                continue  # merely looked-up named actors aren't ours
             try:
                 self._rt.kill(handle)
             except Exception:  # noqa: BLE001

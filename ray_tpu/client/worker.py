@@ -208,6 +208,13 @@ class ClientContext:
         return ([by_bin[b] for b in ready_bins],
                 [by_bin[b] for b in rest_bins])
 
+    def get_actor(self, name: str, namespace: str = ""
+                  ) -> ClientActorHandle:
+        actor_bin = self._call(
+            "cl_get_named_actor", name=name,
+            namespace=namespace or getattr(self, "namespace", ""))
+        return ClientActorHandle(self, actor_bin)
+
     def kill(self, actor: ClientActorHandle,
              no_restart: bool = True) -> None:
         self._call("cl_kill_actor", actor_id_bin=actor._actor_id_bin,

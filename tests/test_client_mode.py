@@ -120,3 +120,42 @@ def test_client_disconnect_releases_actors(ray_start):
         assert not alive, "client's actor survived disconnect"
     finally:
         proxy.stop()
+
+
+def test_client_named_actor_lookup(ray_start):
+    proxy = ClientProxyServer(ray_start.get_gcs_address(), port=0)
+    try:
+        # a named actor created directly in the cluster...
+        @ray_tpu.remote
+        class Registry:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+                return len(self.items)
+
+        direct = Registry.options(name="shared_reg",
+                                  num_cpus=0.1).remote()
+        assert ray_tpu.get(direct.add.remote("from-cluster")) == 1
+
+        # ...is reachable by name from a thin client
+        script = f"""
+import ray_tpu
+ray_tpu.init("ray://127.0.0.1:{proxy.address[1]}")
+reg = ray_tpu.get_actor("shared_reg")
+assert ray_tpu.get(reg.add.remote("from-client")) == 2
+ray_tpu.shutdown()
+print("NAMED_OK")
+"""
+        import subprocess
+        import sys
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=120,
+                             cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "NAMED_OK" in out.stdout
+        assert ray_tpu.get(direct.add.remote("x")) == 3
+        ray_tpu.kill(direct)
+    finally:
+        proxy.stop()
