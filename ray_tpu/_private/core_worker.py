@@ -794,7 +794,8 @@ class CoreWorker:
                             f"push to leased worker failed: {e}", retry=True)
 
     def _return_lease(self, lease_id: str, entry: Optional[_TaskEntry],
-                      nm_address: Optional[Tuple[str, int]] = None) -> None:
+                      nm_address: Optional[Tuple[str, int]] = None,
+                      reuse: bool = True) -> None:
         if nm_address is not None:
             nm_addr = tuple(nm_address)
         elif entry is not None and entry.lease_node:
@@ -802,14 +803,15 @@ class CoreWorker:
         else:
             nm_addr = self.nm_address
         try:
-            self._pool.get(nm_addr).call("nm_return_worker", lease_id=lease_id)
+            self._pool.get(nm_addr).call("nm_return_worker",
+                                         lease_id=lease_id, reuse=reuse)
         except Exception:  # noqa: BLE001
             pass
 
     def _on_task_done(self, task_id: TaskID, results: List[Tuple],
                       lease_id: Optional[str] = None,
-                      dynamic_children: Optional[List[Tuple]] = None
-                      ) -> None:
+                      dynamic_children: Optional[List[Tuple]] = None,
+                      worker_exiting: bool = False) -> None:
         h = task_id.hex()
         with self._lock:
             entry = self.tasks.get(h)
@@ -843,7 +845,10 @@ class CoreWorker:
         entry.dynamic_event.set()  # wake streaming iterators: task over
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
         if lease_id is not None:
-            self._return_lease(lease_id, entry)
+            # worker_exiting (max_calls recycling): retire the worker from
+            # the pool atomically with the lease return, so the node
+            # manager can't re-lease a process that's about to exit
+            self._return_lease(lease_id, entry, reuse=not worker_exiting)
 
     def _on_dynamic_child(self, task_id: TaskID, child: ObjectID,
                           loc: Tuple) -> None:
@@ -1314,6 +1319,8 @@ class _Executor:
         self._buffer: Dict[str, Dict[int, TaskSpec]] = {}
         self._cancelled: set = set()
         self._threads: List[threading.Thread] = []
+        # per-function execution counts for max_calls worker recycling
+        self._calls_by_fn: Dict[str, int] = {}
         self._spawn_exec_threads(1)
 
     def _spawn_exec_threads(self, n: int) -> None:
@@ -1369,6 +1376,7 @@ class _Executor:
 
     def _execute(self, spec: TaskSpec) -> None:
         cw = self.cw
+        will_exit = False  # max_calls recycling decision (see below)
         if spec.task_id.hex() in self._cancelled:
             self._report_error(spec, exc.TaskCancelledError(spec.function_name))
             return
@@ -1472,13 +1480,32 @@ class _Executor:
             for i, v in enumerate(values):
                 oid = ObjectID.for_task_return(spec.task_id, i + 1)
                 results.append(cw.store_blob(oid.hex(), ser.pack(v)))
-            self._report_done(spec, results)
+            # max_calls recycling: decide BEFORE reporting so the owner
+            # retires this worker's lease (reuse=False) atomically — a
+            # post-report exit would race new leases onto a dying
+            # process. Exit only if we own no pinned objects
+            # (_on_can_exit): dying with owned objects would lose them.
+            if spec.task_type == TaskType.NORMAL_TASK \
+                    and spec.max_calls > 0:
+                with self._lock:
+                    n = self._calls_by_fn.get(spec.function_key, 0) + 1
+                    self._calls_by_fn[spec.function_key] = n
+                will_exit = n >= spec.max_calls and cw._on_can_exit()
+            self._report_done(spec, results, worker_exiting=will_exit)
         finally:
             cw.task_events.record(spec.task_id.hex(), ts_exec_end=_ev_now())
             cw.set_current_task(None)
             cw.set_current_trace(None)
             if spec.task_type == TaskType.NORMAL_TASK:
                 cw.current_placement_group_id = None
+            if will_exit:
+                logger.info("max_calls=%d reached for %s; worker exiting",
+                            spec.max_calls, spec.function_name)
+                try:
+                    cw.task_events.flush()
+                except Exception:  # noqa: BLE001
+                    pass
+                os._exit(0)
 
     @staticmethod
     def _split_returns(out: Any, num_returns: int) -> List[Any]:
@@ -1494,13 +1521,14 @@ class _Executor:
         return out_list
 
     def _report_done(self, spec: TaskSpec, results: List[Tuple],
-                     dynamic_children: Optional[List[Tuple]] = None
-                     ) -> None:
+                     dynamic_children: Optional[List[Tuple]] = None,
+                     worker_exiting: bool = False) -> None:
         lease_id = getattr(spec, "_lease_id", None)
         try:
             self.cw._pool.get(spec.owner_address).call(
                 "cw_task_done", task_id=spec.task_id, results=results,
-                lease_id=lease_id, dynamic_children=dynamic_children)
+                lease_id=lease_id, dynamic_children=dynamic_children,
+                worker_exiting=worker_exiting)
         except Exception:  # noqa: BLE001
             logger.warning("owner %s unreachable for task result",
                            spec.owner_address)

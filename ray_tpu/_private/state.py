@@ -132,6 +132,10 @@ class TaskSpec:
     # Normal-task fields
     max_retries: int = 0
     retry_exceptions: bool = False
+    # worker recycling (reference max_calls option): the worker process
+    # exits after executing this function max_calls times — the escape
+    # hatch for native libraries that leak
+    max_calls: int = 0
     # num_returns="dynamic" (reference _raylet.pyx:269
     # StreamingObjectRefGenerator): the task yields a variable number of
     # values; each becomes its own object, and the single declared

@@ -120,6 +120,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries",
                                  Config.default_task_max_retries),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            max_calls=int(opts.get("max_calls", 0)),
             scheduling_strategy=strategy,
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,

@@ -391,3 +391,19 @@ def test_streaming_generator_iterates_before_completion(ray_start):
     assert first_at < 2.5, f"first child only after {first_at:.1f}s"
     rest = [ray_tpu.get(r) for r in gen]
     assert rest == [3, 6, 9]
+
+
+def test_max_calls_recycles_worker(ray_start):
+    """max_calls (reference option surface §8.1): the worker process
+    exits after N executions; fresh workers carry on."""
+
+    @ray_tpu.remote(max_calls=2)
+    def whoami():
+        return os.getpid()
+
+    pids = [ray_tpu.get(whoami.remote()) for _ in range(6)]
+    assert len(set(pids)) >= 3, f"worker never recycled: {pids}"
+    # the contract: no process executes this function more than max_calls
+    # times (exact rotation order depends on pool scheduling)
+    from collections import Counter
+    assert max(Counter(pids).values()) <= 2, pids
