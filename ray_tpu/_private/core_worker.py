@@ -828,9 +828,12 @@ class CoreWorker:
                         ev.set()
         if duplicate:
             # Late/duplicate completion (e.g. after cancel or retry): the
-            # first writer won; just hand back any lease that rode in.
+            # first writer won; just hand back any lease that rode in —
+            # still honoring worker_exiting so a dying worker can't slip
+            # back into the idle pool through this branch.
             if lease_id is not None:
-                self._return_lease(lease_id, entry)
+                self._return_lease(lease_id, entry,
+                                   reuse=not worker_exiting)
             return
         for oid, loc in zip(entry.return_ids, results):
             with self._lock:
@@ -1380,6 +1383,22 @@ class _Executor:
         if spec.task_id.hex() in self._cancelled:
             self._report_error(spec, exc.TaskCancelledError(spec.function_name))
             return
+        # max_calls counts EVERY execution — failing and generator tasks
+        # included (the recycle exists for leaky native libs, which leak
+        # on errors too). The exit decision itself happens at report time.
+        recycle_candidate = False
+        if spec.task_type == TaskType.NORMAL_TASK and spec.max_calls > 0:
+            with self._lock:
+                n = self._calls_by_fn.get(spec.function_key, 0) + 1
+                self._calls_by_fn[spec.function_key] = n
+            recycle_candidate = n >= spec.max_calls
+
+        def decide_exit() -> bool:
+            # _on_can_exit covers pins registered so far; a ref returned
+            # BY THIS task isn't borrowed yet when we exit — losing such
+            # an owner matches the reference's owner-failure semantics
+            # for worker-owned objects.
+            return recycle_candidate and cw._on_can_exit()
         cw.set_current_task(spec.task_id)
         cw.set_current_trace(spec.trace_id)
         cw.task_events.record(spec.task_id.hex(), state="RUNNING",
@@ -1452,13 +1471,15 @@ class _Executor:
                         report_q.put((child, loc))
                     report_q.put(None)
                     reporter.join(timeout=30)
+                    will_exit = decide_exit()
                     self._report_done(
                         spec,
                         [(INLINE,
                           ser.pack([ObjectRef(oid, spec.owner_address,
                                               _register=False)
                                     for oid, _ in children]))],
-                        dynamic_children=children)
+                        dynamic_children=children,
+                        worker_exiting=will_exit)
                     return
                 else:
                     fn = cw.import_function(spec.function_key)
@@ -1474,23 +1495,19 @@ class _Executor:
                             reason=f"creation failed: {e}", restart=False)
                     except Exception:  # noqa: BLE001
                         pass
-                self._report_error(spec, exc.RayTaskError(
-                    spec.function_name, traceback.format_exc(), e))
+                will_exit = decide_exit()
+                self._report_error(
+                    spec, exc.RayTaskError(
+                        spec.function_name, traceback.format_exc(), e),
+                    worker_exiting=will_exit)
                 return
             for i, v in enumerate(values):
                 oid = ObjectID.for_task_return(spec.task_id, i + 1)
                 results.append(cw.store_blob(oid.hex(), ser.pack(v)))
-            # max_calls recycling: decide BEFORE reporting so the owner
-            # retires this worker's lease (reuse=False) atomically — a
-            # post-report exit would race new leases onto a dying
-            # process. Exit only if we own no pinned objects
-            # (_on_can_exit): dying with owned objects would lose them.
-            if spec.task_type == TaskType.NORMAL_TASK \
-                    and spec.max_calls > 0:
-                with self._lock:
-                    n = self._calls_by_fn.get(spec.function_key, 0) + 1
-                    self._calls_by_fn[spec.function_key] = n
-                will_exit = n >= spec.max_calls and cw._on_can_exit()
+            # recycling decision rides the report so the owner retires
+            # this worker's lease (reuse=False) atomically — a
+            # post-report exit would race new leases onto a dying process
+            will_exit = decide_exit()
             self._report_done(spec, results, worker_exiting=will_exit)
         finally:
             cw.task_events.record(spec.task_id.hex(), ts_exec_end=_ev_now())
@@ -1533,7 +1550,9 @@ class _Executor:
             logger.warning("owner %s unreachable for task result",
                            spec.owner_address)
 
-    def _report_error(self, spec: TaskSpec, err: Exception) -> None:
+    def _report_error(self, spec: TaskSpec, err: Exception,
+                      worker_exiting: bool = False) -> None:
         blob = pickle.dumps(err)
         self._report_done(spec, [(ERROR, blob)] * max(spec.num_returns, 1)
-                          if spec.num_returns else [])
+                          if spec.num_returns else [],
+                          worker_exiting=worker_exiting)

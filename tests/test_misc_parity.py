@@ -407,3 +407,20 @@ def test_max_calls_recycles_worker(ray_start):
     # times (exact rotation order depends on pool scheduling)
     from collections import Counter
     assert max(Counter(pids).values()) <= 2, pids
+
+
+def test_max_calls_counts_failing_executions(ray_start):
+    """Failing executions count toward max_calls too — the recycle
+    exists for leaky native libs, which leak on errors as well."""
+
+    @ray_tpu.remote(max_calls=2, max_retries=0)
+    def flaky_pid(fail):
+        if fail:
+            raise ValueError("boom")
+        return os.getpid()
+
+    pid1 = ray_tpu.get(flaky_pid.remote(False))
+    with pytest.raises(ValueError):
+        ray_tpu.get(flaky_pid.remote(True))  # execution #2 → recycle
+    pid3 = ray_tpu.get(flaky_pid.remote(False))
+    assert pid3 != pid1, "failing execution didn't count toward max_calls"
