@@ -8,6 +8,8 @@ from ray_tpu.util.placement_group import (  # noqa: F401
     placement_group_table,
     remove_placement_group,
 )
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.queue import Queue  # noqa: F401
 from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -18,5 +20,5 @@ __all__ = [
     "PlacementGroup", "placement_group", "remove_placement_group",
     "get_current_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "SpreadSchedulingStrategy",
+    "SpreadSchedulingStrategy", "Queue", "ActorPool",
 ]

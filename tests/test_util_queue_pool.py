@@ -1,0 +1,129 @@
+"""ray_tpu.util Queue + ActorPool (reference util/queue.py,
+util/actor_pool.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+def test_queue_fifo_across_processes(ray_start):
+    q = Queue()
+    try:
+        q.put_batch([1, 2, 3])
+        assert q.qsize() == 3 and not q.empty()
+
+        @ray_tpu.remote
+        def consume(queue):
+            return [queue.get(timeout=10) for _ in range(3)]
+
+        assert ray_tpu.get(consume.remote(q)) == [1, 2, 3]
+        assert q.empty()
+
+        @ray_tpu.remote
+        def produce(queue):
+            queue.put("from-worker")
+            return True
+
+        assert ray_tpu.get(produce.remote(q))
+        assert q.get(timeout=10) == "from-worker"
+    finally:
+        q.shutdown()
+
+
+def test_queue_bounds_and_nowait(ray_start):
+    q = Queue(maxsize=2)
+    try:
+        q.put_nowait("a")
+        q.put_nowait("b")
+        assert q.full()
+        with pytest.raises(Full):
+            q.put("c", timeout=0.2)
+        assert q.get_nowait() == "a"
+        q.put_nowait("c")
+        assert q.get_batch(10) == ["b", "c"]
+        with pytest.raises(Empty):
+            q.get_nowait()
+    finally:
+        q.shutdown()
+
+
+def test_queue_blocking_get_wakes_on_put(ray_start):
+    import time
+    q = Queue()
+    try:
+        @ray_tpu.remote
+        def waiter(queue):
+            return queue.get(timeout=30)
+
+        ref = waiter.remote(q)
+        time.sleep(0.5)
+        q.put("wake")
+        assert ray_tpu.get(ref, timeout=30) == "wake"
+    finally:
+        q.shutdown()
+
+
+def test_actor_pool_map_ordered_and_unordered(ray_start):
+    @ray_tpu.remote
+    class Sq:
+        def work(self, x):
+            return x * x
+
+    actors = [Sq.options(num_cpus=0.1).remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(6)))
+    assert out == [0, 1, 4, 9, 16, 25]
+    out = sorted(pool.map_unordered(lambda a, v: a.work.remote(v),
+                                    range(6)))
+    assert out == [0, 1, 4, 9, 16, 25]
+    # more values than actors: pool reuses freed actors
+    assert pool.has_free()
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_queue_many_parked_consumers_no_deadlock(ray_start):
+    """Parked blocking gets must not starve the waking put (server-side
+    waits are sliced so executor threads recycle)."""
+    q = Queue()
+    try:
+        @ray_tpu.remote
+        def waiter(queue, i):
+            return (i, queue.get(timeout=60))
+
+        refs = [waiter.options(num_cpus=0.2).remote(q, i)
+                for i in range(6)]
+        import time
+        time.sleep(1.0)  # let consumers park
+        q.put_batch(list(range(6)))
+        out = ray_tpu.get(refs, timeout=120)
+        assert sorted(v for _, v in out) == list(range(6))
+    finally:
+        q.shutdown()
+
+
+def test_actor_pool_survives_task_errors(ray_start):
+    @ray_tpu.remote
+    class Worker:
+        def work(self, x):
+            if x < 0:
+                raise ValueError("negative")
+            return x * 2
+
+    pool = ActorPool([Worker.options(num_cpus=0.1).remote()
+                      for _ in range(2)])
+    for v in (1, -1, 2, -2, 3):
+        pool.submit(lambda a, x: a.work.remote(x), v)
+    results, errors = [], 0
+    while pool.has_next():
+        try:
+            results.append(pool.get_next())
+        except ValueError:
+            errors += 1
+    assert sorted(results) == [2, 4, 6] and errors == 2
+    # the pool kept both actors through the failures
+    assert pool.has_free()
+    out = list(pool.map(lambda a, x: a.work.remote(x), [5, 6]))
+    assert out == [10, 12]
