@@ -220,8 +220,12 @@ def put_owned():
     return [ray_tpu.put(list(range(1000)))]  # worker owns the inner obj
 inner = ray_tpu.get(put_owned.remote())[0]
 assert ray_tpu.get([f.remote() for _ in range(3)]) == [1, 1, 1]
-# the owner of a still-referenced object must SURVIVE reaping
-time.sleep(6)
+# the owner of a still-referenced object must SURVIVE reaping: wait for
+# at least one reap cycle past the idle timeout, then verify
+deadline = time.time() + 45
+while time.time() < deadline and len(state_api.list_workers()) > 1:
+    time.sleep(0.5)
+time.sleep(3)  # a further full timeout window under a live owner pin
 assert len(state_api.list_workers()) >= 1, "object owner was reaped"
 assert sum(ray_tpu.get(inner)) == 499500
 # release the ref: now everything reaps to zero
@@ -239,6 +243,9 @@ print("REAP_OK")
     env = dict(os.environ)
     env["RAY_TPU_idle_worker_kill_timeout_s"] = "2"
     env["RAY_TPU_idle_worker_pool_floor"] = "0"
+    # this test measures reap TIMING semantics; inherited chaos delays
+    # (full-suite chaos sweeps) would squeeze its fixed windows
+    env.pop("RAY_TPU_testing_rpc_delay_us", None)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run([sys.executable, "-c", script], env=env,
                          capture_output=True, text=True, timeout=180,
