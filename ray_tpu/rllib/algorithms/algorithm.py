@@ -59,6 +59,15 @@ class EnvRunnerSet:
         ray_tpu.get([a.set_weights.remote(weights) for a in self._actors],
                     timeout=300)
 
+    def set_explore_inputs(self, inputs: Dict[str, float]) -> None:
+        """Broadcast exploration scalars (epsilon schedules etc.)."""
+        if self._local is not None:
+            self._local.set_explore_inputs(inputs)
+            return
+        import ray_tpu
+        ray_tpu.get([a.set_explore_inputs.remote(inputs)
+                     for a in self._actors], timeout=120)
+
     def sample_sync(self, num_timesteps_per_runner: int
                     ) -> List[Dict[str, Any]]:
         """reference execution/rollout_ops.py:21
@@ -101,9 +110,8 @@ class Algorithm:
         self.action_space = probe.action_space
         probe.close()
 
-        self.module = config._custom_module or default_module_for(
-            self.observation_space, self.action_space,
-            config.model_hiddens)
+        self.module = config._custom_module or self.default_module(
+            self.observation_space, self.action_space)
         self.learner_group = LearnerGroup(
             lambda: self.learner_cls(self.module, self.config),
             num_learners=config.num_learners, seed=config.seed)
@@ -118,6 +126,12 @@ class Algorithm:
             maxlen=config.metrics_num_episodes_for_smoothing)
 
     # ---- the per-algorithm core ------------------------------------
+    def default_module(self, observation_space, action_space):
+        """Module when the user supplies none; algorithms with
+        non-actor-critic nets (DQN, SAC) override."""
+        return default_module_for(observation_space, action_space,
+                                  self.config.model_hiddens)
+
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
 

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.dqn.dqn import (DQN, DQNConfig, DQNLearner,
+                                              DuelingQMLPModule)
+
+__all__ = ["DQN", "DQNConfig", "DQNLearner", "DuelingQMLPModule"]

@@ -13,12 +13,14 @@ from typing import Tuple, Type
 
 def _registry():
     from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
+    from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
     from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
     return {
         "PPO": (PPO, PPOConfig),
         "IMPALA": (Impala, ImpalaConfig),
         "APPO": (APPO, APPOConfig),
+        "DQN": (DQN, DQNConfig),
     }
 
 

@@ -169,16 +169,16 @@ class Learner:
                         self._params, self._opt_state, gb,
                         self.extra_inputs())
                 count += 1
-                for k, v in st.items():
-                    stats[k] = stats.get(k, 0.0) + float(v)
+                self._accumulate(stats, st)
         if count == 0:  # batch smaller than one minibatch: single step
             gb = self._make_global_batch(local_batch)
             with self._state_lock:
                 self._params, self._opt_state, st = self._update_fn(
                     self._params, self._opt_state, gb, self.extra_inputs())
             count = 1
-            stats = {k: float(v) for k, v in st.items()}
-        return {k: v / count for k, v in stats.items()}
+            stats = {}
+            self._accumulate(stats, st)
+        return self._finalize(stats, count)
 
     # ---- algorithm contract ----------------------------------------
     def compute_loss(self, params, batch: Dict[str, Any],
@@ -191,6 +191,23 @@ class Learner:
     def extra_inputs(self) -> Dict[str, Any]:
         """Scalars threaded into the jitted loss (kl coeff etc.)."""
         return {}
+
+    # ---- stats ------------------------------------------------------
+    @staticmethod
+    def _accumulate(stats: Dict[str, Any], st: Dict[str, Any]) -> None:
+        """Scalar stats average over minibatches; array-valued stats
+        (e.g. per-sample TD errors for prioritized replay) keep the last
+        minibatch's values."""
+        for k, v in st.items():
+            if getattr(v, "ndim", 0):
+                stats[k] = np.asarray(v)
+            else:
+                stats[k] = stats.get(k, 0.0) + float(v)
+
+    @staticmethod
+    def _finalize(stats: Dict[str, Any], count: int) -> Dict[str, Any]:
+        return {k: (v if isinstance(v, np.ndarray) else v / count)
+                for k, v in stats.items()}
 
     # ---- update loop ------------------------------------------------
     def update(self, batch: Dict[str, np.ndarray],
@@ -217,9 +234,8 @@ class Learner:
                         self._params, self._opt_state, mb,
                         self.extra_inputs())
                 count += 1
-                for k, v in st.items():
-                    stats[k] = stats.get(k, 0.0) + float(v)
-        return {k: v / max(count, 1) for k, v in stats.items()}
+                self._accumulate(stats, st)
+        return self._finalize(stats, max(count, 1))
 
     # ---- weights ----------------------------------------------------
     def get_weights(self):

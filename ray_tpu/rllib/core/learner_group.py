@@ -148,8 +148,16 @@ class LearnerGroup:
             a.update.remote(batch, minibatch_size, num_iters, seed)
             for a in self._actors
         ], timeout=600)
-        return {k: float(np.mean([s[k] for s in stats]))
-                for k in stats[0]}
+        # Scalars mean-reduce across ranks; array stats (per-sample TD
+        # errors + their batch indexes) concatenate in rank order — each
+        # rank reported its own shard of the global batch.
+        out: Dict[str, Any] = {}
+        for k in stats[0]:
+            if getattr(stats[0][k], "ndim", 0):
+                out[k] = np.concatenate([np.asarray(s[k]) for s in stats])
+            else:
+                out[k] = float(np.mean([s[k] for s in stats]))
+        return out
 
     def additional_update(self, **kwargs) -> Dict[str, Any]:
         if self._local is not None:

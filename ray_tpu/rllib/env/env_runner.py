@@ -15,7 +15,7 @@ object store — device arrays never transit it, SURVEY.md §5.8).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,9 +43,14 @@ class SingleAgentEnvRunner:
             (seed if seed is not None else 0) * 10007 + worker_index)
         self.params = None
 
+        # Exploration state (epsilon etc.) threads into the jitted
+        # forward as scalar arrays in the batch dict — value changes
+        # don't retrace (reference: exploration objects own this state,
+        # rllib/utils/exploration/epsilon_greedy.py).
+        self._explore_inputs: Dict[str, np.ndarray] = {}
         self._explore = jax.jit(
-            lambda p, obs, k: module.forward_exploration(
-                p, {"obs": obs}, k))
+            lambda p, obs, k, extra: module.forward_exploration(
+                p, {"obs": obs, **extra}, k))
         self._value_only = jax.jit(
             lambda p, obs: module.forward_train(p, {"obs": obs})["vf_preds"])
 
@@ -67,6 +72,11 @@ class SingleAgentEnvRunner:
     def get_weights(self):
         return self.params
 
+    def set_explore_inputs(self, inputs: Dict[str, float]) -> None:
+        """Update exploration scalars (e.g. {"epsilon": 0.1})."""
+        self._explore_inputs = {
+            k: np.asarray(v, np.float32) for k, v in inputs.items()}
+
     # ---- sampling ---------------------------------------------------
     def sample(self, num_timesteps: int) -> Dict[str, Any]:
         """Roll out ~num_timesteps across the vector env; returns a
@@ -78,13 +88,24 @@ class SingleAgentEnvRunner:
         steps = max(1, num_timesteps // self.env.num_envs)
         cols: Dict[str, List[np.ndarray]] = {
             "obs": [], "actions": [], "rewards": [], "terminateds": [],
-            "truncateds": [], "action_logp": [], "vf_preds": []}
-        for _ in range(steps):
+            "truncateds": [], "action_logp": [], "vf_preds": [],
+            "raw_rewards": []}
+        # sparse (t, env) -> true final observation at done steps, for
+        # replay-based algorithms that bootstrap at update time
+        finals_idx: List[Tuple[int, int]] = []
+        finals_val: List[np.ndarray] = []
+        for step_t in range(steps):
             self._key, sub = jax.random.split(self._key)
-            out = self._explore(self.params, self._obs, sub)
+            out = self._explore(self.params, self._obs, sub,
+                                self._explore_inputs)
             actions = np.asarray(out["actions"])
             obs_next, rewards, terms, truncs, _, final_obs = \
                 self.env.step(actions)
+            raw_rewards = rewards.copy()
+            for i in np.nonzero(np.asarray(terms) | np.asarray(truncs))[0]:
+                if final_obs[i] is not None:
+                    finals_idx.append((step_t, int(i)))
+                    finals_val.append(np.asarray(final_obs[i]))
             # Truncation is not termination: fold the bootstrap value of
             # the true final observation into the reward (exactly
             # equivalent to bootstrapping V there), so GAE can then treat
@@ -99,6 +120,7 @@ class SingleAgentEnvRunner:
             cols["obs"].append(self._obs)
             cols["actions"].append(actions)
             cols["rewards"].append(rewards)
+            cols["raw_rewards"].append(raw_rewards)
             cols["terminateds"].append(np.asarray(terms))
             cols["truncateds"].append(np.asarray(truncs))
             cols["action_logp"].append(np.asarray(out["action_logp"]))
@@ -122,6 +144,15 @@ class SingleAgentEnvRunner:
         # reward above.
         batch["bootstrap_value"] = np.asarray(
             self._value_only(self.params, self._obs))
+        # Obs after the final step: with obs[t+1], gives next_obs for
+        # replay-based algorithms (done rows mask the autoreset obs).
+        batch["last_obs"] = np.asarray(self._obs).copy()
+        batch["final_obs_idx"] = (
+            np.asarray(finals_idx, np.int64).reshape(-1, 2))
+        batch["final_obs_vals"] = (
+            np.stack(finals_val) if finals_val
+            else np.zeros((0, *batch["last_obs"].shape[1:]),
+                          batch["last_obs"].dtype))
         metrics = self._completed
         self._completed = []
         batch["episode_metrics"] = metrics
