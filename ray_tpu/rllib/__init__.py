@@ -3,7 +3,7 @@
 reference: python/ray/rllib — Algorithm/Learner/RLModule/EnvRunner stack
 (SURVEY.md §2.3). Learners are JIT'd XLA programs; EnvRunners stay CPU
 actors streaming trajectories through the object store (BASELINE.json
-north star). Algorithms shipped: PPO, IMPALA, APPO, DQN
+north star). Algorithms shipped: PPO, IMPALA, APPO, DQN, SAC
 (the reference's 34-algo registry is tracked in SURVEY.md §8.3).
 """
 
@@ -11,6 +11,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala.impala import (Impala,  # noqa: F401
                                                     ImpalaConfig)
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
@@ -29,7 +30,7 @@ from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner  # noqa: F401
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
     "ImpalaConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
-    "get_algorithm_class",
+    "SAC", "SACConfig", "get_algorithm_class",
     "registered_algorithms", "Learner", "LearnerGroup", "RLModule",
     "DiscreteMLPModule", "DiscreteConvModule", "Env", "register_env",
     "make_env", "SingleAgentEnvRunner",

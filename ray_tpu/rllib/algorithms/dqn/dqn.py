@@ -303,11 +303,35 @@ class DQN(Algorithm):
             config.initial_epsilon)
         self._last_target_update = 0
 
+    # ---- hooks (SAC overrides; reference SAC extends DQN too) -------
+    def _before_sample(self, stats: Dict[str, Any]) -> None:
+        """Push exploration state to runners (epsilon-greedy here)."""
+        eps = self.epsilon_schedule(self._timesteps_total)
+        self.env_runners.set_explore_inputs({"epsilon": eps})
+        stats["epsilon"] = eps
+
+    def _training_intensity(self) -> float:
+        cfg = self.config
+        return (cfg.training_intensity
+                if cfg.training_intensity is not None
+                else cfg.train_batch_size / cfg.rollout_fragment_length)
+
+    def _after_each_update(self) -> None:
+        """Per-gradient-step target maintenance (SAC: polyak)."""
+
+    def _maybe_update_target(self) -> None:
+        """Periodic hard target sync (target_network_update_freq)."""
+        if self._timesteps_total - self._last_target_update >= \
+                self.config.target_network_update_freq:
+            self.learner_group.additional_update(update_target=True)
+            self._last_target_update = self._timesteps_total
+
+    # ---- the shared replay loop -------------------------------------
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         # --- explore + sample (reference dqn.py training_step) -------
-        eps = self.epsilon_schedule(self._timesteps_total)
-        self.env_runners.set_explore_inputs({"epsilon": eps})
+        stats: Dict[str, Any] = {}
+        self._before_sample(stats)
         fragments = self.env_runners.sample_sync(
             cfg.rollout_fragment_length * cfg.num_envs_per_env_runner)
         self._record_episode_metrics(fragments)
@@ -318,16 +342,12 @@ class DQN(Algorithm):
             sampled += f["rewards"].size
         self._timesteps_total += sampled
 
-        stats: Dict[str, Any] = {"epsilon": eps}
         # --- replay train --------------------------------------------
         if self.replay_buffer.num_added >= \
                 cfg.num_steps_sampled_before_learning_starts:
-            intensity = (cfg.training_intensity
-                         if cfg.training_intensity is not None
-                         else cfg.train_batch_size
-                         / cfg.rollout_fragment_length)
             num_updates = max(1, round(
-                sampled * intensity / cfg.train_batch_size))
+                sampled * self._training_intensity()
+                / cfg.train_batch_size))
             agg: Dict[str, float] = {}
             for u in range(num_updates):
                 if isinstance(self.replay_buffer, PrioritizedReplayBuffer):
@@ -344,16 +364,13 @@ class DQN(Algorithm):
                     self.replay_buffer.update_priorities(
                         np.asarray(st["td_indexes"], np.int64),
                         np.asarray(st["td_error"]))
+                self._after_each_update()
                 for k, v in st.items():
                     if not getattr(v, "ndim", 0):
                         agg[k] = agg.get(k, 0.0) + float(v)
             stats.update({k: v / num_updates for k, v in agg.items()})
             stats["num_updates"] = num_updates
-            # --- target sync (target_network_update_freq) ------------
-            if self._timesteps_total - self._last_target_update >= \
-                    cfg.target_network_update_freq:
-                self.learner_group.additional_update(update_target=True)
-                self._last_target_update = self._timesteps_total
+            self._maybe_update_target()
             # --- weight sync -----------------------------------------
             self.env_runners.sync_weights(self.learner_group.get_weights())
         return {"learner": stats, "num_env_steps_sampled": sampled,

@@ -16,11 +16,13 @@ def _registry():
     from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
     from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
+    from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig
     return {
         "PPO": (PPO, PPOConfig),
         "IMPALA": (Impala, ImpalaConfig),
         "APPO": (APPO, APPOConfig),
         "DQN": (DQN, DQNConfig),
+        "SAC": (SAC, SACConfig),
     }
 
 

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.sac.sac import (SAC, SACConfig, SACLearner,
+                                              SquashedGaussianModule)
+
+__all__ = ["SAC", "SACConfig", "SACLearner", "SquashedGaussianModule"]

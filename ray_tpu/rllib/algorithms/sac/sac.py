@@ -1,0 +1,304 @@
+"""SAC: soft actor-critic for continuous control.
+
+reference parity: rllib/algorithms/sac/sac.py (SACConfig — twin Q,
+tau polyak target update, initial_alpha/target_entropy="auto", n_step
+replay; training_step shares the DQN replay loop) and
+sac_torch_policy.py (actor_critic_loss: squashed-gaussian policy,
+min-of-twin-Q targets with entropy bonus, trainable log_alpha against
+target entropy). TPU-first shape: actor + critic + alpha losses combine
+into ONE jitted update with subtree stop_gradients routing each term's
+gradients to its own parameters — one XLA program instead of the
+reference's three optimizer round-trips; target nets polyak-update in a
+second tiny jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
+from ray_tpu.rllib.core.catalog import _mlp_apply, _mlp_init
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(DQNConfig):
+    """Shares DQN's replay-loop knobs (buffer_size, n_step,
+    prioritized_replay*, training_intensity, learning-start threshold).
+    DQN-only knobs (dueling, double_q, epsilon_*,
+    target_network_update_freq) are inert: SAC's stochastic policy
+    explores and its targets polyak-update every gradient step (tau)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or SAC)
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 1
+        self.tau = 0.005
+        self.initial_alpha = 1.0
+        self.target_entropy = "auto"     # -> -action_dim
+        self.num_steps_sampled_before_learning_starts = 1500
+        # epsilon schedule is inert for SAC (stochastic policy explores)
+        self.initial_epsilon = self.final_epsilon = 0.0
+
+
+class SquashedGaussianModule(RLModule):
+    """tanh-squashed gaussian policy + twin Q(s, a) critics
+    (reference sac_torch_model.py). Actions rescale to [low, high]."""
+
+    def __init__(self, obs_dim: int, act_dim: int, low, high,
+                 hiddens: Sequence[int] = (256, 256)):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+        self.hiddens = tuple(hiddens)
+
+    # ---- params -----------------------------------------------------
+    def init_params(self, key) -> Dict[str, Any]:
+        import jax
+        kp, k1, k2 = jax.random.split(key, 3)
+        pi_sizes = [self.obs_dim, *self.hiddens, 2 * self.act_dim]
+        q_sizes = [self.obs_dim + self.act_dim, *self.hiddens, 1]
+        return {"pi": _mlp_init(kp, pi_sizes),
+                "q1": _mlp_init(k1, q_sizes, scale_last=1.0),
+                "q2": _mlp_init(k2, q_sizes, scale_last=1.0)}
+
+    # ---- pure heads -------------------------------------------------
+    def pi_dist_inputs(self, params, obs):
+        import jax.numpy as jnp
+        out = _mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action(self, params, obs, key):
+        """Reparameterized squashed sample -> (action, logp)."""
+        import jax
+        import jax.numpy as jnp
+        mean, log_std = self.pi_dist_inputs(params, obs)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(key, mean.shape)
+        logp_u = jnp.sum(
+            -0.5 * (((u - mean) / std) ** 2 + 2 * log_std
+                    + jnp.log(2 * jnp.pi)), axis=-1)
+        t = jnp.tanh(u)
+        scale = (self.high - self.low) / 2.0
+        action = t * scale + (self.high + self.low) / 2.0
+        logp = logp_u - jnp.sum(
+            jnp.log(scale * (1 - t ** 2) + 1e-6), axis=-1)
+        return action, logp
+
+    def q_values(self, params, obs, actions):
+        import jax.numpy as jnp
+        x = jnp.concatenate(
+            [obs, actions.astype(jnp.float32)], axis=-1)
+        q1 = _mlp_apply(params["q1"], x)[..., 0]
+        q2 = _mlp_apply(params["q2"], x)[..., 0]
+        return q1, q2
+
+    # ---- RLModule contract ------------------------------------------
+    def forward_train(self, params, batch):
+        import jax.numpy as jnp
+        mean, log_std = self.pi_dist_inputs(params, batch["obs"])
+        return {"action_dist_inputs": jnp.concatenate(
+                    [mean, log_std], axis=-1),
+                # replay path bootstraps at update time; no V head
+                "vf_preds": jnp.zeros(mean.shape[:-1], jnp.float32)}
+
+    def forward_exploration(self, params, batch, key):
+        out = self.forward_train(params, batch)
+        actions, logp = self.sample_action(params, batch["obs"], key)
+        out["actions"] = actions
+        out["action_logp"] = logp
+        return out
+
+    def forward_inference(self, params, batch):
+        import jax.numpy as jnp
+        out = self.forward_train(params, batch)
+        mean, _ = self.pi_dist_inputs(params, batch["obs"])
+        scale = (self.high - self.low) / 2.0
+        out["actions"] = jnp.tanh(mean) * scale + \
+            (self.high + self.low) / 2.0
+        return out
+
+
+class SACLearner(Learner):
+    """One jitted update for critic + actor + alpha (reference
+    sac_torch_policy.py actor_critic_loss + optimizer_fn's three Adams)."""
+
+    def build(self, seed: int = 0) -> None:
+        super().build(seed)
+        self._post_build(seed)
+
+    def build_distributed(self, seed: int = 0) -> None:
+        super().build_distributed(seed)
+        self._post_build(seed)
+
+    def _post_build(self, seed: int) -> None:
+        import jax
+        import jax.numpy as jnp
+        with self._state_lock:
+            # log_alpha joins the trainable pytree; Adam state was built
+            # in super().build BEFORE this insert, so rebuild it
+            self._params["log_alpha"] = self._maybe_replicate(
+                jnp.asarray(np.log(self.config.initial_alpha),
+                            jnp.float32))
+            if getattr(self, "_distributed", False):
+                # rebuild Adam state on host then re-replicate every
+                # leaf (matches build_distributed's layout exactly)
+                host_params = jax.device_get(self._params)
+                self._opt_state = jax.tree.map(
+                    self._replicate_host,
+                    self._optimizer.init(host_params))
+            else:
+                self._opt_state = self._optimizer.init(self._params)
+            self._target = {
+                "q1": jax.tree.map(jnp.copy, self._params["q1"]),
+                "q2": jax.tree.map(jnp.copy, self._params["q2"])}
+        self._rng = jax.random.PRNGKey(seed + 777)
+        tau = self.config.tau
+
+        def polyak(target, params):
+            return jax.tree.map(
+                lambda t, p: (1.0 - tau) * t + tau * p, target,
+                {"q1": params["q1"], "q2": params["q2"]})
+
+        self._polyak = jax.jit(polyak)
+        act_dim = self.module.act_dim
+        self.target_entropy = (-float(act_dim)
+                               if self.config.target_entropy == "auto"
+                               else float(self.config.target_entropy))
+
+    def _maybe_replicate(self, x):
+        if getattr(self, "_distributed", False):
+            return self._replicate_host(np.asarray(x))
+        return x
+
+    def extra_inputs(self) -> Dict[str, Any]:
+        import jax
+        self._rng, sub = jax.random.split(self._rng)
+        return {"target": self._target, "rng": sub}
+
+    def compute_loss(self, params, batch, extra):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        m: SquashedGaussianModule = self.module
+        cfg = self.config
+        k_next, k_pi = jax.random.split(extra["rng"])
+        alpha = jnp.exp(params["log_alpha"])
+
+        # ---- critic target: r + gamma^n (1-d) (minQ' - a*logp') -----
+        next_a, next_logp = m.sample_action(params, batch["next_obs"],
+                                            k_next)
+        tq1, tq2 = m.q_values(extra["target"], batch["next_obs"], next_a)
+        q_next = jnp.minimum(tq1, tq2) - \
+            lax.stop_gradient(alpha) * next_logp
+        target = batch["rewards"] + batch["discounts"] * \
+            (1.0 - batch["dones"]) * q_next
+        target = lax.stop_gradient(target)
+        q1, q2 = m.q_values(params, batch["obs"], batch["actions"])
+        # per-sample importance weights when prioritized replay is on
+        w = batch.get("weights")
+        td_sq = 0.5 * ((q1 - target) ** 2 + (q2 - target) ** 2)
+        critic_loss = jnp.mean(td_sq * w) if w is not None \
+            else jnp.mean(td_sq)
+
+        # ---- actor: alpha*logp - minQ(s, a~pi), Q params frozen -----
+        pi_a, pi_logp = m.sample_action(params, batch["obs"], k_pi)
+        q_sg = {"q1": jax.tree.map(lax.stop_gradient, params["q1"]),
+                "q2": jax.tree.map(lax.stop_gradient, params["q2"])}
+        pq1, pq2 = m.q_values(q_sg, batch["obs"], pi_a)
+        actor_loss = jnp.mean(
+            lax.stop_gradient(alpha) * pi_logp - jnp.minimum(pq1, pq2))
+
+        # ---- alpha: match target entropy ----------------------------
+        alpha_loss = -jnp.mean(
+            params["log_alpha"]
+            * lax.stop_gradient(pi_logp + self.target_entropy))
+
+        loss = critic_loss + actor_loss + alpha_loss
+        stats = {
+            "critic_loss": critic_loss, "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss, "alpha": alpha,
+            "mean_q": jnp.mean(jnp.minimum(q1, q2)),
+            "entropy": -jnp.mean(pi_logp),
+            # new priorities: mean abs TD over the twin critics
+            # (reference sac_torch_policy td_error output)
+            "td_error": 0.5 * (jnp.abs(q1 - target)
+                               + jnp.abs(q2 - target)),
+        }
+        if "batch_indexes" in batch:
+            stats["td_indexes"] = batch["batch_indexes"]
+        return loss, stats
+
+    def additional_update(self, *, polyak: bool = True,
+                          **kw) -> Dict[str, Any]:
+        """Polyak target update; also absorbs the base loop's periodic
+        update_target=True (a hard sync would fight tau-averaging)."""
+        if polyak:
+            with self._state_lock:
+                self._target = self._polyak(self._target, self._params)
+        return {}
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        state = super().get_state()
+        with self._state_lock:
+            state["target"] = jax.device_get(self._target)
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        import jax
+        import jax.numpy as jnp
+        with self._state_lock:
+            if getattr(self, "_distributed", False):
+                self._target = jax.tree.map(self._replicate_host,
+                                            state["target"])
+            else:
+                self._target = jax.tree.map(jnp.asarray, state["target"])
+
+
+class SAC(DQN):
+    """Runs DQN's shared replay loop with SAC hooks: no epsilon push
+    (the stochastic policy explores), polyak target updates after every
+    gradient step instead of periodic hard syncs (reference SAC extends
+    DQN the same way, sac.py)."""
+
+    learner_cls = SACLearner
+
+    def default_module(self, observation_space, action_space):
+        if len(observation_space.shape) != 1 or \
+                not hasattr(action_space, "low"):
+            raise NotImplementedError(
+                f"SAC ships a squashed-gaussian MLP for 1-D obs and Box "
+                f"actions; got obs={observation_space} "
+                f"act={action_space}. Pass a custom module via "
+                f"config.rl_module(module=...).")
+        hiddens = self.config.model_hiddens
+        return SquashedGaussianModule(
+            observation_space.shape[0], action_space.shape[0],
+            action_space.low, action_space.high, hiddens)
+
+    def _before_sample(self, stats: Dict[str, Any]) -> None:
+        pass  # entropy-regularized policy needs no epsilon
+
+    def _training_intensity(self) -> float:
+        # natural value: one gradient step per sampled env step (the
+        # standard SAC cadence; reference sac.py training_intensity)
+        cfg = self.config
+        return (cfg.training_intensity
+                if cfg.training_intensity is not None
+                else float(cfg.train_batch_size))
+
+    def _after_each_update(self) -> None:
+        self.learner_group.additional_update(polyak=True)
+
+    def _maybe_update_target(self) -> None:
+        pass  # polyak per update replaces periodic hard syncs

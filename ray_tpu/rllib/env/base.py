@@ -35,6 +35,41 @@ def register_env(name: str,
     _ENV_REGISTRY[name] = creator
 
 
+class GymnasiumAdapter(Env):
+    """Wrap a gymnasium.Env into this protocol: keyword-only reset(seed=)
+    becomes positional, and gymnasium spaces are converted to the local
+    Box/Discrete so catalog isinstance dispatch works (reference RLlib
+    consumes gym envs natively; this build's spaces are a subset)."""
+
+    def __init__(self, gym_env):
+        self._env = gym_env
+        self.observation_space = self._convert(gym_env.observation_space)
+        self.action_space = self._convert(gym_env.action_space)
+
+    @staticmethod
+    def _convert(space):
+        import gymnasium
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        if isinstance(space, gymnasium.spaces.Discrete):
+            return Discrete(int(space.n))
+        if isinstance(space, gymnasium.spaces.Box):
+            return Box(space.low, space.high, dtype=space.dtype)
+        raise NotImplementedError(
+            f"unsupported gymnasium space {type(space).__name__}")
+
+    def reset(self, seed: Optional[int] = None):
+        return self._env.reset(seed=seed)
+
+    def step(self, action):
+        import numpy as np
+        a = np.asarray(action, self.action_space.dtype) \
+            if self.action_space.shape else action
+        return self._env.step(a)
+
+    def close(self) -> None:
+        self._env.close()
+
+
 def make_env(name: str, config: Optional[Dict[str, Any]] = None) -> Env:
     config = config or {}
     if name in _ENV_REGISTRY:
@@ -42,7 +77,7 @@ def make_env(name: str, config: Optional[Dict[str, Any]] = None) -> Env:
     # fall through to gymnasium when available
     try:
         import gymnasium
-        return gymnasium.make(name)
+        return GymnasiumAdapter(gymnasium.make(name))
     except ImportError:
         pass
     raise KeyError(
