@@ -30,6 +30,10 @@ class EnvRunnerSet:
         self.config = config
         self._local: Optional[SingleAgentEnvRunner] = None
         self._actors: List[Any] = []
+        self._writer = None
+        if config.output:
+            from ray_tpu.rllib.offline.json_io import JsonWriter
+            self._writer = JsonWriter(config.output)
         if config.num_env_runners == 0:
             self._local = SingleAgentEnvRunner(
                 config.env, module, config.env_config,
@@ -73,17 +77,24 @@ class EnvRunnerSet:
         """reference execution/rollout_ops.py:21
         synchronous_parallel_sample."""
         if self._local is not None:
-            return [self._local.sample(num_timesteps_per_runner)]
-        import ray_tpu
-        return ray_tpu.get(
-            [a.sample.remote(num_timesteps_per_runner)
-             for a in self._actors], timeout=600)
+            frags = [self._local.sample(num_timesteps_per_runner)]
+        else:
+            import ray_tpu
+            frags = ray_tpu.get(
+                [a.sample.remote(num_timesteps_per_runner)
+                 for a in self._actors], timeout=600)
+        if self._writer is not None:
+            for f in frags:
+                self._writer.write(f)
+        return frags
 
     @property
     def actors(self) -> List[Any]:
         return self._actors
 
     def stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
         if self._local is not None:
             self._local.stop()
         import ray_tpu
@@ -168,6 +179,14 @@ class Algorithm:
                 self._episode_lens.append(m["episode_len"])
 
     # ---- checkpointing (Trainable contract: save/restore) -----------
+    def _extra_state(self) -> Dict[str, Any]:
+        """Algorithm-specific driver state to checkpoint (normalizers,
+        target-sync counters ...); subclasses extend."""
+        return {}
+
+    def _restore_extra_state(self, extra: Dict[str, Any]) -> None:
+        pass
+
     def save(self, checkpoint_dir: str) -> str:
         import os
         import pickle
@@ -176,6 +195,7 @@ class Algorithm:
             "learner": self.learner_group.get_state(),
             "iteration": self._iteration,
             "timesteps_total": self._timesteps_total,
+            "extra": self._extra_state(),
         }
         with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
                   "wb") as f:
@@ -191,6 +211,7 @@ class Algorithm:
         self.learner_group.set_state(state["learner"])
         self._iteration = state["iteration"]
         self._timesteps_total = state["timesteps_total"]
+        self._restore_extra_state(state.get("extra", {}))
         self.env_runners.sync_weights(self.learner_group.get_weights())
 
     def stop(self) -> None:

@@ -38,6 +38,9 @@ class AlgorithmConfig:
         # module
         self.model_hiddens = (64, 64)
         self._custom_module = None
+        # offline data (reference .offline_data(input_=..., output=...))
+        self.input_: Optional[str] = None
+        self.output: Optional[str] = None
         # misc
         self.seed: int = 0
         self.metrics_num_episodes_for_smoothing: int = 100
@@ -83,6 +86,16 @@ class AlgorithmConfig:
             self._custom_module = module
         if model_hiddens is not None:
             self.model_hiddens = tuple(model_hiddens)
+        return self
+
+    def offline_data(self, input_: Optional[str] = None,
+                     output: Optional[str] = None) -> "AlgorithmConfig":
+        """input_: dir of JsonWriter shards to train from (BC/MARWIL);
+        output: dir to write sampled fragments to (any algorithm)."""
+        if input_ is not None:
+            self.input_ = input_
+        if output is not None:
+            self.output = output
         return self
 
     def debugging(self, seed: Optional[int] = None) -> "AlgorithmConfig":

@@ -303,6 +303,13 @@ class DQN(Algorithm):
             config.initial_epsilon)
         self._last_target_update = 0
 
+    def _extra_state(self) -> Dict[str, Any]:
+        return {"last_target_update": self._last_target_update}
+
+    def _restore_extra_state(self, extra: Dict[str, Any]) -> None:
+        self._last_target_update = extra.get(
+            "last_target_update", self._last_target_update)
+
     # ---- hooks (SAC overrides; reference SAC extends DQN too) -------
     def _before_sample(self, stats: Dict[str, Any]) -> None:
         """Push exploration state to runners (epsilon-greedy here)."""

@@ -1,0 +1,5 @@
+from ray_tpu.rllib.algorithms.marwil.marwil import (BC, MARWIL, BCConfig,
+                                                    MARWILConfig,
+                                                    MARWILLearner)
+
+__all__ = ["MARWIL", "MARWILConfig", "BC", "BCConfig", "MARWILLearner"]

@@ -1,0 +1,167 @@
+"""MARWIL (advantage-weighted imitation) + BC (behavior cloning).
+
+reference parity: rllib/algorithms/marwil/marwil.py (MARWILConfig — beta
+exponential advantage weighting, vf_coeff, moving-average advantage
+normalizer; training_step reads offline JSON input) and
+rllib/algorithms/bc/bc.py (BC = MARWIL with beta=0, pure -logp
+imitation). Offline fragments are postprocessed with the same GAE used
+online, then the weighted-imitation update runs as one jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.offline.json_io import JsonReader
+from ray_tpu.rllib.utils.postprocessing import postprocess_fragment
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or MARWIL)
+        self.lr = 1e-4
+        self.beta = 1.0                  # 0 => plain behavior cloning
+        self.train_batch_size = 2000
+        self.minibatch_size = 128
+        self.num_epochs = 1
+        self.moving_average_sqd_adv_norm_update_rate = 1e-2
+        # periodic online evaluation with the learned policy
+        self.evaluation_interval: Optional[int] = 10
+        self.evaluation_duration = 400   # timesteps per eval round
+
+
+class MARWILLearner(Learner):
+    """exp(beta * normalized advantage)-weighted -logp + value loss
+    (reference marwil_torch_policy.py marwil_loss)."""
+
+    def compute_loss(self, params, batch, extra):
+        import jax.numpy as jnp
+
+        out = self.module.forward_train(params, batch)
+        dist = self.module.action_dist(out["action_dist_inputs"])
+        logp = dist.logp(batch["actions"])
+        cfg = self.config
+
+        if cfg.beta > 0.0:
+            # advantages arrive pre-normalized by the driver's moving
+            # average of sqd advantages (reference keeps the same
+            # normalizer in the policy)
+            weights = jnp.minimum(
+                jnp.exp(cfg.beta * batch["advantages"]), 20.0)
+            vf = out["vf_preds"]
+            vf_loss = jnp.mean((vf - batch["value_targets"]) ** 2)
+        else:
+            weights = jnp.ones_like(logp)
+            vf_loss = jnp.asarray(0.0, jnp.float32)
+
+        entropy = dist.entropy()
+        policy_loss = -jnp.mean(weights * logp)
+        loss = (policy_loss + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * jnp.mean(entropy))
+        return loss, {
+            "policy_loss": policy_loss, "vf_loss": vf_loss,
+            "entropy": jnp.mean(entropy),
+            "mean_imitation_weight": jnp.mean(weights),
+        }
+
+
+class MARWIL(Algorithm):
+    learner_cls = MARWILLearner
+
+    def __init__(self, config: "MARWILConfig"):
+        if not config.input_:
+            raise ValueError(
+                "MARWIL/BC are offline algorithms: point "
+                "config.offline_data(input_=...) at a JsonWriter dir")
+        super().__init__(config)
+        self._reader = JsonReader(config.input_, seed=config.seed)
+        self._sqd_adv_norm = 1.0  # moving average of adv^2
+
+    def _extra_state(self) -> Dict[str, Any]:
+        return {"sqd_adv_norm": self._sqd_adv_norm}
+
+    def _restore_extra_state(self, extra: Dict[str, Any]) -> None:
+        self._sqd_adv_norm = extra.get("sqd_adv_norm",
+                                       self._sqd_adv_norm)
+
+    def _value_fn(self):
+        """Jitted V(s) with the CURRENT policy weights (reference MARWIL
+        recomputes advantages against the training value function each
+        pass, not the recorded behavior values)."""
+        if not hasattr(self, "_vf_jit"):
+            import jax
+            self._vf_jit = jax.jit(
+                lambda p, obs: self.module.forward_train(
+                    p, {"obs": obs})["vf_preds"])
+        return self._vf_jit
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        # --- assemble a train batch from offline fragments -----------
+        weights = (self.learner_group.get_weights()
+                   if cfg.beta > 0.0 else None)
+        parts, rows = [], 0
+        while rows < cfg.train_batch_size:
+            frag = self._reader.next()
+            if weights is not None:
+                vf = self._value_fn()
+                t, n = frag["rewards"].shape[:2]
+                flat_obs = frag["obs"].reshape(
+                    (t * n, *frag["obs"].shape[2:]))
+                frag = dict(frag)
+                frag["vf_preds"] = np.asarray(
+                    vf(weights, flat_obs)).reshape(t, n)
+                frag["bootstrap_value"] = np.asarray(
+                    vf(weights, frag["last_obs"]))
+            p = postprocess_fragment(frag, cfg.gamma, cfg.lambda_)
+            parts.append(p)
+            rows += len(p["obs"])
+        batch = {k: np.concatenate([p[k] for p in parts])
+                 for k in parts[0]}
+        self._timesteps_total += rows
+
+        if cfg.beta > 0.0:
+            # normalize by the moving average of squared advantages
+            # (reference marwil keeps the same normalizer in-policy,
+            # update_averaged_estimate in marwil_torch_policy.py)
+            raw_sqd = float(np.mean(batch["advantages"] ** 2))
+            batch["advantages"] = (
+                batch["advantages"]
+                / max(np.sqrt(self._sqd_adv_norm), 1e-4))
+            rate = cfg.moving_average_sqd_adv_norm_update_rate
+            self._sqd_adv_norm = (1 - rate) * self._sqd_adv_norm \
+                + rate * raw_sqd
+
+        stats = self.learner_group.update(
+            batch, minibatch_size=cfg.minibatch_size,
+            num_iters=cfg.num_epochs, seed=cfg.seed + self._iteration)
+        stats["sqd_adv_norm"] = self._sqd_adv_norm
+
+        # --- periodic online evaluation ------------------------------
+        if cfg.evaluation_interval and \
+                self._iteration % cfg.evaluation_interval == 0:
+            self.env_runners.sync_weights(
+                self.learner_group.get_weights())
+            frags = self.env_runners.sample_sync(
+                cfg.evaluation_duration // max(1, len(self.env_runners)))
+            self._record_episode_metrics(frags)
+
+        return {"learner": stats, "num_offline_steps_trained": rows}
+
+
+class BCConfig(MARWILConfig):
+    """reference bc.py: BCConfig = MARWILConfig with beta forced to 0."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or BC)
+        self.beta = 0.0
+        self.vf_loss_coeff = 0.0
+
+
+class BC(MARWIL):
+    pass

@@ -15,6 +15,9 @@ def _registry():
     from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
     from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
+    from ray_tpu.rllib.algorithms.marwil.marwil import (BC, MARWIL,
+                                                        BCConfig,
+                                                        MARWILConfig)
     from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
     from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig
     return {
@@ -23,6 +26,8 @@ def _registry():
         "APPO": (APPO, APPOConfig),
         "DQN": (DQN, DQNConfig),
         "SAC": (SAC, SACConfig),
+        "MARWIL": (MARWIL, MARWILConfig),
+        "BC": (BC, BCConfig),
     }
 
 

@@ -1,0 +1,112 @@
+"""Offline sample IO: JSONL fragment files.
+
+reference parity: rllib/offline/json_writer.py (JsonWriter — sampled
+batches to .json shards, rolling over at max_file_size) and
+json_reader.py (JsonReader — reads shards, cycling forever for
+training). Batches here are rollout *fragments* (the [T, N, ...] column
+dicts EnvRunner.sample returns) so offline postprocessing (GAE for
+MARWIL) can run exactly like the online path. Arrays encode as nested
+lists with an explicit dtype tag; nesting carries the shape.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _encode(value: Any) -> Any:
+    if isinstance(value, np.ndarray):
+        return {"__nd__": value.tolist(), "dtype": str(value.dtype)}
+    if isinstance(value, (np.integer, np.floating, np.bool_)):
+        return value.item()
+    return value
+
+
+def _decode(value: Any) -> Any:
+    if isinstance(value, dict) and "__nd__" in value:
+        return np.asarray(value["__nd__"],
+                          dtype=np.dtype(value["dtype"]))
+    return value
+
+
+class JsonWriter:
+    """Append rollout fragments to JSONL shards under `path`."""
+
+    def __init__(self, path: str,
+                 max_file_size: int = 64 * 1024 * 1024):
+        self.path = path
+        self.max_file_size = max_file_size
+        os.makedirs(path, exist_ok=True)
+        self._shard = 0
+        self._file = None
+
+    def _current(self):
+        if self._file is None or self._file.tell() > self.max_file_size:
+            if self._file is not None:
+                self._file.close()
+                self._shard += 1
+            name = os.path.join(self.path,
+                                f"output-{self._shard:05d}.jsonl")
+            self._file = open(name, "a", encoding="utf-8")
+        return self._file
+
+    def write(self, fragment: Dict[str, Any]) -> None:
+        row = {k: _encode(v) for k, v in fragment.items()
+               if k != "episode_metrics"}
+        f = self._current()
+        f.write(json.dumps(row) + "\n")
+        f.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader:
+    """Cycle through JSONL shards, yielding decoded fragments."""
+
+    def __init__(self, path: str, shuffle: bool = True,
+                 seed: Optional[int] = None):
+        if os.path.isdir(path):
+            pattern = os.path.join(path, "*.jsonl")
+        else:
+            pattern = path
+        self.files: List[str] = sorted(_glob.glob(pattern))
+        if not self.files:
+            raise FileNotFoundError(f"no offline data at {pattern!r}")
+        # decode once up front: training cycles these fragments forever,
+        # and the numpy arrays are smaller than the JSON text
+        self._fragments: List[Dict[str, Any]] = []
+        for fn in self.files:
+            with open(fn, encoding="utf-8") as f:
+                for line in f:
+                    if line.strip():
+                        row = json.loads(line)
+                        self._fragments.append(
+                            {k: _decode(v) for k, v in row.items()})
+        if not self._fragments:
+            raise ValueError(f"offline data at {pattern!r} is empty")
+        self._order = np.arange(len(self._fragments))
+        self._rng = np.random.default_rng(seed)
+        self.shuffle = shuffle
+        if shuffle:
+            self._rng.shuffle(self._order)
+        self._pos = 0
+
+    def __len__(self) -> int:
+        return len(self._fragments)
+
+    def next(self) -> Dict[str, Any]:
+        if self._pos >= len(self._order):
+            self._pos = 0
+            if self.shuffle:
+                self._rng.shuffle(self._order)
+        frag = self._fragments[self._order[self._pos]]
+        self._pos += 1
+        return dict(frag)

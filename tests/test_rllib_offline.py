@@ -1,0 +1,145 @@
+"""Offline RL: JSONL sample IO, output config, BC and MARWIL.
+
+reference parity: rllib/offline/json_writer.py + json_reader.py
+(fragment shards), algorithms/bc + algorithms/marwil (offline training
+from JSON input; CI learning tests train BC/MARWIL on recorded
+CartPole data).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env.base import make_env
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.offline.json_io import JsonReader, JsonWriter
+
+
+class TestJsonIO:
+    def test_roundtrip_preserves_dtype_shape(self, tmp_path):
+        w = JsonWriter(str(tmp_path / "data"))
+        frag = {
+            "obs": np.random.randn(4, 2, 3).astype(np.float32),
+            "actions": np.array([[1, 0], [0, 1], [1, 1], [0, 0]],
+                                np.int64),
+            "rewards": np.ones((4, 2), np.float32),
+            "worker_index": 3,
+        }
+        w.write(frag)
+        w.write(frag)
+        w.close()
+        r = JsonReader(str(tmp_path / "data"), shuffle=False)
+        assert len(r) == 2
+        got = r.next()
+        assert got["obs"].dtype == np.float32
+        assert got["obs"].shape == (4, 2, 3)
+        np.testing.assert_allclose(got["obs"], frag["obs"], rtol=1e-6)
+        assert got["actions"].dtype == np.int64
+        assert got["worker_index"] == 3
+        # cycles forever
+        for _ in range(3):
+            r.next()
+
+    def test_reader_raises_on_missing_data(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            JsonReader(str(tmp_path / "nope"))
+
+
+def _record_expert_data(path: str, timesteps: int = 4000) -> float:
+    """Roll a hand-coded CartPole balancer and write fragments."""
+    from ray_tpu.rllib.core.catalog import DiscreteMLPModule
+
+    class _Expert(DiscreteMLPModule):
+        """Heuristic: push toward the falling side (solves CartPole
+        ~always); logits derived so logp/entropy are well-defined."""
+
+        def forward_train(self, params, batch):
+            import jax.numpy as jnp
+            obs = batch["obs"]
+            score = obs[..., 2] + 0.5 * obs[..., 3]  # angle + ang-vel
+            logits = jnp.stack([-8.0 * score, 8.0 * score], axis=-1)
+            return {"action_dist_inputs": logits,
+                    "vf_preds": jnp.zeros(obs.shape[:-1], jnp.float32)}
+
+    module = _Expert(4, 2)
+    runner = SingleAgentEnvRunner("CartPole-v1", module, num_envs=4,
+                                  seed=0, gamma=0.99)
+    import jax
+    runner.set_weights(module.init_params(jax.random.PRNGKey(0)))
+    writer = JsonWriter(path)
+    returns = []
+    done = 0
+    while done < timesteps:
+        frag = runner.sample(200)
+        writer.write(frag)
+        done += frag["rewards"].size
+        returns += [m["episode_return"]
+                    for m in frag["episode_metrics"]]
+    writer.close()
+    runner.stop()
+    return float(np.mean(returns)) if returns else 0.0
+
+
+class TestBCMarwil:
+    def test_bc_learns_cartpole_from_expert_data(self, tmp_path):
+        from ray_tpu.rllib.algorithms.marwil.marwil import BCConfig
+        data = str(tmp_path / "expert")
+        expert_return = _record_expert_data(data)
+        assert expert_return > 150, f"expert too weak: {expert_return}"
+        algo = (BCConfig()
+                .environment("CartPole-v1")
+                .offline_data(input_=data)
+                .training(lr=5e-3, train_batch_size=2000,
+                          minibatch_size=256, num_epochs=2)
+                .debugging(seed=0)
+                .build())
+        best = 0.0
+        for i in range(30):
+            algo.train()
+            # eval metrics appear on evaluation_interval boundaries
+            res = algo.train()
+            erm = res["episode_reward_mean"]
+            if erm == erm:
+                best = max(best, erm)
+            if best >= 120.0:
+                break
+        algo.stop()
+        assert best >= 120.0, f"BC failed to imitate: {best}"
+
+    def test_marwil_trains_and_weights_advantages(self, tmp_path):
+        from ray_tpu.rllib.algorithms.marwil.marwil import MARWILConfig
+        data = str(tmp_path / "expert")
+        _record_expert_data(data, timesteps=2000)
+        algo = (MARWILConfig()
+                .environment("CartPole-v1")
+                .offline_data(input_=data)
+                .training(lr=1e-3, beta=1.0, train_batch_size=1000,
+                          minibatch_size=128)
+                .debugging(seed=0)
+                .build())
+        for _ in range(3):
+            res = algo.train()
+        st = res["learner"]
+        assert np.isfinite(st["policy_loss"])
+        assert st["mean_imitation_weight"] > 0.0
+        assert res["num_offline_steps_trained"] >= 1000
+        # the moving advantage normalizer moved off its init
+        assert st["sqd_adv_norm"] != 1.0
+        algo.stop()
+
+    def test_output_config_records_fragments(self, tmp_path):
+        from ray_tpu.rllib.algorithms.ppo.ppo import PPOConfig
+        out = str(tmp_path / "out")
+        algo = (PPOConfig()
+                .environment("CartPole-v1")
+                .env_runners(rollout_fragment_length=32)
+                .training(train_batch_size=64, minibatch_size=32,
+                          num_epochs=1)
+                .offline_data(output=out)
+                .debugging(seed=0)
+                .build())
+        algo.train()
+        algo.stop()
+        r = JsonReader(out, shuffle=False)
+        frag = r.next()
+        assert "obs" in frag and "action_logp" in frag
+        assert frag["obs"].ndim >= 2  # [T, N, ...]
