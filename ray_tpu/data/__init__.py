@@ -7,9 +7,13 @@ pull-based streaming execution with backpressure, per-worker train shards.
 from ray_tpu.data.block import Block  # noqa: F401
 from ray_tpu.data.dataset import (Dataset, MaterializedDataset,  # noqa: F401
                                   from_blocks, from_items, from_numpy, range)
+from ray_tpu.data.grouped import GroupedData  # noqa: F401
+from ray_tpu.data.io import (from_pandas, read_csv,  # noqa: F401
+                             read_json, read_parquet)
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 
 __all__ = [
     "Block", "Dataset", "MaterializedDataset", "DataIterator",
-    "from_items", "from_numpy", "from_blocks", "range",
+    "GroupedData", "from_items", "from_numpy", "from_blocks",
+    "from_pandas", "range", "read_csv", "read_json", "read_parquet",
 ]

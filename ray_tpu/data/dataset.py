@@ -115,6 +115,79 @@ class Dataset:
             ]
         return MaterializedDataset(out_refs)
 
+    def sort(self, key: str, *, descending: bool = False
+             ) -> "MaterializedDataset":
+        """Distributed sort as a two-phase exchange (reference
+        Dataset.sort — sort_sample_keys + map/reduce tasks in
+        _internal/planner/exchange/sort_task_spec.py): a map task per
+        block range-partitions it by sampled cut points (each block
+        crosses the store once, not once per partition), then a reduce
+        task per partition merges + locally sorts its pieces."""
+        mat = self.materialize()
+        n = max(1, len(mat._refs))
+        sample_remote = ray_tpu.remote(_sample_keys)
+        got = [s for s in ray_tpu.get(
+            [sample_remote.remote(r, key) for r in mat._refs])
+            if s.size]
+        if not got:
+            return mat
+        samples = np.sort(np.concatenate(got))
+        # index-based cut points (works for every comparable dtype,
+        # incl. strings, unlike interpolated quantiles)
+        cuts = [samples[min(len(samples) - 1,
+                            (j * len(samples)) // n)]
+                for j in builtins.range(1, n)]
+        bounds = np.asarray(cuts)
+        split_remote = ray_tpu.remote(_split_by_range) \
+            .options(num_returns=n)
+        pieces = [split_remote.remote(r, key, bounds, n)
+                  for r in mat._refs]
+        if n == 1:
+            pieces = [[p] for p in pieces]
+        merge_remote = ray_tpu.remote(_merge_sorted)
+        refs = [merge_remote.remote([pc[p] for pc in pieces], key,
+                                    descending)
+                for p in builtins.range(n)]
+        if descending:
+            refs = refs[::-1]
+        return MaterializedDataset(refs)
+
+    def groupby(self, key: str):
+        """reference Dataset.groupby -> GroupedData."""
+        from ray_tpu.data.grouped import GroupedData
+        return GroupedData(self, key)
+
+    def zip(self, other: "Dataset") -> "MaterializedDataset":
+        """Column-wise zip of equal-length datasets (reference
+        Dataset.zip); the other side is re-sliced to this side's block
+        boundaries."""
+        left = self.materialize()
+        right = other.materialize()
+        count_remote = ray_tpu.remote(_count_rows)
+        lcounts = ray_tpu.get([count_remote.remote(r)
+                               for r in left._refs])
+        rcounts = ray_tpu.get([count_remote.remote(r)
+                               for r in right._refs])
+        if sum(lcounts) != sum(rcounts):
+            raise ValueError(
+                f"zip needs equal row counts: {sum(lcounts)} vs "
+                f"{sum(rcounts)}")
+        zip_remote = ray_tpu.remote(_zip_partition)
+        refs = []
+        lo = 0
+        for ref, cnt in zip(left._refs, lcounts):
+            refs.append(zip_remote.remote(ref, right._refs, rcounts,
+                                          lo, lo + cnt))
+            lo += cnt
+        return MaterializedDataset(refs)
+
+    def union(self, *others: "Dataset") -> "MaterializedDataset":
+        """Row concat (reference Dataset.union)."""
+        refs = list(self.materialize()._refs)
+        for o in others:
+            refs.extend(o.materialize()._refs)
+        return MaterializedDataset(refs)
+
     # -- consumption --------------------------------------------------
 
     def materialize(self, *, max_in_flight_blocks: int = 4
@@ -141,6 +214,25 @@ class Dataset:
         it = DataIterator(blocks=self.iter_blocks(
             max_in_flight_blocks=max_in_flight_blocks))
         yield from it.iter_batches(batch_size=batch_size, drop_last=drop_last)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           device: Optional[str] = None):
+        """Batches as dicts of torch tensors (reference
+        Dataset.iter_torch_batches)."""
+        import torch
+        for blk in self.iter_batches(batch_size=batch_size,
+                                     drop_last=drop_last):
+            out = {}
+            for k, v in blk.items():
+                arr = np.ascontiguousarray(v)
+                if not arr.flags.writeable:
+                    # store-backed blocks are read-only shm views;
+                    # torch requires writable memory
+                    arr = arr.copy()
+                t = torch.as_tensor(arr)
+                out[k] = t.to(device) if device else t
+            yield out
 
     def take(self, k: int = 20) -> List[Dict[str, Any]]:
         out = []
@@ -200,6 +292,66 @@ class MaterializedDataset(Dataset):
 
 def _count_rows(blk: Block) -> int:
     return block_mod.block_num_rows(blk)
+
+
+def _sample_keys(blk: Block, key: str, max_samples: int = 100
+                 ) -> np.ndarray:
+    if not block_mod.block_num_rows(blk):
+        return np.asarray([])
+    col = np.asarray(blk[key])
+    if len(col) <= max_samples:
+        return col
+    idx = np.random.default_rng(0).choice(len(col), max_samples,
+                                          replace=False)
+    return col[idx]
+
+
+def _split_by_range(blk: Block, key: str, bounds: np.ndarray, n: int):
+    """Map phase: one piece per output partition. NaN keys fall through
+    searchsorted to the last partition (never silently dropped)."""
+    if not block_mod.block_num_rows(blk):
+        return tuple({} for _ in builtins.range(n))
+    part_ids = np.searchsorted(bounds, np.asarray(blk[key]),
+                               side="right")
+    return tuple(
+        block_mod.take_rows(blk, np.nonzero(part_ids == p)[0])
+        for p in builtins.range(n))
+
+
+def _merge_sorted(refs: List[Any], key: str, descending: bool) -> Block:
+    """Reduce phase: merge this partition's pieces and sort locally."""
+    pieces = [b for b in ray_tpu.get(list(refs))
+              if block_mod.block_num_rows(b)]
+    merged = block_mod.concat_blocks(pieces)
+    if not block_mod.block_num_rows(merged):
+        return merged
+    order = np.argsort(merged[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    return block_mod.take_rows(merged, order)
+
+
+def _zip_partition(left_blk: Block, right_refs: List[Any],
+                   rcounts: List[int], lo: int, hi: int) -> Block:
+    """Zip the left block with the right side's global rows [lo,hi)."""
+    pieces = []
+    pos = 0
+    for ref, cnt in zip(right_refs, rcounts):
+        s, e = max(lo, pos), min(hi, pos + cnt)
+        if e > s:
+            blk = ray_tpu.get(ref)
+            pieces.append(block_mod.slice_block(blk, s - pos, e - pos))
+        pos += cnt
+    right = block_mod.concat_blocks(pieces)
+    out = dict(left_blk)
+    for k, v in right.items():
+        name = k
+        suffix = 1
+        while name in out:  # probe a free suffix, never clobber
+            name = f"{k}_{suffix}"
+            suffix += 1
+        out[name] = v
+    return out
 
 
 def _build_partition_contig(refs: List[Any], counts: List[int],

@@ -1,0 +1,185 @@
+"""Data depth: sort, groupby/agg, zip/union, file IO, torch batches.
+
+reference parity: python/ray/data/tests/test_sort.py,
+test_groupby.py (per-key aggregations), test_zip.py, IO tests
+(test_csv.py/test_json.py/test_parquet.py), test_iterator.py
+(iter_torch_batches).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestSort:
+    def test_sort_global_order(self):
+        rng = np.random.default_rng(0)
+        vals = rng.permutation(500).astype(np.int64)
+        ds = rdata.from_numpy({"x": vals, "y": vals * 2},
+                              parallelism=4)
+        out = ds.sort("x")
+        got = np.concatenate([b["x"] for b in out.iter_blocks()
+                              if b])
+        np.testing.assert_array_equal(got, np.arange(500))
+        # companion column rides along
+        got_y = np.concatenate([b["y"] for b in out.iter_blocks()
+                                if b])
+        np.testing.assert_array_equal(got_y, np.arange(500) * 2)
+
+    def test_sort_strings(self):
+        ds = rdata.from_numpy(
+            {"name": np.array(["banana", "apple", "date", "cherry"])},
+            parallelism=2)
+        got = np.concatenate(
+            [b["name"] for b in ds.sort("name").iter_blocks() if b])
+        assert list(got) == ["apple", "banana", "cherry", "date"]
+
+    def test_sort_keeps_nan_rows(self):
+        vals = np.array([3.0, np.nan, 1.0, 2.0, np.nan, 0.0])
+        ds = rdata.from_numpy({"x": vals}, parallelism=3)
+        got = np.concatenate(
+            [b["x"] for b in ds.sort("x").iter_blocks() if b])
+        assert len(got) == 6  # NaNs never dropped
+        np.testing.assert_array_equal(got[:4], [0.0, 1.0, 2.0, 3.0])
+        assert np.isnan(got[4:]).all()
+
+    def test_sort_with_empty_blocks(self):
+        ds = rdata.range(3).repartition(8)  # 5 empty blocks
+        got = np.concatenate(
+            [b["id"] for b in ds.sort("id").iter_blocks() if b])
+        np.testing.assert_array_equal(got, [0, 1, 2])
+
+    def test_sort_descending(self):
+        ds = rdata.from_numpy(
+            {"x": np.array([3, 1, 2, 5, 4])}, parallelism=2)
+        got = np.concatenate(
+            [b["x"] for b in ds.sort("x", descending=True).iter_blocks()
+             if b])
+        np.testing.assert_array_equal(got, [5, 4, 3, 2, 1])
+
+
+class TestGroupBy:
+    def _ds(self):
+        return rdata.from_numpy({
+            "k": np.array([0, 1, 0, 2, 1, 0]),
+            "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])},
+            parallelism=3)
+
+    def test_sum_mean_count(self):
+        df = self._ds().groupby("k").agg(
+            {"v": ["sum", "mean"]}).to_pandas().sort_values("k")
+        np.testing.assert_array_equal(df["k"], [0, 1, 2])
+        np.testing.assert_allclose(df["sum(v)"], [10.0, 7.0, 4.0])
+        np.testing.assert_allclose(df["mean(v)"], [10 / 3, 3.5, 4.0])
+        cnt = self._ds().groupby("k").count().to_pandas() \
+            .sort_values("k")
+        np.testing.assert_array_equal(cnt["count()"], [3, 2, 1])
+
+    def test_min_max_std(self):
+        df = self._ds().groupby("k").max("v").to_pandas() \
+            .sort_values("k")
+        np.testing.assert_allclose(df["max(v)"], [6.0, 5.0, 4.0])
+
+    def test_groupby_with_multidim_feature_column(self):
+        # extra [N, d] columns must not break 1-d aggregations
+        ds = rdata.from_numpy({
+            "k": np.array([0, 1, 0, 1]),
+            "v": np.array([1.0, 2.0, 3.0, 4.0]),
+            "obs": np.random.randn(4, 5).astype(np.float32)},
+            parallelism=2)
+        df = ds.groupby("k").sum("v").to_pandas().sort_values("k")
+        np.testing.assert_allclose(df["sum(v)"], [4.0, 6.0])
+
+    def test_groupby_after_repartition_with_empty_blocks(self):
+        ds = rdata.from_numpy(
+            {"k": np.array([0, 1, 0]),
+             "v": np.array([1.0, 2.0, 3.0])}).repartition(6)
+        df = ds.groupby("k").sum("v").to_pandas().sort_values("k")
+        np.testing.assert_allclose(df["sum(v)"], [4.0, 2.0])
+
+    def test_map_groups(self):
+        out = self._ds().groupby("k").map_groups(
+            lambda blk: {"k": blk["k"][:1],
+                         "spread": np.asarray(
+                             [blk["v"].max() - blk["v"].min()])})
+        df = out.to_pandas().sort_values("k")
+        np.testing.assert_allclose(df["spread"], [5.0, 3.0, 0.0])
+
+
+class TestZipUnion:
+    def test_zip(self):
+        a = rdata.from_numpy({"x": np.arange(10)}, parallelism=3)
+        b = rdata.from_numpy({"y": np.arange(10) * 10}, parallelism=2)
+        df = a.zip(b).to_pandas()
+        np.testing.assert_array_equal(df["y"], df["x"] * 10)
+
+    def test_zip_name_collision(self):
+        a = rdata.from_numpy({"x": np.arange(4)}, parallelism=1)
+        b = rdata.from_numpy({"x": np.arange(4) + 100}, parallelism=1)
+        df = a.zip(b).to_pandas()
+        np.testing.assert_array_equal(df["x_1"], df["x"] + 100)
+
+    def test_zip_collision_never_clobbers(self):
+        a = rdata.from_numpy({"x": np.arange(4),
+                              "x_1": np.arange(4) + 50}, parallelism=1)
+        b = rdata.from_numpy({"x": np.arange(4) + 100}, parallelism=1)
+        df = a.zip(b).to_pandas()
+        np.testing.assert_array_equal(df["x_1"], np.arange(4) + 50)
+        np.testing.assert_array_equal(df["x_2"], np.arange(4) + 100)
+
+    def test_zip_length_mismatch(self):
+        a = rdata.from_numpy({"x": np.arange(4)})
+        b = rdata.from_numpy({"y": np.arange(5)})
+        with pytest.raises(ValueError, match="equal row counts"):
+            a.zip(b)
+
+    def test_union(self):
+        a = rdata.range(5)
+        b = rdata.range(3)
+        assert a.union(b).count() == 8
+
+
+class TestFileIO:
+    def _ds(self):
+        return rdata.from_numpy({
+            "a": np.arange(20), "b": np.arange(20) * 0.5},
+            parallelism=3)
+
+    @pytest.mark.parametrize("fmt", ["csv", "json", "parquet"])
+    def test_write_read_roundtrip(self, tmp_path, fmt):
+        path = str(tmp_path / fmt)
+        ds = self._ds()
+        files = getattr(ds, f"write_{fmt}")(path)
+        assert len(files) == 3
+        back = getattr(rdata, f"read_{fmt}")(path)
+        df = back.to_pandas().sort_values("a").reset_index(drop=True)
+        np.testing.assert_array_equal(df["a"], np.arange(20))
+        np.testing.assert_allclose(df["b"], np.arange(20) * 0.5)
+
+    def test_pandas_roundtrip(self):
+        import pandas as pd
+        df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+        ds = rdata.from_pandas(df)
+        out = ds.to_pandas()
+        np.testing.assert_array_equal(out["x"], [1, 2, 3])
+        assert list(out["y"]) == ["a", "b", "c"]
+
+
+class TestTorchBatches:
+    def test_iter_torch_batches(self):
+        import torch
+        ds = rdata.from_numpy({"x": np.arange(10, dtype=np.float32)},
+                              parallelism=2)
+        batches = list(ds.iter_torch_batches(batch_size=4))
+        assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+        total = torch.cat([b["x"] for b in batches])
+        assert total.shape == (10,)
