@@ -100,6 +100,10 @@ class GroupedData:
         count/sum/min/max/mean/std -> columns named 'agg(column)'."""
         norm: Dict[str, List[str]] = {}
         for col, fns in spec.items():
+            if col == self._key:
+                raise ValueError(
+                    f"cannot aggregate the grouping key {col!r}; "
+                    f"use count() for group sizes")
             fns = [fns] if isinstance(fns, str) else list(fns)
             for fn in fns:
                 if fn not in _AGG_FUNCS:

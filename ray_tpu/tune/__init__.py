@@ -5,7 +5,9 @@ Trainable step/save/restore contract, grid+random search, ASHA scheduler,
 per-trial failure retry from checkpoint.
 """
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler  # noqa: F401
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,  # noqa: F401
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.search import (choice, grid_search, loguniform,  # noqa: F401
                                  randint, uniform)
 from ray_tpu.tune.trainable import (FunctionTrainable, Trainable,  # noqa: F401
@@ -17,5 +19,6 @@ __all__ = [
     "Tuner", "TuneConfig", "TuneRunConfig", "ResultGrid", "TrialResult",
     "Trainable", "FunctionTrainable", "wrap_function", "report",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "ASHAScheduler", "FIFOScheduler",
+    "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining",
 ]

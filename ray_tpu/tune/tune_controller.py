@@ -29,10 +29,24 @@ class _TrialRunner:
 
     def __init__(self, factory: Callable[[Dict[str, Any]], Any],
                  config: Dict[str, Any]):
+        self._factory = factory
         self._t = factory(config)
 
     def ping(self) -> str:
         return "pong"
+
+    def reset(self, config: Dict[str, Any],
+              checkpoint_dir: Optional[str] = None) -> None:
+        """In-place trainable swap (reference reuse_actors /
+        Trainable.reset): rebuild with a new config, optionally
+        restoring a checkpoint — no actor churn, no scheduling race."""
+        try:
+            self._t.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        self._t = self._factory(config)
+        if checkpoint_dir:
+            self._t.restore(checkpoint_dir)
 
     def train(self) -> Dict[str, Any]:
         return self._t.train()
@@ -76,7 +90,8 @@ class TuneController:
                  max_concurrent_trials: int = 4,
                  max_failures_per_trial: int = 1,
                  checkpoint_frequency: int = 0,
-                 resources_per_trial: Optional[Dict[str, float]] = None):
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 resume_state: Optional[Dict[str, Any]] = None):
         self._factory = factory
         self._stop = dict(stop or {})
         self._scheduler = scheduler or FIFOScheduler()
@@ -92,6 +107,66 @@ class TuneController:
         ]
         for t in self.trials:
             os.makedirs(t.trial_dir, exist_ok=True)
+        if resume_state:
+            self._apply_resume_state(resume_state)
+        # PBT-style schedulers track every trial's config for exploit
+        if hasattr(self._scheduler, "on_trial_add"):
+            for t in self.trials:
+                self._scheduler.on_trial_add(t.trial_id, t.config)
+
+    # -- experiment state (Tuner.restore; reference
+    # tune/execution/experiment_state.py) ----------------------------------
+
+    def _apply_resume_state(self, state: Dict[str, Any]) -> None:
+        """Rehydrate trials: finished ones keep their results; errored /
+        interrupted ones go back to PENDING and resume from their latest
+        checkpoint when one exists."""
+        by_id = {t["trial_id"]: t for t in state.get("trials", [])}
+        for t in self.trials:
+            saved = by_id.get(t.trial_id)
+            if not saved:
+                continue
+            t.config = saved["config"]
+            t.results = list(saved["results"])
+            t.last_result = dict(saved["last_result"])
+            t.checkpoint_dir = saved["checkpoint_dir"]
+            t.num_restores = saved.get("num_restores", 0)
+            t.state = (TERMINATED if saved["state"] == TERMINATED
+                       else PENDING)
+            if t.state == PENDING:
+                # the rerun replays iterations after the checkpoint
+                # (or from scratch): drop recorded results past that
+                # point so training_iteration stays unique in results
+                ckpt_iter = 0
+                if t.checkpoint_dir:
+                    tail = os.path.basename(t.checkpoint_dir)
+                    if tail.startswith("checkpoint_"):
+                        try:
+                            ckpt_iter = int(tail.split("_")[-1])
+                        except ValueError:
+                            ckpt_iter = 0
+                t.results = [
+                    r for r in t.results
+                    if r.get("training_iteration", 0) <= ckpt_iter]
+                t.last_result = dict(t.results[-1]) if t.results else {}
+
+    def experiment_state(self) -> Dict[str, Any]:
+        return {"trials": [
+            {"trial_id": t.trial_id, "config": t.config,
+             "state": t.state, "results": t.results,
+             "last_result": t.last_result,
+             "checkpoint_dir": t.checkpoint_dir,
+             "num_restores": t.num_restores,
+             "error": repr(t.error) if t.error else None}
+            for t in self.trials]}
+
+    def _save_experiment_state(self) -> None:
+        import pickle
+        tmp = os.path.join(self.run_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(self.experiment_state(), f)
+        os.replace(tmp,
+                   os.path.join(self.run_dir, "experiment_state.pkl"))
 
     # -- actor lifecycle ---------------------------------------------------
 
@@ -124,6 +199,9 @@ class TuneController:
         trial.actor = None
         trial.in_flight = None
         trial.state = state
+        if state in (TERMINATED, ERROR) and \
+                hasattr(self._scheduler, "on_trial_remove"):
+            self._scheduler.on_trial_remove(trial.trial_id)
 
     def _next_ckpt_dir(self, trial: Trial) -> str:
         return os.path.join(trial.trial_dir,
@@ -149,7 +227,8 @@ class TuneController:
             pending = [t for t in self.trials if t.state == PENDING]
             for t in pending[:max(0, self._max_concurrent - len(running))]:
                 try:
-                    self._start_trial(t)
+                    # resumed trials restart from their checkpoint
+                    self._start_trial(t, restore=bool(t.checkpoint_dir))
                 except Exception as e:  # noqa: BLE001
                     t.error = e
                     t.state = ERROR
@@ -158,9 +237,17 @@ class TuneController:
                 break
             refs = [t.in_flight for t in running]
             ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=5.0)
+            if ready:
+                # drain everything already finished, not just the first
+                # listed trial — handling one ref per pass starves later
+                # trials whenever an earlier one always has results ready
+                # (schedulers then never see the starved trials' scores)
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=0)
             for ref in ready:
                 trial = next(t for t in running if t.in_flight == ref)
-                self._handle_ready(trial, ref)
+                if trial.state == RUNNING and trial.in_flight == ref:
+                    self._handle_ready(trial, ref)
         # Time budget expired: don't leak live actors (they'd keep holding
         # resources and training forever).
         for t in self.trials:
@@ -168,6 +255,7 @@ class TuneController:
                 t.error = TimeoutError(
                     "tune run hit its time budget with this trial running")
                 self._stop_trial(t, ERROR, save_final=False)
+        self._save_experiment_state()
         return self.trials
 
     def _handle_ready(self, trial: Trial, ref: Any) -> None:
@@ -179,6 +267,9 @@ class TuneController:
         result.setdefault("trial_id", trial.trial_id)
         trial.results.append(result)
         trial.last_result = result
+        # persist after every result: restore-after-hard-kill must see
+        # progress, not just the state at the last trial stop
+        self._save_experiment_state()
         if self._ckpt_freq and trial.iteration % self._ckpt_freq == 0:
             try:
                 trial.checkpoint_dir = ray_tpu.get(
@@ -189,15 +280,59 @@ class TuneController:
                                trial.trial_id, exc_info=True)
         if self._should_stop(result):
             self._stop_trial(trial, TERMINATED)
+            self._save_experiment_state()
             return
         decision = self._scheduler.on_result(trial.trial_id, result)
         if decision == STOP:
             logger.info("scheduler stopped %s at iter %d",
                         trial.trial_id, trial.iteration)
             self._stop_trial(trial, TERMINATED)
+            self._save_experiment_state()
+            return
+        if isinstance(decision, dict) and \
+                decision.get("action") == "exploit":
+            self._exploit(trial, decision)
+            self._save_experiment_state()
             return
         assert decision == CONTINUE
         trial.in_flight = trial.actor.train.remote()
+
+    def _exploit(self, trial: Trial, decision: Dict[str, Any]) -> None:
+        """PBT exploit: snapshot the source trial, then restart this
+        trial from that checkpoint with the explored config (reference
+        pbt.py _exploit + tune_controller trial restore path)."""
+        src = next(t for t in self.trials
+                   if t.trial_id == decision["source"])
+        try:
+            if src.state == RUNNING and src.actor is not None:
+                # actor calls are ordered: save runs after the source's
+                # in-flight train() completes
+                src.checkpoint_dir = ray_tpu.get(
+                    src.actor.save.remote(self._next_ckpt_dir(src)),
+                    timeout=300)
+        except Exception:  # noqa: BLE001
+            logger.warning("PBT source snapshot failed for %s",
+                           src.trial_id, exc_info=True)
+        if not src.checkpoint_dir:
+            # no checkpoint to exploit — keep training as-is
+            trial.in_flight = trial.actor.train.remote()
+            return
+        logger.info("PBT: %s exploits %s (new config %s)",
+                    trial.trial_id, src.trial_id, decision["config"])
+        trial.config = dict(decision["config"])
+        trial.checkpoint_dir = src.checkpoint_dir
+        try:
+            # in-place reset on the same actor (reference reuse_actors)
+            ray_tpu.get(trial.actor.reset.remote(
+                trial.config, trial.checkpoint_dir), timeout=300)
+            trial.num_restores += 1
+            if hasattr(self._scheduler, "confirm_exploit"):
+                self._scheduler.confirm_exploit(trial.trial_id,
+                                                trial.config)
+            trial.in_flight = trial.actor.train.remote()
+        except Exception as e:  # noqa: BLE001
+            trial.error = e
+            self._stop_trial(trial, ERROR, save_final=False)
 
     def _handle_trial_failure(self, trial: Trial,
                               error: BaseException) -> None:

@@ -146,22 +146,68 @@ class Tuner:
         self._param_space = dict(param_space or {})
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or TuneRunConfig()
+        self._resume_state: Optional[Dict[str, Any]] = None
+        self._resume_dir: Optional[str] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any) -> "Tuner":
+        """Resume an interrupted/failed experiment from its run dir
+        (reference Tuner.restore, tuner.py). Finished trials keep their
+        results; unfinished or errored trials rerun, restoring from
+        their latest checkpoint when one exists. The original
+        tune/run configs reload from the run dir."""
+        import pickle
+        state_file = os.path.join(path, "experiment_state.pkl")
+        with open(state_file, "rb") as f:
+            state = pickle.load(f)
+        tune_config = run_config = None
+        meta_file = os.path.join(path, "tuner_config.pkl")
+        if os.path.exists(meta_file):
+            with open(meta_file, "rb") as f:
+                meta = pickle.load(f)
+            tune_config = meta.get("tune_config")
+            run_config = meta.get("run_config")
+        else:
+            import logging
+            logging.getLogger(__name__).warning(
+                "no tuner_config.pkl under %s (original configs were "
+                "unpicklable?) — resuming with DEFAULT TuneConfig/"
+                "TuneRunConfig: no scheduler, no stop conditions", path)
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config)
+        tuner._resume_state = state
+        tuner._resume_dir = path
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc, rc = self._tune_config, self._run_config
-        name = rc.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
-        run_dir = os.path.join(rc.storage_path, name)
-        os.makedirs(run_dir, exist_ok=True)
-        variants = list(BasicVariantGenerator(
-            self._param_space, num_samples=tc.num_samples,
-            seed=tc.search_seed).variants())
+        if self._resume_dir:
+            run_dir = self._resume_dir
+            variants = [t["config"]
+                        for t in self._resume_state["trials"]]
+        else:
+            name = rc.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
+            run_dir = os.path.join(rc.storage_path, name)
+            os.makedirs(run_dir, exist_ok=True)
+            variants = list(BasicVariantGenerator(
+                self._param_space, num_samples=tc.num_samples,
+                seed=tc.search_seed).variants())
+            import pickle
+            try:
+                with open(os.path.join(run_dir, "tuner_config.pkl"),
+                          "wb") as f:
+                    pickle.dump({"tune_config": tc, "run_config": rc,
+                                 "param_space": self._param_space}, f)
+            except Exception:  # noqa: BLE001 — unpicklable scheduler etc.
+                pass
         controller = TuneController(
             _make_factory(self._trainable), variants,
             run_dir=run_dir, stop=rc.stop, scheduler=tc.scheduler,
             max_concurrent_trials=tc.max_concurrent_trials,
             max_failures_per_trial=rc.max_failures_per_trial,
             checkpoint_frequency=rc.checkpoint_frequency,
-            resources_per_trial=rc.resources_per_trial)
+            resources_per_trial=rc.resources_per_trial,
+            resume_state=self._resume_state)
         trials = controller.run()
         results = [
             TrialResult(
