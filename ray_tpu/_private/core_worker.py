@@ -12,6 +12,7 @@ per-caller seq reordering buffer in _ActorExecutor.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import os
 import pickle
@@ -1333,6 +1334,22 @@ class _Executor:
             t.start()
             self._threads.append(t)
 
+    def _ensure_aio_loop(self):
+        """Lazily start the actor's asyncio loop thread."""
+        import asyncio
+        loop = getattr(self, "_aio_loop", None)
+        if loop is not None:
+            return loop
+        with self._lock:
+            loop = getattr(self, "_aio_loop", None)
+            if loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(target=loop.run_forever,
+                                     daemon=True, name="actor-aio-loop")
+                t.start()
+                self._aio_loop = loop
+        return self._aio_loop
+
     def push_task(self, spec: TaskSpec, lease_id: Optional[str] = None) -> str:
         if spec.task_type == TaskType.ACTOR_TASK:
             owner = spec.owner_worker_id.hex()
@@ -1430,6 +1447,15 @@ class _Executor:
                                      spec.actor_method_name)
                     args, kwargs = self._resolve_args(spec)
                     out = method(*args, **kwargs)
+                    if inspect.iscoroutine(out):
+                        # async actor (reference fiber.h / asyncio
+                        # actors): coroutines run on one shared event
+                        # loop so awaits interleave; up to
+                        # max_concurrency calls (exec threads) can be
+                        # in flight at once
+                        import asyncio
+                        out = asyncio.run_coroutine_threadsafe(
+                            out, self._ensure_aio_loop()).result()
                     values = self._split_returns(out, spec.num_returns)
                 elif spec.dynamic_returns:
                     # generator task (reference dynamic returns): store

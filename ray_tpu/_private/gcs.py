@@ -204,6 +204,7 @@ class GcsServer:
             "list_events": self.list_events,
             # pubsub (reference InternalPubSubGcsService)
             "subscribe": self.subscribe,
+            "publish": self.publish,
             "ping": lambda: "pong",
         }, host=host, port=port)
         self.address = self.server.address

@@ -1,0 +1,90 @@
+"""LogMonitor: tail worker log files, publish lines to the driver.
+
+reference parity: python/ray/_private/log_monitor.py:103 — a per-node
+process tails the session log dir and publishes new lines through GCS
+pubsub; drivers print them with a (worker, node) prefix
+(worker.py:1823 print_to_stdstream). Here it's a daemon thread inside
+each node manager publishing to the "worker_logs" channel.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class LogMonitor:
+    def __init__(self, log_dir: str, gcs_address: Tuple[str, int],
+                 node_id_hex: str, poll_interval: float = 0.25):
+        self.log_dir = log_dir
+        self.node_id_hex = node_id_hex
+        self.poll_interval = poll_interval
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        from ray_tpu._private.rpc import RpcClient
+        self._gcs = RpcClient(gcs_address, timeout=30)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="log-monitor")
+        self._thread.start()
+
+    def _scan_once(self) -> None:
+        if not os.path.isdir(self.log_dir):
+            return
+        for name in sorted(os.listdir(self.log_dir)):
+            if not name.endswith(".log"):
+                continue
+            path = os.path.join(self.log_dir, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(name, 0)
+            if size <= offset:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read(size - offset)
+            except OSError:
+                continue
+            # only publish complete lines; keep the partial tail for
+            # the next scan
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[name] = offset + last_nl + 1
+            lines = chunk[:last_nl].decode(
+                "utf-8", errors="replace").splitlines()
+            if not lines:
+                continue
+            worker = name[:-len(".log")]
+            try:
+                self._gcs.call("publish", channel="worker_logs",
+                               message={"node_id": self.node_id_hex,
+                                        "worker": worker,
+                                        "lines": lines})
+            except Exception:  # noqa: BLE001
+                logger.debug("log publish failed", exc_info=True)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self._scan_once()
+            except Exception:  # noqa: BLE001
+                logger.debug("log monitor scan failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        # the poll thread shares _offsets and the GCS client: join it
+        # before the final drain so nothing races or double-publishes
+        self._thread.join(timeout=5)
+        # final drain so lines written just before shutdown still flow
+        try:
+            self._scan_once()
+        except Exception:  # noqa: BLE001
+            pass
+        self._gcs.close()

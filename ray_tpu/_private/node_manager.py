@@ -167,6 +167,12 @@ class NodeManager:
             self._kill_worker_for_memory,
             threshold=Config.memory_usage_threshold,
             period_s=Config.memory_monitor_refresh_ms / 1000.0)
+        # tail worker logs -> GCS "worker_logs" channel -> drivers
+        # (reference _private/log_monitor.py)
+        from ray_tpu._private.log_monitor import LogMonitor
+        self.log_monitor = LogMonitor(
+            os.path.join(self.session_dir, "logs"), self.gcs_address,
+            self.node_id.hex())
 
     # ---- resource sync ---------------------------------------------------
 
@@ -816,6 +822,10 @@ class NodeManager:
         self._dead = True
         try:
             self.memory_monitor.stop()
+        except AttributeError:
+            pass
+        try:
+            self.log_monitor.stop()
         except AttributeError:
             pass
         with self._lock:

@@ -101,12 +101,26 @@ class HeadNode:
         shutil.rmtree(self.session_dir, ignore_errors=True)
 
 
+def _print_worker_logs(msg) -> None:
+    """reference worker.py:1823 print_to_stdstream — driver-side sink
+    for the worker_logs pubsub channel. stderr, so drivers that emit
+    machine-readable stdout (bench JSON) stay parseable."""
+    import sys
+    try:
+        prefix = f"({msg['worker']}, node={msg['node_id'][:8]})"
+        for line in msg["lines"]:
+            print(f"{prefix} {line}", file=sys.stderr)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def init(address: Optional[str] = None, *,
          resources: Optional[Dict[str, float]] = None,
          num_cpus: Optional[float] = None,
          object_store_memory: Optional[int] = None,
          namespace: str = "",
          ignore_reinit_error: bool = False,
+         log_to_driver: bool = True,
          _session_root: Optional[str] = None) -> Worker:
     """Connect this process as a driver; bootstrap a head if no address."""
     global _global_worker
@@ -161,6 +175,11 @@ def init(address: Optional[str] = None, *,
     cw = CoreWorker(mode="driver", job_id=job_id, gcs_address=gcs_address,
                     node_manager_address=nm_address,
                     store_address=store_address, node_id_hex=node_id_hex)
+    if log_to_driver:
+        try:
+            cw.subscribe("worker_logs", _print_worker_logs)
+        except Exception:  # noqa: BLE001
+            pass
     _global_worker = Worker(core_worker=cw, mode="driver",
                             gcs_address=gcs_address,
                             node_manager_address=nm_address, node=node,

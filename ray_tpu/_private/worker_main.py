@@ -15,6 +15,14 @@ import threading
 
 
 def main() -> None:
+    # stdout/stderr land in the per-worker log file (a pipe, so python
+    # would block-buffer): line-buffer so the log monitor can tail
+    # prints as they happen
+    try:
+        sys.stdout.reconfigure(line_buffering=True)
+        sys.stderr.reconfigure(line_buffering=True)
+    except Exception:  # noqa: BLE001
+        pass
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s worker %(name)s: %(message)s")

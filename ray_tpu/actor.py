@@ -121,6 +121,18 @@ class ActorClass:
         from ray_tpu.dag import ClassNode
         return ClassNode(self, args, kwargs)
 
+    def _default_concurrency(self) -> int:
+        """Async actors (any `async def` method) default to concurrent
+        execution so await-a-later-call patterns work out of the box
+        (reference: asyncio actors default max_concurrency=1000;
+        capped lower here because each in-flight call holds an exec
+        thread while its coroutine runs on the shared loop)."""
+        import inspect
+        for m in vars(self._cls).values():
+            if inspect.iscoroutinefunction(m):
+                return 100
+        return 1
+
     def remote(self, *args: Any, **kwargs: Any) -> ActorHandle:
         ctx = worker_mod.client_context()
         if ctx is not None:
@@ -166,7 +178,8 @@ class ActorClass:
             owner_address=cw.address, owner_worker_id=cw.worker_id,
             actor_id=actor_id, max_restarts=max_restarts,
             max_task_retries=int(opts.get("max_task_retries", 0)),
-            max_concurrency=int(opts.get("max_concurrency", 1)),
+            max_concurrency=int(opts.get("max_concurrency",
+                                         self._default_concurrency())),
             scheduling_strategy=strategy, placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
             runtime_env=opts.get("runtime_env"),

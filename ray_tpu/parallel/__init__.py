@@ -29,6 +29,13 @@ from ray_tpu.parallel.sharding import (  # noqa: F401
     shard_pytree,
     with_logical_constraint,
 )
+from ray_tpu.parallel.multislice import (  # noqa: F401
+    AXIS_DCN,
+    MultiSliceConfig,
+    dcn_batch_spec,
+    make_multislice_mesh,
+    validate_multislice_sharding,
+)
 from ray_tpu.parallel.ring import ring_attention  # noqa: F401
 from ray_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
 
@@ -48,4 +55,9 @@ __all__ = [
     "with_logical_constraint",
     "ring_attention",
     "ulysses_attention",
+    "AXIS_DCN",
+    "MultiSliceConfig",
+    "make_multislice_mesh",
+    "dcn_batch_spec",
+    "validate_multislice_sharding",
 ]

@@ -102,10 +102,23 @@ def make_mesh(config: Optional[MeshConfig] = None,
     sizes = config.resolve(len(devices))
     shape = tuple(sizes[a] for a in MESH_AXIS_ORDER)
 
+    mesh_devices = arrange_devices(
+        shape, devices,
+        allow_split_physical_axes=allow_split_physical_axes)
+    return jax.sharding.Mesh(mesh_devices, MESH_AXIS_ORDER)
+
+
+def arrange_devices(shape: Tuple[int, ...], devices: Sequence, *,
+                    allow_split_physical_axes: bool = True):
+    """Arrange devices into `shape`: ICI-aware on TPU via
+    mesh_utils.create_device_mesh, plain reshape elsewhere. Shared by
+    single-slice and per-slice (multislice) mesh construction."""
+    import numpy as np
+
     if devices and getattr(devices[0], "platform", "cpu") == "tpu":
         try:
             from jax.experimental import mesh_utils
-            mesh_devices = mesh_utils.create_device_mesh(
+            return mesh_utils.create_device_mesh(
                 shape, devices=list(devices),
                 allow_split_physical_axes=allow_split_physical_axes)
         except Exception as e:
@@ -114,10 +127,7 @@ def make_mesh(config: Optional[MeshConfig] = None,
                 "ICI-aware device mesh construction failed (%s); falling "
                 "back to flat device order — inner-axis collectives may "
                 "cross slow links", e)
-            mesh_devices = np.asarray(devices).reshape(shape)
-    else:
-        mesh_devices = np.asarray(devices).reshape(shape)
-    return jax.sharding.Mesh(mesh_devices, MESH_AXIS_ORDER)
+    return np.asarray(devices).reshape(shape)
 
 
 def get_abstract_mesh(config: MeshConfig, n_devices: int):

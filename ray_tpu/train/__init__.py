@@ -12,6 +12,8 @@ from ray_tpu.train.data_parallel_trainer import (DataParallelTrainer,  # noqa: F
                                                  Result)
 from ray_tpu.train.jax_backend import JaxConfig  # noqa: F401
 from ray_tpu.train.jax_trainer import JaxTrainer  # noqa: F401
+from ray_tpu.train.tensorflow_backend import TensorflowConfig  # noqa: F401
+from ray_tpu.train.tensorflow_trainer import TensorflowTrainer  # noqa: F401
 from ray_tpu.train.torch_trainer import TorchTrainer  # noqa: F401
 from ray_tpu.train.torch_backend import TorchConfig  # noqa: F401
 from ray_tpu.train.session import (TrainContext, get_checkpoint,  # noqa: F401
@@ -20,6 +22,7 @@ from ray_tpu.train.session import (TrainContext, get_checkpoint,  # noqa: F401
 __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
     "ScalingConfig", "DataParallelTrainer", "Result", "JaxConfig",
-    "JaxTrainer", "TorchTrainer", "TorchConfig", "TrainContext", "report", "get_checkpoint", "get_context",
-    "get_dataset_shard",
+    "JaxTrainer", "TorchTrainer", "TorchConfig", "TensorflowTrainer",
+    "TensorflowConfig", "TrainContext", "report", "get_checkpoint",
+    "get_context", "get_dataset_shard",
 ]

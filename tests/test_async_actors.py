@@ -1,0 +1,67 @@
+"""Async actors: async def methods interleave on an event loop.
+
+reference parity: async actors (core_worker fiber.h:92 / python asyncio
+actors) — `async def` methods of an actor with max_concurrency > 1 run
+concurrently on one event loop, so an awaiting call doesn't block later
+calls (tests/test_asyncio.py in the reference).
+"""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_start):
+    """All tests here run on the shared session cluster."""
+
+
+def test_async_methods_interleave():
+    @ray_tpu.remote
+    class SignalActor:
+        def __init__(self):
+            self._evt = None
+
+        async def setup(self):
+            import asyncio
+            self._evt = asyncio.Event()
+            return "ready"
+
+        async def waiter(self):
+            # blocks on the loop until wake() runs — only possible if a
+            # later call can execute while this one is awaiting
+            await self._evt.wait()
+            return "woken"
+
+        async def wake(self):
+            self._evt.set()
+            return "ok"
+
+    # NO explicit max_concurrency: async actors default concurrent
+    # (reference asyncio actors default max_concurrency=1000), so the
+    # awaiting waiter never deadlocks the wake call
+    a = SignalActor.remote()
+    assert ray_tpu.get(a.setup.remote(), timeout=120) == "ready"
+    waiter_ref = a.waiter.remote()
+    wake_ref = a.wake.remote()
+    assert ray_tpu.get(wake_ref, timeout=60) == "ok"
+    assert ray_tpu.get(waiter_ref, timeout=60) == "woken"
+    ray_tpu.kill(a)
+
+
+def test_async_method_result_and_errors():
+    @ray_tpu.remote
+    class A:
+        async def add(self, x, y):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x + y
+
+        async def boom(self):
+            raise ValueError("async kaboom")
+
+    a = A.remote()
+    assert ray_tpu.get(a.add.remote(2, 3), timeout=120) == 5
+    with pytest.raises(ray_tpu.exceptions.RayTaskError, match="kaboom"):
+        ray_tpu.get(a.boom.remote(), timeout=60)
+    ray_tpu.kill(a)

@@ -13,11 +13,9 @@ import ray_tpu
 from ray_tpu import data as rdata
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _cluster():
-    ray_tpu.init(num_cpus=2)
-    yield
-    ray_tpu.shutdown()
+@pytest.fixture(autouse=True)
+def _cluster(ray_start):
+    """All tests here run on the shared session cluster."""
 
 
 class TestSort:

@@ -1,0 +1,100 @@
+"""Multi-slice (ICI x DCN) mesh: construction, sharding, training math.
+
+reference parity: the reference spans nodes with NCCL process groups
+(train/torch/config.py); the TPU equivalent is a hybrid mesh whose
+outermost "dcn" axis carries only data-parallel traffic (SURVEY.md
+§5.8). Verified on the chip-free ladder: 8 CPU devices as 2 slices x 4.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import (MeshConfig, MultiSliceConfig,
+                              dcn_batch_spec, make_multislice_mesh,
+                              validate_multislice_sharding)
+
+
+class TestMeshConstruction:
+    def test_2x4_mesh_axes(self):
+        cfg = MultiSliceConfig(
+            num_slices=2, per_slice=MeshConfig(data=1, fsdp=2, tensor=2))
+        mesh = make_multislice_mesh(cfg)
+        assert mesh.axis_names[0] == "dcn"
+        assert mesh.shape["dcn"] == 2
+        assert mesh.shape["fsdp"] == 2
+        assert mesh.shape["tensor"] == 2
+        assert mesh.devices.size == 8
+
+    def test_uneven_slices_rejected(self):
+        with pytest.raises(ValueError, match="divisible"):
+            MultiSliceConfig(num_slices=3).resolve(8)
+
+    def test_slices_are_contiguous_partitions(self):
+        cfg = MultiSliceConfig(num_slices=2,
+                               per_slice=MeshConfig(data=-1))
+        mesh = make_multislice_mesh(cfg)
+        devs = mesh.devices  # [dcn=2, data=4, 1, 1, 1, 1, 1]
+        ids = np.vectorize(lambda d: d.id)(devs).reshape(2, 4)
+        # each slice holds a contiguous block of the flat device list
+        assert set(ids[0]) == {0, 1, 2, 3}
+        assert set(ids[1]) == {4, 5, 6, 7}
+
+
+class TestShardingValidation:
+    def test_model_axis_on_dcn_rejected(self):
+        with pytest.raises(ValueError, match="tensor"):
+            validate_multislice_sharding(P(("dcn", "tensor")))
+
+    def test_data_axis_on_dcn_ok(self):
+        validate_multislice_sharding(dcn_batch_spec())
+        validate_multislice_sharding(P(("dcn", "data"), None))
+        validate_multislice_sharding(P(None, "tensor"))
+
+
+class TestMultiSliceTraining:
+    def test_dcn_data_parallel_matches_single_device(self):
+        """A gradient step over the 2-slice mesh (batch sharded across
+        dcn+data, params replicated) must equal the unsharded step —
+        XLA inserts the cross-slice psum for the gradient reduction."""
+        cfg = MultiSliceConfig(
+            num_slices=2, per_slice=MeshConfig(data=2, tensor=2))
+        mesh = make_multislice_mesh(cfg)
+
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((8, 8)).astype(np.float32)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = rng.standard_normal((16, 8)).astype(np.float32)
+
+        def loss(w, x, y):
+            return jnp.mean((x @ w - y) ** 2)
+
+        grad = jax.grad(loss)
+        expected = grad(w, x, y)
+
+        rep = NamedSharding(mesh, P())
+        batch_sh = NamedSharding(mesh, dcn_batch_spec())
+        w_d = jax.device_put(w, rep)
+        x_d = jax.device_put(x, batch_sh)
+        y_d = jax.device_put(y, batch_sh)
+        got = jax.jit(grad, out_shardings=rep)(w_d, x_d, y_d)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_tensor_parallel_stays_in_slice(self):
+        """A tensor-sharded matmul over the hybrid mesh compiles and
+        matches dense while the tensor axis never crosses dcn."""
+        cfg = MultiSliceConfig(
+            num_slices=2, per_slice=MeshConfig(data=1, tensor=4))
+        mesh = make_multislice_mesh(cfg)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 16)).astype(np.float32)
+        w = rng.standard_normal((16, 32)).astype(np.float32)
+
+        x_d = jax.device_put(x, NamedSharding(mesh, P(("dcn",), None)))
+        w_d = jax.device_put(w, NamedSharding(mesh, P(None, "tensor")))
+        out = jax.jit(lambda a, b: a @ b)(x_d, w_d)
+        np.testing.assert_allclose(np.asarray(out), x @ w, rtol=2e-5)

@@ -1,0 +1,48 @@
+"""TensorflowTrainer: TF_CONFIG wiring across the worker group.
+
+reference parity: python/ray/train/tests/test_tensorflow_trainer.py and
+tensorflow/config.py (TF_CONFIG = cluster.worker addresses + task
+index per rank, the MultiWorkerMirroredStrategy contract).
+"""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import ScalingConfig, TensorflowTrainer
+from ray_tpu.train import report as train_report
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_start):
+    """All tests here run on the shared session cluster."""
+
+
+def test_tf_config_set_per_rank():
+    # defined inside the test so cloudpickle ships it by value
+    def _loop():
+        import os
+        tf_config = json.loads(os.environ["TF_CONFIG"])
+        workers = tf_config["cluster"]["worker"]
+        idx = tf_config["task"]["index"]
+        # tf itself must be importable and usable inside the worker
+        import tensorflow as tf
+        x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+        s = float(tf.reduce_sum(tf.matmul(x, x)))
+        train_report({"num_workers": len(workers), "index": idx,
+                      "addr": workers[idx], "matmul_sum": s,
+                      "task_type": tf_config["task"]["type"]})
+
+    trainer = TensorflowTrainer(
+        _loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}))
+    result = trainer.fit()
+    assert result.error is None
+    # the driver's Result carries rank-0 metrics
+    m = result.metrics
+    assert m["num_workers"] == 2
+    assert m["task_type"] == "worker"
+    assert m["matmul_sum"] == pytest.approx(54.0)
+    assert ":" in m["addr"]

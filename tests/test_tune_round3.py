@@ -17,11 +17,9 @@ from ray_tpu.tune import (MedianStoppingRule, PopulationBasedTraining,
 from ray_tpu.tune.schedulers import CONTINUE, STOP
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _cluster():
-    ray_tpu.init(num_cpus=2)
-    yield
-    ray_tpu.shutdown()
+@pytest.fixture(autouse=True)
+def _cluster(ray_start):
+    """All tests here run on the shared session cluster."""
 
 
 class TestMedianStoppingRule:
