@@ -4,13 +4,14 @@ reference: python/ray/rllib — Algorithm/Learner/RLModule/EnvRunner stack
 (SURVEY.md §2.3). Learners are JIT'd XLA programs; EnvRunners stay CPU
 actors streaming trajectories through the object store (BASELINE.json
 north star). Algorithms shipped: PPO, IMPALA, APPO, DQN, SAC, MARWIL,
-BC (the reference's 34-algo registry is tracked in SURVEY.md §8.3).
+BC, ES (the reference's 34-algo registry is tracked in SURVEY.md §8.3).
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.es.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil.marwil import (BC, MARWIL,  # noqa: F401
                                                     BCConfig, MARWILConfig)
 from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
@@ -33,7 +34,7 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
     "ImpalaConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
-    "get_algorithm_class",
+    "ES", "ESConfig", "get_algorithm_class",
     "registered_algorithms", "Learner", "LearnerGroup", "RLModule",
     "DiscreteMLPModule", "DiscreteConvModule", "Env", "register_env",
     "make_env", "SingleAgentEnvRunner",

@@ -92,7 +92,7 @@ class EnvRunnerSet:
     def actors(self) -> List[Any]:
         return self._actors
 
-    def stop(self) -> None:
+    def stop(self) -> None:  # EnvRunnerSet
         if self._writer is not None:
             self._writer.close()
         if self._local is not None:
@@ -111,6 +111,7 @@ class Algorithm:
     _run_one_training_iteration :3020)."""
 
     learner_cls = None  # set by subclass
+    needs_env_runners = True  # ES overrides: no rollout workers
 
     def __init__(self, config: AlgorithmConfig):
         self.config = config
@@ -126,8 +127,12 @@ class Algorithm:
         self.learner_group = LearnerGroup(
             lambda: self.learner_cls(self.module, self.config),
             num_learners=config.num_learners, seed=config.seed)
-        self.env_runners = EnvRunnerSet(config, self.module)
-        self.env_runners.sync_weights(self.learner_group.get_weights())
+        if self.needs_env_runners:
+            self.env_runners = EnvRunnerSet(config, self.module)
+            self.env_runners.sync_weights(
+                self.learner_group.get_weights())
+        else:  # derivative-free algos (ES) evaluate their own way
+            self.env_runners = None
 
         self._iteration = 0
         self._timesteps_total = 0
@@ -212,8 +217,11 @@ class Algorithm:
         self._iteration = state["iteration"]
         self._timesteps_total = state["timesteps_total"]
         self._restore_extra_state(state.get("extra", {}))
-        self.env_runners.sync_weights(self.learner_group.get_weights())
+        if self.env_runners is not None:
+            self.env_runners.sync_weights(
+                self.learner_group.get_weights())
 
     def stop(self) -> None:
-        self.env_runners.stop()
+        if self.env_runners is not None:
+            self.env_runners.stop()
         self.learner_group.shutdown()

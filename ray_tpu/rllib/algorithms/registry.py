@@ -15,6 +15,7 @@ def _registry():
     from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
     from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
+    from ray_tpu.rllib.algorithms.es.es import ES, ESConfig
     from ray_tpu.rllib.algorithms.marwil.marwil import (BC, MARWIL,
                                                         BCConfig,
                                                         MARWILConfig)
@@ -28,6 +29,7 @@ def _registry():
         "SAC": (SAC, SACConfig),
         "MARWIL": (MARWIL, MARWILConfig),
         "BC": (BC, BCConfig),
+        "ES": (ES, ESConfig),
     }
 
 

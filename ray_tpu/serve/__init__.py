@@ -11,8 +11,9 @@ replica actors with in-flight accounting, a threaded HTTP proxy actor.
 from ray_tpu.serve.api import (Application, Deployment,  # noqa: F401
                                DeploymentHandle, delete, deployment,
                                get_handle, run, shutdown, start_http)
+from ray_tpu.serve.batching import batch  # noqa: F401
 
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
-    "run", "get_handle", "delete", "shutdown", "start_http",
+    "run", "get_handle", "delete", "shutdown", "start_http", "batch",
 ]

@@ -164,3 +164,31 @@ def test_deployment_composition(serve_session):
     ranker_handle = serve.run(Ranker.bind(emb_handle))
     out = ray_tpu.get(ranker_handle.remote(["aa", "bbbb", "c"]))
     assert out == [4, 2, 1]
+
+
+def test_batch_coalesces_concurrent_requests(serve_session):
+    """@serve.batch (reference serve/batching.py): concurrent calls to
+    a replica fuse into one list-in/list-out invocation."""
+
+    @serve.deployment(max_concurrent_queries=16)
+    class BatchedModel:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def predict(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 2 for x in items]
+
+        def __call__(self, x):
+            if x == "__sizes__":
+                return list(self.batch_sizes)
+            return self.predict(x)
+
+    handle = serve.run(BatchedModel.bind())
+    refs = [handle.remote(i) for i in range(8)]
+    results = ray_tpu.get(refs, timeout=120)
+    assert sorted(results) == [0, 2, 4, 6, 8, 10, 12, 14]
+    sizes = ray_tpu.get(handle.remote("__sizes__"), timeout=60)
+    assert sum(sizes) == 8
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
