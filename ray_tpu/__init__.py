@@ -15,7 +15,8 @@ lazily on first use.
 
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
-from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from ray_tpu.actor import (ActorClass, ActorHandle, get_actor,  # noqa: F401
+                           method)
 from ray_tpu.api import (available_resources, cancel, cluster_resources,  # noqa: F401
                          free, get, get_gcs_address, get_runtime_context,
                          init, is_initialized, kill, nodes, put, remote,
@@ -25,7 +26,8 @@ from ray_tpu.remote_function import RemoteFunction  # noqa: F401
 __version__ = "0.1.0"
 
 __all__ = [
-    "ObjectRef", "ActorClass", "ActorHandle", "get_actor", "remote", "init",
+    "ObjectRef", "ActorClass", "ActorHandle", "get_actor", "method",
+    "remote", "init",
     "shutdown", "is_initialized", "get", "put", "wait", "kill", "cancel",
     "free", "nodes", "cluster_resources", "available_resources",
     "get_gcs_address", "get_runtime_context", "exceptions", "RemoteFunction",

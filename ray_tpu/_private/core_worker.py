@@ -943,7 +943,8 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           function_key: str, args_blob: bytes,
                           arg_refs: List[ObjectID],
-                          num_returns: int) -> List[ObjectRef]:
+                          num_returns: int,
+                          concurrency_group: str = "") -> List[ObjectRef]:
         spec = TaskSpec(
             task_id=TaskID.of(self.job_id), job_id=self.job_id,
             task_type=TaskType.ACTOR_TASK, function_key=function_key,
@@ -951,7 +952,8 @@ class CoreWorker:
             arg_object_refs=arg_refs, num_returns=num_returns,
             resources={}, owner_address=self.address,
             owner_worker_id=self.worker_id, actor_id=actor_id,
-            actor_method_name=method_name)
+            actor_method_name=method_name,
+            concurrency_group=concurrency_group)
         # before the spec becomes reachable by other threads: a queued
         # spec can be popped+pickled by an in-flight _resolve_actor the
         # moment the lock below releases
@@ -1323,6 +1325,8 @@ class _Executor:
         self._buffer: Dict[str, Dict[int, TaskSpec]] = {}
         self._cancelled: set = set()
         self._threads: List[threading.Thread] = []
+        # named concurrency groups: group -> dedicated task queue
+        self._group_queues: Dict[str, "queue.Queue"] = {}
         # per-function execution counts for max_calls worker recycling
         self._calls_by_fn: Dict[str, int] = {}
         self._spawn_exec_threads(1)
@@ -1360,22 +1364,46 @@ class _Executor:
                 while nxt in buf:
                     s = buf.pop(nxt)
                     s._lease_id = None  # type: ignore[attr-defined]
-                    self._queue.put(s)
+                    # route by concurrency group: releases stay in
+                    # per-owner order, but a saturated group never
+                    # blocks calls destined for other groups
+                    # (reference concurrency_group_manager.h)
+                    self._group_queues.get(
+                        getattr(s, "concurrency_group", "") or "",
+                        self._queue).put(s)
                     nxt += 1
                 self._next_seq[owner] = nxt
         else:
             spec._lease_id = lease_id  # type: ignore[attr-defined]
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 self._spawn_exec_threads(max(1, spec.max_concurrency))
+                for group, width in (spec.concurrency_groups
+                                     or {}).items():
+                    self._ensure_group(group, width)
             self._queue.put(spec)
         return "ok"
+
+    def _ensure_group(self, group: str, width: int) -> None:
+        """Dedicated queue + thread pool per named concurrency group."""
+        with self._lock:
+            if group in self._group_queues:
+                return
+            q: "queue.Queue" = queue.Queue()
+            self._group_queues[group] = q
+        for i in range(max(1, width)):
+            t = threading.Thread(target=self._exec_loop, args=(q,),
+                                 daemon=True,
+                                 name=f"exec-{group}-{i}")
+            t.start()
+            self._threads.append(t)
 
     def cancel_task(self, task_id_hex: str) -> None:
         self._cancelled.add(task_id_hex)
 
-    def _exec_loop(self) -> None:
+    def _exec_loop(self, q: Optional["queue.Queue"] = None) -> None:
+        q = q if q is not None else self._queue
         while True:
-            spec = self._queue.get()
+            spec = q.get()
             if spec is None:
                 return
             try:

@@ -129,6 +129,11 @@ class TaskSpec:
     max_restarts: int = 0
     max_task_retries: int = 0
     max_concurrency: int = 1
+    # named concurrency groups (reference concurrency_group_manager.h):
+    # creation carries {group: max_concurrency}; each actor call carries
+    # the group it executes in ("" = default pool)
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: str = ""
     # Normal-task fields
     max_retries: int = 0
     retry_exceptions: bool = False

@@ -29,20 +29,39 @@ _ACTOR_OPTIONS = {
 }
 
 
+def method(**options: Any):
+    """@ray_tpu.method decorator: per-method defaults on actor classes
+    (reference ray.method — num_returns, concurrency_group)."""
+    allowed = {"num_returns", "concurrency_group"}
+    bad = set(options) - allowed
+    if bad:
+        raise ValueError(f"invalid method options: {sorted(bad)}")
+
+    def deco(fn):
+        fn.__ray_tpu_method_options__ = options
+        return fn
+
+    return deco
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **kwargs: Any) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name,
-                           kwargs.get("num_returns", self._num_returns))
+        return ActorMethod(
+            self._handle, self._method_name,
+            kwargs.get("num_returns", self._num_returns),
+            kwargs.get("concurrency_group", self._concurrency_group))
 
     def remote(self, *args: Any, **kwargs: Any) -> Any:
         return self._handle._submit(self._method_name, args, kwargs,
-                                    self._num_returns)
+                                    self._num_returns,
+                                    self._concurrency_group)
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         raise TypeError(
@@ -52,11 +71,16 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str,
-                 method_names: List[str], fn_key: str):
+                 method_names: List[str], fn_key: str,
+                 method_options: Optional[Dict[str, Dict[str, Any]]]
+                 = None,
+                 concurrency_groups: Optional[List[str]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_names = list(method_names)
         self._fn_key = fn_key
+        self._method_options = dict(method_options or {})
+        self._concurrency_groups = list(concurrency_groups or [])
         w = worker_mod.global_worker_or_none()
         if w is not None:
             w.core_worker.attach_actor(actor_id)
@@ -71,15 +95,26 @@ class ActorHandle:
         if name not in self._method_names:
             raise AttributeError(
                 f"actor {self._class_name} has no method '{name}'")
-        return ActorMethod(self, name)
+        opts = self._method_options.get(name, {})
+        return ActorMethod(self, name,
+                           opts.get("num_returns", 1),
+                           opts.get("concurrency_group", ""))
 
     def _submit(self, method_name: str, args: tuple, kwargs: dict,
-                num_returns: int) -> Any:
+                num_returns: int, concurrency_group: str = "") -> Any:
+        if concurrency_group and \
+                concurrency_group not in self._concurrency_groups:
+            # reference raises too — a silent default-pool fallback
+            # would lose the isolation the caller asked for
+            raise ValueError(
+                f"actor {self._class_name} has no concurrency group "
+                f"{concurrency_group!r}; declared: "
+                f"{self._concurrency_groups}")
         w = worker_mod.global_worker()
         args_blob, arg_refs = pack_args(args, kwargs)
         refs = w.core_worker.submit_actor_task(
             self._actor_id, method_name, self._fn_key, args_blob, arg_refs,
-            num_returns)
+            num_returns, concurrency_group=concurrency_group)
         if num_returns == 1:
             return refs[0]
         return refs
@@ -89,7 +124,9 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._class_name,
-                              self._method_names, self._fn_key))
+                              self._method_names, self._fn_key,
+                              self._method_options,
+                              self._concurrency_groups))
 
 
 class ActorClass:
@@ -115,6 +152,39 @@ class ActorClass:
     def _method_names(self) -> List[str]:
         return [m for m in dir(self._cls)
                 if not m.startswith("_") and callable(getattr(self._cls, m))]
+
+    def _method_options(self) -> Dict[str, Dict[str, Any]]:
+        """@ray_tpu.method(...) tags per method name."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for m in self._method_names():
+            tags = getattr(getattr(self._cls, m),
+                           "__ray_tpu_method_options__", None)
+            if tags:
+                out[m] = dict(tags)
+        return out
+
+    def _concurrency_groups(self, method_opts: Dict[str, Dict[str, Any]]
+                            ) -> Optional[Dict[str, int]]:
+        groups = self._options.get("concurrency_groups")
+        if groups is not None and (
+                not isinstance(groups, dict)
+                or not all(isinstance(k, str) and k
+                           for k in groups)
+                or not all(isinstance(v, int) and v >= 1
+                           for v in groups.values())):
+            raise ValueError(
+                "concurrency_groups must be {non-empty group name: "
+                "max_concurrency >= 1}, got " + repr(groups))
+        # every method-tagged group must be declared
+        declared = set(groups or {})
+        for m, tags in method_opts.items():
+            g = tags.get("concurrency_group")
+            if g and g not in declared:
+                raise ValueError(
+                    f"method {m!r} uses undeclared concurrency group "
+                    f"{g!r}; declare it in "
+                    f"options(concurrency_groups={{...}})")
+        return dict(groups) if groups else None
 
     def bind(self, *args: Any, **kwargs: Any):
         """Lazy graph node (reference dag/class_node.py)."""
@@ -147,6 +217,9 @@ class ActorClass:
         opts = self._options
         name = opts.get("name") or ""
         namespace = opts.get("namespace") or w.namespace
+        method_opts = self._method_options()
+        groups = self._concurrency_groups(method_opts)
+        group_names = sorted(groups or {})
 
         if name and opts.get("get_if_exists"):
             info = cw._gcs.call("get_named_actor", name=name,
@@ -155,7 +228,8 @@ class ActorClass:
                 if self._fn_key is None:
                     self._fn_key = cw.export_function(self._cls)
                 return ActorHandle(info.actor_id, self._cls.__name__,
-                                   self._method_names(), self._fn_key)
+                                   self._method_names(), self._fn_key,
+                                   method_opts, group_names)
 
         if self._fn_key is None:
             self._fn_key = cw.export_function(self._cls)
@@ -180,6 +254,7 @@ class ActorClass:
             max_task_retries=int(opts.get("max_task_retries", 0)),
             max_concurrency=int(opts.get("max_concurrency",
                                          self._default_concurrency())),
+            concurrency_groups=groups,
             scheduling_strategy=strategy, placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
             runtime_env=opts.get("runtime_env"),
@@ -187,10 +262,12 @@ class ActorClass:
             detached=(lifetime == "detached"))
         import pickle
         cw._gcs.call("kv_put", key=f"__actor_spec_meta:{actor_id.hex()}",
-                     value=pickle.dumps((self._fn_key, self._method_names())))
+                     value=pickle.dumps((self._fn_key, self._method_names(),
+                                         method_opts, group_names)))
         cw.create_actor(spec, name=name, namespace=namespace)
         return ActorHandle(actor_id, self._cls.__name__,
-                           self._method_names(), self._fn_key)
+                           self._method_names(), self._fn_key,
+                           method_opts, group_names)
 
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:
@@ -203,16 +280,21 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
                                    namespace=namespace or w.namespace)
     if info is None or info.state == "DEAD":
         raise ValueError(f"no live actor named '{name}'")
-    fn_key, methods = _actor_class_meta(w, info.actor_id.hex())
-    return ActorHandle(info.actor_id, info.class_name, methods, fn_key)
+    fn_key, methods, method_opts, group_names = _actor_class_meta(
+        w, info.actor_id.hex())
+    return ActorHandle(info.actor_id, info.class_name, methods, fn_key,
+                       method_opts, group_names)
 
 
 def _actor_class_meta(w: Any, actor_id_hex: str):
-    """Fetch the actor's exported class key + method names via GCS."""
+    """Fetch the actor's exported class key + method metadata via GCS."""
     spec: TaskSpec = w.core_worker._gcs.call(
         "kv_get", key=f"__actor_spec_meta:{actor_id_hex}")
     if spec is None:
         raise ValueError(f"actor {actor_id_hex[:12]} metadata missing")
     import pickle
-    fn_key, methods = pickle.loads(spec)
-    return fn_key, methods
+    meta = pickle.loads(spec)
+    if len(meta) == 2:  # pre-concurrency-group metadata
+        fn_key, methods = meta
+        return fn_key, methods, {}, []
+    return meta

@@ -180,8 +180,11 @@ class ClientProxyServer:
         with self._lock:
             handle, _ = self._client_actors[client_id][actor_id_bin.hex()]
         args, kwargs = self._materialize_args(client_id, args_blob)
-        ref = getattr(handle, method_name).remote(*args, **kwargs)
-        return self._track(client_id, [ref])
+        out = getattr(handle, method_name).remote(*args, **kwargs)
+        # @method(num_returns=N) tags make .remote() return a LIST of
+        # refs; flatten so tracking and the client see each ref
+        refs = out if isinstance(out, list) else [out]
+        return self._track(client_id, refs)
 
     def get_named_actor(self, client_id: str, name: str,
                         namespace: str = "") -> bytes:

@@ -94,13 +94,16 @@ class _ClientActorMethod:
         self._handle = handle
         self._name = name
 
-    def remote(self, *args: Any, **kwargs: Any) -> ClientObjectRef:
+    def remote(self, *args: Any, **kwargs: Any):
         ctx = self._handle._ctx
         ref_bins = ctx._call(
             "cl_actor_call", actor_id_bin=self._handle._actor_id_bin,
             method_name=self._name,
             args_blob=cloudpickle.dumps((args, kwargs)))
-        return ClientObjectRef(ref_bins[0], ctx)
+        if len(ref_bins) == 1:
+            return ClientObjectRef(ref_bins[0], ctx)
+        # @method(num_returns=N): one client ref per return value
+        return [ClientObjectRef(b, ctx) for b in ref_bins]
 
 
 class ClientActorHandle:
