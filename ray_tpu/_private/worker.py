@@ -96,6 +96,12 @@ class HeadNode:
             object_store_capacity=object_store_memory)
 
     def shutdown(self) -> None:
+        # local-only usage report (reference usage_lib, zero egress);
+        # written NEXT TO the session dir so it survives the rmtree
+        from ray_tpu._private.usage import write_usage_report
+        write_usage_report(
+            os.path.dirname(self.session_dir),
+            f"usage_stats_{os.path.basename(self.session_dir)}.json")
         self.node_manager.shutdown()
         self.gcs.shutdown()
         shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -17,3 +17,7 @@ __all__ = [
     "GroupedData", "from_items", "from_numpy", "from_blocks",
     "from_pandas", "range", "read_csv", "read_json", "read_parquet",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('data')
+del _rlu

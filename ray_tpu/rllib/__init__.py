@@ -29,6 +29,8 @@ from ray_tpu.rllib.env.base import Env, make_env, register_env  # noqa: F401
 from ray_tpu.rllib.env import cartpole  # noqa: F401  (registers CartPole-v1)
 from ray_tpu.rllib.env import catch_pixels  # noqa: F401  (CatchPixels-v0)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner  # noqa: F401
+from ray_tpu.rllib.env.multi_agent import (MultiAgentEnv,  # noqa: F401
+                                           make_multi_agent)
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "Impala",
@@ -37,5 +39,10 @@ __all__ = [
     "ES", "ESConfig", "get_algorithm_class",
     "registered_algorithms", "Learner", "LearnerGroup", "RLModule",
     "DiscreteMLPModule", "DiscreteConvModule", "Env", "register_env",
-    "make_env", "SingleAgentEnvRunner",
+    "make_env", "SingleAgentEnvRunner", "MultiAgentEnv",
+    "make_multi_agent",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('rllib')
+del _rlu

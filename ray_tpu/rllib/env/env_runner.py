@@ -33,9 +33,19 @@ class SingleAgentEnvRunner:
         # runners always act on CPU regardless of driver platform
         jax.config.update("jax_platforms", "cpu")
 
-        self.env = SyncVectorEnv(
-            [functools.partial(make_env, env_name, env_config)
-             for _ in range(num_envs)])
+        from ray_tpu.rllib.env.multi_agent import (MultiAgentEnv,
+                                                   MultiAgentVectorAdapter)
+        # the probe (type dispatch) becomes the first vector member so
+        # its construction isn't wasted
+        probe = make_env(env_name, env_config)
+        env_fns = [lambda: probe] + [
+            functools.partial(make_env, env_name, env_config)
+            for _ in range(num_envs - 1)]
+        if isinstance(probe, MultiAgentEnv):
+            # shared policy: each (env, agent) pair is one vector lane
+            self.env = MultiAgentVectorAdapter(env_fns)
+        else:
+            self.env = SyncVectorEnv(env_fns)
         self.module = module
         self.worker_index = worker_index
         self.gamma = gamma

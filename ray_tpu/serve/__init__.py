@@ -17,3 +17,7 @@ __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
     "run", "get_handle", "delete", "shutdown", "start_http", "batch",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('serve')
+del _rlu

@@ -26,3 +26,7 @@ __all__ = [
     "TensorflowConfig", "TrainContext", "report", "get_checkpoint",
     "get_context", "get_dataset_shard",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('train')
+del _rlu

@@ -22,3 +22,7 @@ __all__ = [
     "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('tune')
+del _rlu

@@ -1,0 +1,67 @@
+"""Scalability envelope smokes (scaled to CI hardware).
+
+reference parity: release/benchmarks/ scalability envelope — many
+queued tasks on one node, many actors, many objects in one get
+(README.md:27-31). Absolute numbers here are CI-sized; the assertion is
+completeness + no degradation to failure, not throughput.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_start):
+    """Shared session cluster."""
+
+
+@pytest.mark.slow
+def test_two_thousand_queued_tasks_complete():
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    refs = [inc.remote(i) for i in range(2000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out == [i + 1 for i in range(2000)]
+
+
+@pytest.mark.slow
+def test_deep_task_chain():
+    @ray_tpu.remote
+    def step(x):
+        return x + 1
+
+    ref = step.remote(0)
+    for _ in range(199):
+        ref = step.remote(ref)
+    assert ray_tpu.get(ref, timeout=600) == 200
+
+
+@pytest.mark.slow
+def test_many_actors_round_trip():
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, base):
+            self.n = base
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    actors = [Counter.options(num_cpus=0.05).remote(i * 100)
+              for i in range(20)]
+    out = ray_tpu.get([a.bump.remote() for a in actors], timeout=600)
+    assert out == [i * 100 + 1 for i in range(20)]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+@pytest.mark.slow
+def test_thousand_objects_single_get():
+    refs = [ray_tpu.put(np.full(64, i)) for i in range(1000)]
+    vals = ray_tpu.get(refs, timeout=600)
+    for i in (0, 500, 999):
+        assert vals[i][0] == i

@@ -1,0 +1,36 @@
+"""Usage stats: local-only feature reporting, opt-out env contract.
+
+reference parity: _private/usage/usage_lib.py (feature-usage report +
+RAY_USAGE_STATS_ENABLED opt-out) — minus the egress: the report is a
+session-dir JSON file only.
+"""
+
+import json
+
+from ray_tpu._private import usage
+
+
+def test_record_and_report(monkeypatch):
+    monkeypatch.setattr(usage, "_features", set())
+    usage.record_library_usage("train")
+    usage.record_library_usage("rllib")
+    usage.record_extra_usage_tag("mesh_axes", "data,fsdp")
+    report = usage.usage_report()
+    assert set(report["libraries_used"]) >= {"train", "rllib"}
+    assert report["extra_tags"]["mesh_axes"] == "data,fsdp"
+    assert report["schema_version"]
+
+
+def test_opt_out(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    monkeypatch.setattr(usage, "_features", set())
+    usage.record_library_usage("serve")
+    assert usage.usage_report()["libraries_used"] == []
+
+
+def test_report_written_to_session_dir(tmp_path, monkeypatch):
+    monkeypatch.setattr(usage, "_features", {"data"})
+    path = usage.write_usage_report(str(tmp_path))
+    with open(path) as f:
+        report = json.load(f)
+    assert report["libraries_used"] == ["data"]
