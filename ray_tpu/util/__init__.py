@@ -10,6 +10,7 @@ from ray_tpu.util.placement_group import (  # noqa: F401
 )
 from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
 from ray_tpu.util.queue import Queue  # noqa: F401
+from ray_tpu.util import tpu_profiler  # noqa: F401
 from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -20,5 +21,5 @@ __all__ = [
     "PlacementGroup", "placement_group", "remove_placement_group",
     "get_current_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "SpreadSchedulingStrategy", "Queue", "ActorPool",
+    "SpreadSchedulingStrategy", "Queue", "ActorPool", "tpu_profiler",
 ]
