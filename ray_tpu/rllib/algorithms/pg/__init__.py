@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.pg.pg import PG, PGConfig, PGLearner
+
+__all__ = ["PG", "PGConfig", "PGLearner"]

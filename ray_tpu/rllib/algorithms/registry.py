@@ -16,11 +16,13 @@ def _registry():
     from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
     from ray_tpu.rllib.algorithms.es.es import ES, ESConfig
+    from ray_tpu.rllib.algorithms.pg.pg import PG, PGConfig
     from ray_tpu.rllib.algorithms.marwil.marwil import (BC, MARWIL,
                                                         BCConfig,
                                                         MARWILConfig)
     from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
     from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig
+    from ray_tpu.rllib.algorithms.td3.td3 import TD3, TD3Config
     return {
         "PPO": (PPO, PPOConfig),
         "IMPALA": (Impala, ImpalaConfig),
@@ -30,6 +32,8 @@ def _registry():
         "MARWIL": (MARWIL, MARWILConfig),
         "BC": (BC, BCConfig),
         "ES": (ES, ESConfig),
+        "PG": (PG, PGConfig),
+        "TD3": (TD3, TD3Config),
     }
 
 

@@ -20,8 +20,9 @@ import numpy as np
 
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.rllib.core.catalog import _mlp_apply, _mlp_init
-from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.core.target_learner import (ContinuousReplayAlgoMixin,
+                                               PolyakTargetLearner)
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
 
@@ -126,17 +127,14 @@ class SquashedGaussianModule(RLModule):
         return out
 
 
-class SACLearner(Learner):
+class SACLearner(PolyakTargetLearner):
     """One jitted update for critic + actor + alpha (reference
-    sac_torch_policy.py actor_critic_loss + optimizer_fn's three Adams)."""
+    sac_torch_policy.py actor_critic_loss + optimizer_fn's three Adams).
+    Target scaffolding (polyak, rng, checkpointing) comes from
+    PolyakTargetLearner."""
 
-    def build(self, seed: int = 0) -> None:
-        super().build(seed)
-        self._post_build(seed)
-
-    def build_distributed(self, seed: int = 0) -> None:
-        super().build_distributed(seed)
-        self._post_build(seed)
+    target_keys = ["q1", "q2"]
+    rng_salt = 777
 
     def _post_build(self, seed: int) -> None:
         import jax
@@ -156,18 +154,7 @@ class SACLearner(Learner):
                     self._optimizer.init(host_params))
             else:
                 self._opt_state = self._optimizer.init(self._params)
-            self._target = {
-                "q1": jax.tree.map(jnp.copy, self._params["q1"]),
-                "q2": jax.tree.map(jnp.copy, self._params["q2"])}
-        self._rng = jax.random.PRNGKey(seed + 777)
-        tau = self.config.tau
-
-        def polyak(target, params):
-            return jax.tree.map(
-                lambda t, p: (1.0 - tau) * t + tau * p, target,
-                {"q1": params["q1"], "q2": params["q2"]})
-
-        self._polyak = jax.jit(polyak)
+        super()._post_build(seed)
         act_dim = self.module.act_dim
         self.target_entropy = (-float(act_dim)
                                if self.config.target_entropy == "auto"
@@ -177,11 +164,6 @@ class SACLearner(Learner):
         if getattr(self, "_distributed", False):
             return self._replicate_host(np.asarray(x))
         return x
-
-    def extra_inputs(self) -> Dict[str, Any]:
-        import jax
-        self._rng, sub = jax.random.split(self._rng)
-        return {"target": self._target, "rng": sub}
 
     def compute_loss(self, params, batch, extra):
         import jax
@@ -237,39 +219,10 @@ class SACLearner(Learner):
             stats["td_indexes"] = batch["batch_indexes"]
         return loss, stats
 
-    def additional_update(self, *, polyak: bool = True,
-                          **kw) -> Dict[str, Any]:
-        """Polyak target update; also absorbs the base loop's periodic
-        update_target=True (a hard sync would fight tau-averaging)."""
-        if polyak:
-            with self._state_lock:
-                self._target = self._polyak(self._target, self._params)
-        return {}
-
-    def get_state(self) -> Dict[str, Any]:
-        import jax
-        state = super().get_state()
-        with self._state_lock:
-            state["target"] = jax.device_get(self._target)
-        return state
-
-    def set_state(self, state: Dict[str, Any]) -> None:
-        super().set_state(state)
-        import jax
-        import jax.numpy as jnp
-        with self._state_lock:
-            if getattr(self, "_distributed", False):
-                self._target = jax.tree.map(self._replicate_host,
-                                            state["target"])
-            else:
-                self._target = jax.tree.map(jnp.asarray, state["target"])
-
-
-class SAC(DQN):
-    """Runs DQN's shared replay loop with SAC hooks: no epsilon push
-    (the stochastic policy explores), polyak target updates after every
-    gradient step instead of periodic hard syncs (reference SAC extends
-    DQN the same way, sac.py)."""
+class SAC(ContinuousReplayAlgoMixin, DQN):
+    """Runs DQN's shared replay loop with the continuous-control hooks
+    (one gradient step per env step, polyak targets every update;
+    reference SAC extends DQN the same way, sac.py)."""
 
     learner_cls = SACLearner
 
@@ -288,17 +241,3 @@ class SAC(DQN):
 
     def _before_sample(self, stats: Dict[str, Any]) -> None:
         pass  # entropy-regularized policy needs no epsilon
-
-    def _training_intensity(self) -> float:
-        # natural value: one gradient step per sampled env step (the
-        # standard SAC cadence; reference sac.py training_intensity)
-        cfg = self.config
-        return (cfg.training_intensity
-                if cfg.training_intensity is not None
-                else float(cfg.train_batch_size))
-
-    def _after_each_update(self) -> None:
-        self.learner_group.additional_update(polyak=True)
-
-    def _maybe_update_target(self) -> None:
-        pass  # polyak per update replaces periodic hard syncs

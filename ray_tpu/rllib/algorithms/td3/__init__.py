@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.td3.td3 import (TD3, DeterministicModule,
+                                              TD3Config, TD3Learner)
+
+__all__ = ["TD3", "TD3Config", "TD3Learner", "DeterministicModule"]

@@ -1,0 +1,215 @@
+"""TD3: twin-delayed deep deterministic policy gradient.
+
+reference parity: rllib/algorithms/td3/td3.py (TD3Config — twin Q,
+target policy smoothing with clipped noise, delayed policy updates,
+gaussian exploration; built on the DDPG policy ddpg_torch_policy.py).
+TPU-first shape like SAC: critic + (gated) actor losses fuse into one
+jitted update; the policy-delay gate rides in as a 0/1 scalar so the
+program never retraces; targets (policy + twin Q) polyak-update in a
+tiny second program. Exploration noise scale threads into the runner's
+jitted forward like DQN's epsilon.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
+from ray_tpu.rllib.core.catalog import _mlp_apply, _mlp_init
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.core.target_learner import (ContinuousReplayAlgoMixin,
+                                               PolyakTargetLearner)
+
+
+class TD3Config(DQNConfig):
+    """Shares DQN's replay-loop knobs; DQN-only knobs (dueling,
+    double_q, epsilon_*) are inert."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or TD3)
+        self.lr = 1e-3
+        self.train_batch_size = 100
+        self.rollout_fragment_length = 1
+        self.tau = 0.005
+        self.policy_delay = 2
+        self.target_noise = 0.2          # smoothing noise stddev
+        self.target_noise_clip = 0.5
+        self.exploration_noise = 0.1     # of the action range
+        self.num_steps_sampled_before_learning_starts = 1500
+        self.initial_epsilon = self.final_epsilon = 0.0
+
+
+class DeterministicModule(RLModule):
+    """mu(s) policy + twin Q(s, a) critics (reference
+    ddpg_torch_model.py). Exploration adds gaussian action noise scaled
+    by batch["noise_scale"] (threaded by the runner)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, low, high,
+                 hiddens: Sequence[int] = (256, 256)):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+        self.hiddens = tuple(hiddens)
+
+    def init_params(self, key) -> Dict[str, Any]:
+        import jax
+        kp, k1, k2 = jax.random.split(key, 3)
+        pi_sizes = [self.obs_dim, *self.hiddens, self.act_dim]
+        q_sizes = [self.obs_dim + self.act_dim, *self.hiddens, 1]
+        return {"pi": _mlp_init(kp, pi_sizes),
+                "q1": _mlp_init(k1, q_sizes, scale_last=1.0),
+                "q2": _mlp_init(k2, q_sizes, scale_last=1.0)}
+
+    def _scale(self):
+        return (self.high - self.low) / 2.0, (self.high + self.low) / 2.0
+
+    def mu(self, params, obs):
+        import jax.numpy as jnp
+        scale, mid = self._scale()
+        return jnp.tanh(_mlp_apply(params["pi"], obs)) * scale + mid
+
+    def q_values(self, params, obs, actions):
+        import jax.numpy as jnp
+        x = jnp.concatenate([obs, actions.astype(jnp.float32)], axis=-1)
+        return (_mlp_apply(params["q1"], x)[..., 0],
+                _mlp_apply(params["q2"], x)[..., 0])
+
+    def forward_train(self, params, batch):
+        import jax.numpy as jnp
+        a = self.mu(params, batch["obs"])
+        return {"action_dist_inputs": a,
+                "vf_preds": jnp.zeros(a.shape[:-1], jnp.float32)}
+
+    def forward_exploration(self, params, batch, key):
+        import jax
+        import jax.numpy as jnp
+        out = self.forward_train(params, batch)
+        a = out["action_dist_inputs"]
+        scale, _ = self._scale()
+        noise_scale = batch.get("noise_scale",
+                                jnp.asarray(0.0, jnp.float32))
+        noise = jax.random.normal(key, a.shape) * scale * noise_scale
+        out["actions"] = jnp.clip(a + noise, self.low, self.high)
+        out["action_logp"] = jnp.zeros(a.shape[:-1], jnp.float32)
+        return out
+
+    def forward_inference(self, params, batch):
+        out = self.forward_train(params, batch)
+        out["actions"] = out["action_dist_inputs"]
+        return out
+
+
+class TD3Learner(PolyakTargetLearner):
+    """One jitted update: twin-critic TD loss against a smoothed target
+    action, plus the deterministic policy-gradient term gated by the
+    policy-delay scalar (reference ddpg_torch_policy.py
+    build_ddpg_losses + TD3's smoothing/delay). Target scaffolding
+    comes from PolyakTargetLearner (whole param tree)."""
+
+    target_keys = None  # target the full tree: pi + q1 + q2
+    rng_salt = 311
+
+    def _post_build(self, seed: int) -> None:
+        super()._post_build(seed)
+        self._updates = 0
+
+    def extra_inputs(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        extra = super().extra_inputs()
+        self._updates += 1
+        gate = 1.0 if self._updates % self.config.policy_delay == 0 \
+            else 0.0
+        extra["policy_gate"] = jnp.asarray(gate, jnp.float32)
+        return extra
+
+    def postprocess_updates(self, updates, extra):
+        """Actor params move ONLY on delayed steps: zeroing the loss
+        alone leaves Adam momentum walking the policy every step."""
+        import jax
+        updates = dict(updates)
+        updates["pi"] = jax.tree.map(
+            lambda u: u * extra["policy_gate"], updates["pi"])
+        return updates
+
+    def compute_loss(self, params, batch, extra):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        m: DeterministicModule = self.module
+        cfg = self.config
+        scale = (m.high - m.low) / 2.0
+
+        # ---- smoothed target action (TD3's trick #3) ----------------
+        a_next = m.mu(extra["target"], batch["next_obs"])
+        noise = jnp.clip(
+            jax.random.normal(extra["rng"], a_next.shape)
+            * cfg.target_noise * scale,
+            -cfg.target_noise_clip * scale,
+            cfg.target_noise_clip * scale)
+        a_next = jnp.clip(a_next + noise, m.low, m.high)
+
+        tq1, tq2 = m.q_values(extra["target"], batch["next_obs"],
+                              a_next)
+        q_next = jnp.minimum(tq1, tq2)
+        target = lax.stop_gradient(
+            batch["rewards"] + batch["discounts"]
+            * (1.0 - batch["dones"]) * q_next)
+
+        q1, q2 = m.q_values(params, batch["obs"], batch["actions"])
+        w = batch.get("weights")
+        td_sq = 0.5 * ((q1 - target) ** 2 + (q2 - target) ** 2)
+        critic_loss = jnp.mean(td_sq * w) if w is not None \
+            else jnp.mean(td_sq)
+
+        # ---- delayed deterministic policy gradient ------------------
+        q_sg = {"q1": jax.tree.map(lax.stop_gradient, params["q1"]),
+                "q2": jax.tree.map(lax.stop_gradient, params["q2"])}
+        pi_a = m.mu(params, batch["obs"])
+        q_pi, _ = m.q_values(q_sg, batch["obs"], pi_a)
+        actor_loss = -jnp.mean(q_pi)
+
+        loss = critic_loss + extra["policy_gate"] * actor_loss
+        stats = {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                 "mean_q": jnp.mean(jnp.minimum(q1, q2)),
+                 "td_error": 0.5 * (jnp.abs(q1 - target)
+                                    + jnp.abs(q2 - target))}
+        if "batch_indexes" in batch:
+            stats["td_indexes"] = batch["batch_indexes"]
+        return loss, stats
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        self._updates = state.get("updates", self._updates)
+
+
+class TD3(ContinuousReplayAlgoMixin, DQN):
+    """DQN's replay loop with TD3 hooks: gaussian action noise instead
+    of epsilon, polyak targets after every update."""
+
+    learner_cls = TD3Learner
+
+    def default_module(self, observation_space, action_space):
+        if len(observation_space.shape) != 1 or \
+                not hasattr(action_space, "low"):
+            raise NotImplementedError(
+                f"TD3 ships a deterministic MLP for 1-D obs and Box "
+                f"actions; got obs={observation_space} "
+                f"act={action_space}.")
+        return DeterministicModule(
+            observation_space.shape[0], action_space.shape[0],
+            action_space.low, action_space.high,
+            self.config.model_hiddens)
+
+    def _before_sample(self, stats: Dict[str, Any]) -> None:
+        self.env_runners.set_explore_inputs(
+            {"noise_scale": self.config.exploration_noise})
+        stats["exploration_noise"] = self.config.exploration_noise

@@ -60,6 +60,7 @@ class Learner:
                 loss_wrap, has_aux=True)(params)
             updates, opt_state = self._optimizer.update(
                 grads, opt_state, params)
+            updates = self.postprocess_updates(updates, extra)
             params = optax.apply_updates(params, updates)
             stats = dict(stats)
             stats["total_loss"] = loss
@@ -115,6 +116,7 @@ class Learner:
                 loss_wrap, has_aux=True)(params)
             updates, opt_state = self._optimizer.update(
                 grads, opt_state, params)
+            updates = self.postprocess_updates(updates, extra)
             params = optax.apply_updates(params, updates)
             stats = dict(stats)
             stats["total_loss"] = loss
@@ -187,6 +189,13 @@ class Learner:
 
     def additional_update(self, **kwargs) -> Dict[str, Any]:
         return {}
+
+    def postprocess_updates(self, updates, extra):
+        """Inside-jit hook between optimizer.update and apply_updates
+        (e.g. TD3 masks the actor subtree on non-delayed steps —
+        zeroing the LOSS alone wouldn't stop Adam momentum from moving
+        the params). Default: identity."""
+        return updates
 
     def extra_inputs(self) -> Dict[str, Any]:
         """Scalars threaded into the jitted loss (kl coeff etc.)."""

@@ -1,0 +1,106 @@
+"""PolyakTargetLearner: shared target-network scaffolding.
+
+SAC and TD3 both keep polyak-averaged target copies of (a subtree of)
+their params, split an rng per jitted update, and (de)replicate targets
+through checkpoints — this base holds that once (the reference keeps
+the equivalent in each policy class; here it's one mixin over the
+jax Learner engine).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.rllib.core.learner import Learner
+
+
+class PolyakTargetLearner(Learner):
+    """Subclasses set `target_keys` (None = the whole param tree) and
+    read extra["target"] / extra["rng"] in compute_loss; the owning
+    algorithm calls additional_update(polyak=True) after each gradient
+    step."""
+
+    target_keys: Optional[List[str]] = None
+    rng_salt: int = 0
+
+    def build(self, seed: int = 0) -> None:
+        super().build(seed)
+        self._post_build(seed)
+
+    def build_distributed(self, seed: int = 0) -> None:
+        super().build_distributed(seed)
+        self._post_build(seed)
+
+    def _target_subtree(self, params):
+        if self.target_keys is None:
+            return params
+        return {k: params[k] for k in self.target_keys}
+
+    def _post_build(self, seed: int) -> None:
+        import jax
+        import jax.numpy as jnp
+        with self._state_lock:
+            self._target = jax.tree.map(
+                jnp.copy, self._target_subtree(self._params))
+        self._rng = jax.random.PRNGKey(seed + self.rng_salt)
+        tau = self.config.tau
+
+        def polyak(target, params):
+            return jax.tree.map(
+                lambda t, p: (1.0 - tau) * t + tau * p, target,
+                self._target_subtree(params))
+
+        self._polyak = jax.jit(polyak)
+
+    def extra_inputs(self) -> Dict[str, Any]:
+        import jax
+        self._rng, sub = jax.random.split(self._rng)
+        return {"target": self._target, "rng": sub}
+
+    def additional_update(self, *, polyak: bool = True,
+                          **kw) -> Dict[str, Any]:
+        """Polyak target update; also absorbs the base replay loop's
+        periodic update_target=True (a hard sync would fight
+        tau-averaging)."""
+        if polyak:
+            with self._state_lock:
+                self._target = self._polyak(self._target, self._params)
+        return {}
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        state = super().get_state()
+        with self._state_lock:
+            state["target"] = jax.device_get(self._target)
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        import jax
+        import jax.numpy as jnp
+        with self._state_lock:
+            if getattr(self, "_distributed", False):
+                self._target = jax.tree.map(self._replicate_host,
+                                            state["target"])
+            else:
+                self._target = jax.tree.map(jnp.asarray,
+                                            state["target"])
+
+
+class ContinuousReplayAlgoMixin:
+    """Algorithm-side hooks shared by SAC/TD3 over DQN's replay loop:
+    no epsilon push (these policies explore their own way), one
+    gradient step per sampled env step by default, polyak after every
+    update instead of periodic hard target syncs."""
+
+    def _training_intensity(self) -> float:
+        cfg = self.config
+        return (cfg.training_intensity
+                if cfg.training_intensity is not None
+                else float(cfg.train_batch_size))
+
+    def _after_each_update(self) -> None:
+        self.learner_group.additional_update(polyak=True)
+
+    def _maybe_update_target(self) -> None:
+        pass  # polyak per update replaces periodic hard syncs
