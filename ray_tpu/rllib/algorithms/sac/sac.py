@@ -238,6 +238,3 @@ class SAC(ContinuousReplayAlgoMixin, DQN):
         return SquashedGaussianModule(
             observation_space.shape[0], action_space.shape[0],
             action_space.low, action_space.high, hiddens)
-
-    def _before_sample(self, stats: Dict[str, Any]) -> None:
-        pass  # entropy-regularized policy needs no epsilon

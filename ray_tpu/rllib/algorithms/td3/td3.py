@@ -126,8 +126,12 @@ class TD3Learner(PolyakTargetLearner):
         return extra
 
     def postprocess_updates(self, updates, extra):
-        """Actor params move ONLY on delayed steps: zeroing the loss
-        alone leaves Adam momentum walking the policy every step."""
+        """Actor params move ONLY on delayed steps (TD3's invariant) —
+        zeroing the loss alone would leave Adam momentum walking the
+        policy every step. Deliberate deviation from the reference's
+        separate actor optimizer: the shared Adam's pi moments still
+        decay during gated steps (slightly smaller effective momentum),
+        which keeps the whole update one fused XLA program."""
         import jax
         updates = dict(updates)
         updates["pi"] = jax.tree.map(

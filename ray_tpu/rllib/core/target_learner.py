@@ -89,9 +89,13 @@ class PolyakTargetLearner(Learner):
 
 class ContinuousReplayAlgoMixin:
     """Algorithm-side hooks shared by SAC/TD3 over DQN's replay loop:
-    no epsilon push (these policies explore their own way), one
+    no epsilon push by default (these policies explore their own way —
+    TD3 overrides _before_sample to push its noise scale instead), one
     gradient step per sampled env step by default, polyak after every
     update instead of periodic hard target syncs."""
+
+    def _before_sample(self, stats: Dict[str, Any]) -> None:
+        pass  # no epsilon; stochastic/noise exploration is in-policy
 
     def _training_intensity(self) -> float:
         cfg = self.config

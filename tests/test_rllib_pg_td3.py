@@ -82,6 +82,38 @@ class TestTD3:
                                    np.asarray(o2["actions"]))
         assert np.all(np.abs(np.asarray(o1["actions"])) <= 2.0)
 
+    def test_policy_delay_freezes_actor_between_delayed_steps(self):
+        """postprocess_updates masks the pi subtree on gated updates:
+        with policy_delay=2, update 1 must leave pi untouched while the
+        critics move; update 2 moves pi."""
+        import jax
+        from ray_tpu.rllib.algorithms.td3.td3 import (DeterministicModule,
+                                                      TD3Learner)
+        cfg = self._config().training(train_batch_size=8)
+        cfg.policy_delay = 2
+        module = DeterministicModule(3, 1, [-2.0], [2.0], hiddens=(8,))
+        learner = TD3Learner(module, cfg)
+        learner.build(seed=0)
+        batch = {
+            "obs": np.random.randn(8, 3).astype(np.float32),
+            "actions": np.random.uniform(-2, 2, (8, 1)).astype(
+                np.float32),
+            "rewards": np.ones(8, np.float32),
+            "dones": np.zeros(8, np.float32),
+            "discounts": np.full(8, 0.99, np.float32),
+            "next_obs": np.random.randn(8, 3).astype(np.float32),
+        }
+        pi0 = jax.device_get(learner._params["pi"])
+        q0 = jax.device_get(learner._params["q1"])
+        learner.update(batch, minibatch_size=None, num_iters=1)
+        pi1 = jax.device_get(learner._params["pi"])
+        q1 = jax.device_get(learner._params["q1"])
+        jax.tree.map(np.testing.assert_array_equal, pi0, pi1)
+        assert not np.allclose(q0[0]["w"], q1[0]["w"])
+        learner.update(batch, minibatch_size=None, num_iters=1)
+        pi2 = jax.device_get(learner._params["pi"])
+        assert not np.allclose(pi1[0]["w"], pi2[0]["w"])
+
     def test_td3_save_restore_roundtrip(self, tmp_path):
         cfg = self._config().training(
             buffer_size=500, train_batch_size=16,
