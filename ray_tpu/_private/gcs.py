@@ -167,6 +167,29 @@ class GcsServer:
         self.CLUSTER_EVENTS_MAX = 4096
         self._dead = False
 
+        # Reload the persisted actor directory (reference GcsInitData:
+        # on restart the GCS rebuilds state from storage; nodes instead
+        # RE-REGISTER via the resource-report loop — see
+        # report_resources returning "unknown_node").
+        for key in self.store.keys("actors", ""):
+            rec = self.store.get("actors", key)
+            if rec:
+                info, spec = rec
+                if info.state in ("PENDING", "RESTARTING"):
+                    # its scheduling thread died with the old process
+                    info.state = "DEAD"
+                    info.death_cause = "GCS restarted mid-scheduling"
+                self.actors[key] = info
+                if spec is not None:
+                    self.actor_specs[key] = spec
+        # drop names whose actor record never made it to the snapshot
+        # (crash between the two writes) — a name pointing at a missing
+        # record would brick every lookup of that name
+        self.named_actors.update(
+            {k: v for k, v in
+             (self.store.get("meta", "named_actors") or {}).items()
+             if v in self.actors})
+
         self.server = rpc_lib.RpcServer({
             # KV (reference InternalKVGcsService)
             "kv_put": self.kv_put,
@@ -236,8 +259,16 @@ class GcsServer:
 
     def register_node(self, info: NodeInfo) -> None:
         with self._lock:
-            self.nodes[info.node_id.hex()] = info
-            self.node_available[info.node_id.hex()] = dict(info.resources_total)
+            hex_id = info.node_id.hex()
+            prev = self.nodes.get(hex_id)
+            self.nodes[hex_id] = info
+            # a RE-register of a live node (idempotent retry / blip
+            # recovery) must not clobber its real availability with the
+            # full total — busy nodes would look free until the next
+            # report tick
+            if prev is None or not prev.alive or \
+                    hex_id not in self.node_available:
+                self.node_available[hex_id] = dict(info.resources_total)
         self.publish("node", ("ALIVE", info))
 
     def unregister_node(self, node_id_hex: str) -> None:
@@ -268,11 +299,17 @@ class GcsServer:
             return list(self.nodes.values())
 
     def report_resources(self, node_id_hex: str,
-                         available: Dict[str, float]) -> None:
+                         available: Dict[str, float]) -> str:
         with self._lock:
             if node_id_hex in self.nodes and self.nodes[node_id_hex].alive:
                 self.node_available[node_id_hex] = dict(available)
                 self.node_health_failures[node_id_hex] = 0
+                return "ok"
+        # a restarted GCS (or one that declared this node dead during a
+        # network blip) doesn't know the reporter: tell it to
+        # re-register (reference: raylets reconnect after GCS restart,
+        # NotifyGCSRestart node_manager.proto:357)
+        return "unknown_node"
 
     def get_cluster_resources(self) -> Dict[str, Dict[str, Dict[str, float]]]:
         with self._lock:
@@ -315,6 +352,22 @@ class GcsServer:
 
     # ---- actors ----------------------------------------------------------
 
+    def _persist_actor(self, actor_id_hex: str) -> None:
+        """Write one actor's directory record + the named map to the
+        store so lookups survive a GCS restart (reference
+        GcsActorTable, gcs_table_storage.h:48). Stores a snapshot COPY:
+        the live ActorInfo keeps mutating under the GCS lock while the
+        persistence flusher pickles tables under the store lock."""
+        import copy
+        with self._lock:
+            info = self.actors.get(actor_id_hex)
+            spec = self.actor_specs.get(actor_id_hex)
+            named = dict(self.named_actors)
+            info = copy.copy(info) if info is not None else None
+        if info is not None:
+            self.store.put("actors", actor_id_hex, (info, spec))
+            self.store.put("meta", "named_actors", named)
+
     def register_actor(self, spec: TaskSpec, name: str = "",
                        namespace: str = "") -> str:
         """Register + schedule an actor creation (reference
@@ -325,8 +378,10 @@ class GcsServer:
         with self._lock:
             if name:
                 existing = self.named_actors.get(key)
-                if existing is not None and \
-                        self.actors[existing].state != "DEAD":
+                existing_info = (self.actors.get(existing)
+                                 if existing is not None else None)
+                if existing_info is not None and \
+                        existing_info.state != "DEAD":
                     raise ValueError(
                         f"actor name '{name}' already taken in ns '{namespace}'")
                 self.named_actors[key] = actor_id.hex()
@@ -335,6 +390,7 @@ class GcsServer:
                 class_name=spec.function_name, state="PENDING", address=None,
                 node_id=None, max_restarts=spec.max_restarts)
             self.actor_specs[actor_id.hex()] = spec
+        self._persist_actor(actor_id.hex())
         # Schedule asynchronously so registration returns immediately
         # (reference: GcsActorManager registers then hands to the scheduler).
         threading.Thread(target=self._schedule_actor,
@@ -390,6 +446,7 @@ class GcsServer:
             info.state = "ALIVE"
             info.address = tuple(address)
             info.node_id = NodeID.from_hex(node_id_hex)
+        self._persist_actor(actor_id_hex)
         self.publish("actor", ("ALIVE", self.actors[actor_id_hex]))
 
     def report_actor_death(self, actor_id_hex: str, reason: str,
@@ -412,6 +469,7 @@ class GcsServer:
             else:
                 info.state = "DEAD"
                 info.address = None
+        self._persist_actor(actor_id_hex)
         if can_restart:
             logger.warning("GCS: restarting actor %s (%d/%s): %s",
                            actor_id_hex[:12], info.num_restarts,
@@ -442,6 +500,7 @@ class GcsServer:
         with self._lock:
             return [k for k, aid in self.named_actors.items()
                     if (all_namespaces or k[0] == namespace)
+                    and aid in self.actors
                     and self.actors[aid].state != "DEAD"]
 
     def list_actors(self) -> List[ActorInfo]:

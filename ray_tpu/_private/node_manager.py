@@ -181,8 +181,24 @@ class NodeManager:
             try:
                 with self._lock:
                     avail = self.available.to_dict()
-                self._gcs.call("report_resources",
-                               node_id_hex=self.node_id.hex(), available=avail)
+                resp = self._gcs.call(
+                    "report_resources",
+                    node_id_hex=self.node_id.hex(), available=avail)
+                if resp == "unknown_node" and not self._dead:
+                    # the GCS restarted (or declared us dead during a
+                    # blip): re-register so scheduling resumes — but
+                    # never resurrect a node that is itself shutting
+                    # down. Follow with a fresh report so the GCS sees
+                    # true availability, not resources_total.
+                    logger.warning(
+                        "GCS does not know node %s — re-registering",
+                        self.node_id.hex()[:12])
+                    self._gcs.call("register_node", info=self.info)
+                    with self._lock:
+                        avail = self.available.to_dict()
+                    self._gcs.call(
+                        "report_resources",
+                        node_id_hex=self.node_id.hex(), available=avail)
             except Exception:  # noqa: BLE001
                 pass
             try:

@@ -77,6 +77,20 @@ class _Handler(socketserver.BaseRequestHandler):
         server: RpcServer = self.server.rpc_server  # type: ignore[attr-defined]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # track live connections so stop() can close them — otherwise
+        # handler threads outlive the server and keep ANSWERING against
+        # the stopped instance (a restarted server on the same port then
+        # never sees those clients). The stopping flag closes the race
+        # where a connection accepted around stop() registers after the
+        # snapshot and lingers anyway.
+        with self.server.conn_lock:  # type: ignore[attr-defined]
+            if self.server.stopping:  # type: ignore[attr-defined]
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            self.server.conns.add(sock)  # type: ignore[attr-defined]
         try:
             while True:
                 req = _recv_frame(sock)
@@ -103,11 +117,20 @@ class _Handler(socketserver.BaseRequestHandler):
                 _send_frame(sock, pickle.dumps(reply, protocol=5))
         except (ConnectionLost, ConnectionResetError, BrokenPipeError, OSError):
             return
+        finally:
+            with self.server.conn_lock:  # type: ignore[attr-defined]
+                self.server.conns.discard(sock)  # type: ignore[attr-defined]
 
 
 class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.conns: set = set()
+        self.conn_lock = threading.Lock()
+        self.stopping = False
 
 
 class RpcServer:
@@ -133,6 +156,38 @@ class RpcServer:
             self._server.server_close()
         except Exception:  # noqa: BLE001
             pass
+        # sever live connections so clients fail over immediately
+        # (e.g. to a restarted server on the same port) instead of
+        # talking to this zombie's handler threads
+        with self._server.conn_lock:
+            self._server.stopping = True
+            conns = list(self._server.conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# Methods safe to RESEND even after a send apparently succeeded (the
+# peer may have executed them): reads, pings, and naturally-idempotent
+# writes. A send into a dead peer's kernel buffer "succeeds" locally, so
+# without this the first call after a server restart always fails.
+_IDEMPOTENT_PREFIXES = ("get_", "list_", "kv_get", "kv_keys", "nm_get",
+                        "nm_list", "cl_get", "cl_list")
+_IDEMPOTENT_METHODS = frozenset({
+    "ping", "nm_ping", "report_resources", "register_node", "subscribe",
+    "next_job_id", "cluster_resources", "available_resources",
+})
+
+
+def _is_idempotent(method: str) -> bool:
+    return method.startswith(_IDEMPOTENT_PREFIXES) or \
+        method in _IDEMPOTENT_METHODS
 
 
 class RpcClient:
@@ -169,11 +224,13 @@ class RpcClient:
                 except (ConnectionLost, ConnectionResetError, BrokenPipeError,
                         OSError):
                     self.close_locked()
-                    # Only retry when the request never left this client
-                    # (stale pooled connection died on send). After a
-                    # successful send the handler may have executed —
-                    # re-sending would duplicate a non-idempotent RPC.
-                    if sent or attempt == 1:
+                    # Retry when the request never left this client
+                    # (stale pooled connection died on send) OR the
+                    # method is idempotent. After a successful send a
+                    # non-idempotent handler may have executed —
+                    # re-sending would duplicate it.
+                    if attempt == 1 or (sent and
+                                        not _is_idempotent(method)):
                         raise ConnectionLost(
                             f"rpc to {self.address} failed: {method}")
         status, result = pickle.loads(reply)

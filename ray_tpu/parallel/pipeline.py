@@ -39,7 +39,12 @@ def make_pipeline_fn(stage_fn: Callable[[Any, Any], Any],
                          "returns the differentiable scalar objective")
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+        _relax_kwargs = {"check_vma": False}
+    except ImportError:  # older jax (kwarg was named check_rep there)
+        from jax.experimental.shard_map import shard_map
+        _relax_kwargs = {"check_rep": False}
     from jax.sharding import PartitionSpec as P
 
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -84,7 +89,7 @@ def make_pipeline_fn(stage_fn: Callable[[Any, Any], Any],
         per_stage, mesh=mesh,
         in_specs=(P(AXIS_PIPE), P(), P()),
         out_specs=P(AXIS_PIPE),
-        check_rep=False)
+        **_relax_kwargs)
 
     def run(params_stacked, x_micro, y_micro):
         out = pipelined(params_stacked, x_micro, y_micro)
