@@ -124,6 +124,10 @@ class CoreWorker:
         self._borrow_release_queue: "queue.Queue" = queue.Queue()
         self.tasks: Dict[str, _TaskEntry] = {}
         self.actors: Dict[str, _ActorState] = {}
+        # actor id hex -> submitted-but-unfinished calls from THIS
+        # process (max_pending_calls backpressure is per caller, like
+        # the reference's submit-queue bound)
+        self._actor_pending: Dict[str, int] = {}
         self._store_map_cache = (0.0, {})
         self._put_index = 0
         self._fn_cache: Dict[str, Any] = {}
@@ -819,6 +823,8 @@ class CoreWorker:
             duplicate = entry is None or entry.done
             if not duplicate:
                 entry.done = True
+                # submit-side backpressure accounting (max_pending_calls)
+                self._decr_actor_pending_locked(entry)
                 # dynamic-return children become owned objects of ours,
                 # registered before the generator handle resolves so a
                 # get() of a child ref never races its registration
@@ -886,6 +892,7 @@ class CoreWorker:
                 entry.retries_left -= 1
             else:
                 entry.done = True
+                self._decr_actor_pending_locked(entry)
         if will_retry:
             logger.warning("retrying task %s (%s: %s), %d retries left",
                            entry.spec.function_name, error_type, message,
@@ -940,11 +947,29 @@ class CoreWorker:
             if actor_id.hex() not in self.actors:
                 self.actors[actor_id.hex()] = _ActorState(actor_id=actor_id)
 
+    def actor_pending_calls(self, actor_id: ActorID) -> int:
+        """Caller-side count of this actor's submitted-but-unfinished
+        calls (reference max_pending_calls backpressure)."""
+        with self._lock:
+            return self._actor_pending.get(actor_id.hex(), 0)
+
+    def _decr_actor_pending_locked(self, entry: "_TaskEntry") -> None:
+        """Call under self._lock when an actor task reaches a terminal
+        state — every terminal path must hit this or the caller's
+        max_pending_calls budget leaks shut."""
+        aid = entry.spec.actor_id
+        if aid is not None and \
+                entry.spec.task_type == TaskType.ACTOR_TASK:
+            cnt = self._actor_pending.get(aid.hex(), 0)
+            if cnt > 0:
+                self._actor_pending[aid.hex()] = cnt - 1
+
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           function_key: str, args_blob: bytes,
                           arg_refs: List[ObjectID],
                           num_returns: int,
-                          concurrency_group: str = "") -> List[ObjectRef]:
+                          concurrency_group: str = "",
+                          max_pending_calls: int = -1) -> List[ObjectRef]:
         spec = TaskSpec(
             task_id=TaskID.of(self.job_id), job_id=self.job_id,
             task_type=TaskType.ACTOR_TASK, function_key=function_key,
@@ -971,6 +996,15 @@ class CoreWorker:
                 for oid in return_ids:
                     self.objects[oid.hex()] = (ERROR, blob)
                 return [ObjectRef(oid, self.address) for oid in return_ids]
+            # backpressure bound checked ATOMICALLY with the increment:
+            # an unlocked pre-check would let concurrent submitters
+            # overshoot the budget together
+            pending = self._actor_pending.get(actor_id.hex(), 0)
+            if 0 <= max_pending_calls <= pending:
+                raise exc.PendingCallsLimitExceeded(
+                    f"actor {actor_id.hex()[:12]} already has {pending} "
+                    f"pending calls from this caller "
+                    f"(max_pending_calls={max_pending_calls})")
             spec.sequence_number = state.seq
             state.seq += 1
             for oid in return_ids:
@@ -978,6 +1012,7 @@ class CoreWorker:
                 self.object_events[oid.hex()] = threading.Event()
             self.tasks[spec.task_id.hex()] = _TaskEntry(
                 spec=spec, retries_left=0, return_ids=return_ids)
+            self._actor_pending[actor_id.hex()] = pending + 1
             addr = state.address
             if addr is None:
                 state.queue.append(spec)

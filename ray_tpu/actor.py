@@ -16,7 +16,8 @@ from ray_tpu._private.ids import ActorID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.state import (DefaultSchedulingStrategy, TaskSpec,
                                     TaskType)
-from ray_tpu.remote_function import build_resources, pack_args, _extract_pg
+from ray_tpu.remote_function import (build_resources, pack_args,
+                                     validate_runtime_env, _extract_pg)
 
 _ACTOR_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "memory", "name",
@@ -74,13 +75,15 @@ class ActorHandle:
                  method_names: List[str], fn_key: str,
                  method_options: Optional[Dict[str, Dict[str, Any]]]
                  = None,
-                 concurrency_groups: Optional[List[str]] = None):
+                 concurrency_groups: Optional[List[str]] = None,
+                 max_pending_calls: int = -1):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_names = list(method_names)
         self._fn_key = fn_key
         self._method_options = dict(method_options or {})
         self._concurrency_groups = list(concurrency_groups or [])
+        self._max_pending_calls = int(max_pending_calls)
         w = worker_mod.global_worker_or_none()
         if w is not None:
             w.core_worker.attach_actor(actor_id)
@@ -114,7 +117,8 @@ class ActorHandle:
         args_blob, arg_refs = pack_args(args, kwargs)
         refs = w.core_worker.submit_actor_task(
             self._actor_id, method_name, self._fn_key, args_blob, arg_refs,
-            num_returns, concurrency_group=concurrency_group)
+            num_returns, concurrency_group=concurrency_group,
+            max_pending_calls=self._max_pending_calls)
         if num_returns == 1:
             return refs[0]
         return refs
@@ -126,7 +130,8 @@ class ActorHandle:
         return (ActorHandle, (self._actor_id, self._class_name,
                               self._method_names, self._fn_key,
                               self._method_options,
-                              self._concurrency_groups))
+                              self._concurrency_groups,
+                              self._max_pending_calls))
 
 
 class ActorClass:
@@ -227,9 +232,11 @@ class ActorClass:
             if info is not None and info.state != "DEAD":
                 if self._fn_key is None:
                     self._fn_key = cw.export_function(self._cls)
-                return ActorHandle(info.actor_id, self._cls.__name__,
-                                   self._method_names(), self._fn_key,
-                                   method_opts, group_names)
+                return ActorHandle(
+                    info.actor_id, self._cls.__name__,
+                    self._method_names(), self._fn_key,
+                    method_opts, group_names,
+                    int(opts.get("max_pending_calls", -1)))
 
         if self._fn_key is None:
             self._fn_key = cw.export_function(self._cls)
@@ -257,17 +264,20 @@ class ActorClass:
             concurrency_groups=groups,
             scheduling_strategy=strategy, placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=validate_runtime_env(opts.get("runtime_env")),
             name=name, namespace=namespace,
             detached=(lifetime == "detached"))
         import pickle
         cw._gcs.call("kv_put", key=f"__actor_spec_meta:{actor_id.hex()}",
                      value=pickle.dumps((self._fn_key, self._method_names(),
-                                         method_opts, group_names)))
+                                         method_opts, group_names,
+                                         int(opts.get("max_pending_calls",
+                                                      -1)))))
         cw.create_actor(spec, name=name, namespace=namespace)
         return ActorHandle(actor_id, self._cls.__name__,
                            self._method_names(), self._fn_key,
-                           method_opts, group_names)
+                           method_opts, group_names,
+                           int(opts.get("max_pending_calls", -1)))
 
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:
@@ -280,10 +290,10 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
                                    namespace=namespace or w.namespace)
     if info is None or info.state == "DEAD":
         raise ValueError(f"no live actor named '{name}'")
-    fn_key, methods, method_opts, group_names = _actor_class_meta(
-        w, info.actor_id.hex())
+    fn_key, methods, method_opts, group_names, max_pending = \
+        _actor_class_meta(w, info.actor_id.hex())
     return ActorHandle(info.actor_id, info.class_name, methods, fn_key,
-                       method_opts, group_names)
+                       method_opts, group_names, max_pending)
 
 
 def _actor_class_meta(w: Any, actor_id_hex: str):
@@ -296,5 +306,7 @@ def _actor_class_meta(w: Any, actor_id_hex: str):
     meta = pickle.loads(spec)
     if len(meta) == 2:  # pre-concurrency-group metadata
         fn_key, methods = meta
-        return fn_key, methods, {}, []
+        return fn_key, methods, {}, [], -1
+    if len(meta) == 4:  # pre-max_pending_calls metadata
+        return (*meta, -1)
     return meta

@@ -103,3 +103,8 @@ class RuntimeEnvSetupError(RayError):
 
 class PlacementGroupSchedulingError(RayError):
     pass
+
+
+class PendingCallsLimitExceeded(RayError):
+    """Submitting to an actor whose max_pending_calls bound is full
+    (reference ray.exceptions.PendingCallsLimitExceeded)."""

@@ -27,6 +27,26 @@ _TASK_OPTIONS = {
 }
 
 
+_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "py_modules"}
+
+
+def validate_runtime_env(renv):
+    """Reject runtime_env fields this runtime doesn't implement
+    (reference supports pip/conda/container via a per-node agent;
+    package installation is unsupported here) — accepting and silently
+    ignoring them would be worse than failing fast."""
+    if renv is None:
+        return None
+    bad = set(renv) - _RUNTIME_ENV_KEYS
+    if bad:
+        raise ValueError(
+            f"unsupported runtime_env field(s) {sorted(bad)}; this "
+            f"runtime implements {sorted(_RUNTIME_ENV_KEYS)} "
+            f"(pip/conda/container need package installation, which "
+            f"is not available)")
+    return renv
+
+
 def build_resources(options: Dict[str, Any],
                     default_num_cpus: float = 1.0) -> Dict[str, float]:
     resources = dict(options.get("resources") or {})
@@ -124,7 +144,7 @@ class RemoteFunction:
             scheduling_strategy=strategy,
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=validate_runtime_env(opts.get("runtime_env")),
             name=opts.get("name") or self._fn.__name__)
         spec.dynamic_returns = dynamic
         refs = cw.submit_task(spec)

@@ -118,3 +118,70 @@ def test_named_actor_handle_carries_method_groups():
     thread = ray_tpu.get(b.ping.remote(), timeout=30)
     assert thread.startswith("exec-io")
     ray_tpu.kill(a)
+
+
+def test_max_pending_calls_backpressure():
+    """reference max_pending_calls (_private/ray_option_utils.py):
+    submitting past the bound raises PendingCallsLimitExceeded."""
+    import time
+
+    import ray_tpu.exceptions as exc
+
+    @ray_tpu.remote
+    class Slow:
+        def work(self, marker):
+            time.sleep(5.0)
+            return marker
+
+        def fast(self):
+            return "ok"
+
+    a = Slow.options(max_pending_calls=2).remote()
+    r1 = a.work.remote(1)
+    r2 = a.work.remote(2)
+    with pytest.raises(exc.PendingCallsLimitExceeded):
+        a.work.remote(3)
+    # the limit clears as calls finish
+    assert ray_tpu.get(r1, timeout=120) == 1
+    assert ray_tpu.get(r2, timeout=120) == 2
+    r4 = a.work.remote(4)
+    assert ray_tpu.get(r4, timeout=120) == 4
+    ray_tpu.kill(a)
+
+
+def test_unsupported_runtime_env_rejected():
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        f.options(runtime_env={"pip": ["requests"]}).remote()
+
+    @ray_tpu.remote
+    class A:
+        def g(self):
+            return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        A.options(runtime_env={"conda": "env"}).remote()
+
+
+def test_named_lookup_carries_max_pending_calls():
+    import time
+
+    import ray_tpu.exceptions as exc
+
+    @ray_tpu.remote
+    class Slow2:
+        def work(self):
+            time.sleep(4.0)
+            return 1
+
+    a = Slow2.options(name="bounded", max_pending_calls=1).remote()
+    b = ray_tpu.get_actor("bounded")
+    assert b._max_pending_calls == 1
+    r = b.work.remote()
+    with pytest.raises(exc.PendingCallsLimitExceeded):
+        b.work.remote()
+    assert ray_tpu.get(r, timeout=120) == 1
+    ray_tpu.kill(a)
