@@ -4,7 +4,7 @@ reference: python/ray/rllib — Algorithm/Learner/RLModule/EnvRunner stack
 (SURVEY.md §2.3). Learners are JIT'd XLA programs; EnvRunners stay CPU
 actors streaming trajectories through the object store (BASELINE.json
 north star). Algorithms shipped: PPO, IMPALA, APPO, DQN, SAC, MARWIL,
-BC, ES, PG, TD3 (the reference's 34-algo registry is tracked in SURVEY.md §8.3).
+BC, ES, PG, TD3, DDPG (the reference's 34-algo registry is tracked in SURVEY.md §8.3).
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
@@ -14,6 +14,7 @@ from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.es.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.pg.pg import PG, PGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.td3.td3 import TD3, TD3Config  # noqa: F401
+from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPG, DDPGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil.marwil import (BC, MARWIL,  # noqa: F401
                                                     BCConfig, MARWILConfig)
 from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig  # noqa: F401
@@ -39,6 +40,7 @@ __all__ = [
     "ImpalaConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
     "ES", "ESConfig", "PG", "PGConfig", "TD3", "TD3Config",
+    "DDPG", "DDPGConfig",
     "get_algorithm_class",
     "registered_algorithms", "Learner", "LearnerGroup", "RLModule",
     "DiscreteMLPModule", "DiscreteConvModule", "Env", "register_env",

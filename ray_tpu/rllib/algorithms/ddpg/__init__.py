@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPG, DDPGConfig
+
+__all__ = ["DDPG", "DDPGConfig"]

@@ -13,6 +13,7 @@ from typing import Tuple, Type
 
 def _registry():
     from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
+    from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPG, DDPGConfig
     from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
     from ray_tpu.rllib.algorithms.es.es import ES, ESConfig
@@ -34,6 +35,7 @@ def _registry():
         "ES": (ES, ESConfig),
         "PG": (PG, PGConfig),
         "TD3": (TD3, TD3Config),
+        "DDPG": (DDPG, DDPGConfig),
     }
 
 

@@ -146,3 +146,24 @@ class TestTD3:
                 break
         algo.stop()
         assert best >= -300.0, f"TD3 failed to learn Pendulum: {best}"
+
+
+class TestDDPG:
+    def test_ddpg_compiles_and_steps(self):
+        from ray_tpu.rllib import DDPGConfig
+        algo = (DDPGConfig()
+                .environment("Pendulum-v1")
+                .env_runners(num_envs_per_env_runner=2,
+                             rollout_fragment_length=8)
+                .training(buffer_size=2000, train_batch_size=32,
+                          training_intensity=2.0,
+                          num_steps_sampled_before_learning_starts=32)
+                .rl_module(model_hiddens=(32, 32))
+                .debugging(seed=0)
+                .build())
+        assert algo.config.policy_delay == 1
+        assert algo.config.target_noise == 0.0
+        for _ in range(3):
+            result = algo.train()
+        assert "critic_loss" in result["learner"]
+        algo.stop()

@@ -51,10 +51,18 @@ def test_many_actors_round_trip():
             self.n += 1
             return self.n
 
-    actors = [Counter.options(num_cpus=0.05).remote(i * 100)
-              for i in range(20)]
+    # two waves of 10: creating 20 worker processes at once exceeds the
+    # GCS actor-scheduling deadline on a loaded single-core CI box
+    # (spawn is ~1-3s each, serialized); waves keep the envelope claim
+    # (20 live actors) without racing the deadline
+    actors = []
+    for wave in range(2):
+        batch = [Counter.options(num_cpus=0.05).remote(i * 100)
+                 for i in range(wave * 10, wave * 10 + 10)]
+        ray_tpu.get([a.bump.remote() for a in batch], timeout=600)
+        actors.extend(batch)
     out = ray_tpu.get([a.bump.remote() for a in actors], timeout=600)
-    assert out == [i * 100 + 1 for i in range(20)]
+    assert out == [i * 100 + 2 for i in range(20)]
     for a in actors:
         ray_tpu.kill(a)
 
