@@ -88,7 +88,7 @@ class TestClusterBasics:
                     placement_group=pg, placement_group_bundle_index=i),
                 num_cpus=1).remote()
             for i in range(3)
-        ], timeout=60)
+        ], timeout=180)  # 3 cold workers on 3 nodes, loaded 1-core box
         assert len(set(nodes)) == 3, nodes
         remove_placement_group(pg)
 
