@@ -253,6 +253,55 @@ class Dataset:
         count_remote = ray_tpu.remote(_count_rows)
         return sum(ray_tpu.get([count_remote.remote(r) for r in refs]))
 
+    # whole-dataset aggregates (reference Dataset.sum/min/max/mean/std):
+    # per-block partials via tiny tasks, combined on the driver
+    def _agg(self, on: str, kind: str):
+        mat = self.materialize()
+        remote = ray_tpu.remote(_block_partial_agg)
+        parts = [p for p in ray_tpu.get(
+            [remote.remote(r, on, kind) for r in mat._refs])
+            if p is not None]
+        if not parts:
+            raise ValueError(f"cannot aggregate empty dataset on {on!r}")
+        if kind == "sum":
+            return sum(p[0] for p in parts)
+        if kind == "min":
+            return min(p[0] for p in parts)
+        if kind == "max":
+            return max(p[0] for p in parts)
+        if kind == "mean":
+            n = sum(p[1] for p in parts)
+            return sum(p[0] for p in parts) / n
+        # std: merge per-block (n, mean, M2) with Chan's parallel
+        # update — a global E[x^2]-mean^2 would cancel catastrophically
+        # for large-mean data. ddof=1 (sample std) matches the
+        # reference Dataset.std and this repo's GroupedData.std.
+        n, mean, m2 = parts[0]
+        for nb, mb, m2b in parts[1:]:
+            delta = mb - mean
+            tot = n + nb
+            mean += delta * nb / tot
+            m2 += m2b + delta * delta * n * nb / tot
+            n = tot
+        if n < 2:
+            return 0.0
+        return float(np.sqrt(m2 / (n - 1)))
+
+    def sum(self, on: str):  # noqa: A003
+        return self._agg(on, "sum")
+
+    def min(self, on: str):  # noqa: A003
+        return self._agg(on, "min")
+
+    def max(self, on: str):  # noqa: A003
+        return self._agg(on, "max")
+
+    def mean(self, on: str):
+        return self._agg(on, "mean")
+
+    def std(self, on: str):
+        return self._agg(on, "std")
+
     def schema(self) -> Dict[str, str]:
         for blk in self.iter_blocks():
             if block_mod.block_num_rows(blk):
@@ -292,6 +341,25 @@ class MaterializedDataset(Dataset):
 
 def _count_rows(blk: Block) -> int:
     return block_mod.block_num_rows(blk)
+
+
+def _block_partial_agg(blk: Block, on: str, kind: str):
+    """Per-block partials; None if empty. sum/min/max: (value,);
+    mean: (total, count); std: (count, mean, M2)."""
+    if not block_mod.block_num_rows(blk):
+        return None
+    col = np.asarray(blk[on])
+    if kind == "sum":
+        return (col.sum(),)
+    if kind == "min":
+        return (col.min(),)
+    if kind == "max":
+        return (col.max(),)
+    if kind == "mean":
+        return (float(col.sum()), int(col.size))
+    mean = float(col.mean())
+    m2 = float(((col.astype(np.float64) - mean) ** 2).sum())
+    return (int(col.size), mean, m2)
 
 
 def _sample_keys(blk: Block, key: str, max_samples: int = 100

@@ -181,3 +181,26 @@ class TestTorchBatches:
         assert all(isinstance(b["x"], torch.Tensor) for b in batches)
         total = torch.cat([b["x"] for b in batches])
         assert total.shape == (10,)
+
+
+class TestDatasetAggregates:
+    def test_sum_min_max_mean_std(self):
+        vals = np.arange(100, dtype=np.float64)
+        ds = rdata.from_numpy({"x": vals}, parallelism=4)
+        assert ds.sum("x") == vals.sum()
+        assert ds.min("x") == 0.0
+        assert ds.max("x") == 99.0
+        assert ds.mean("x") == pytest.approx(vals.mean())
+        # ddof=1 (sample std), matching the reference and groupby
+        assert ds.std("x") == pytest.approx(vals.std(ddof=1))
+
+    def test_std_large_mean_numerically_stable(self):
+        # E[x^2]-mean^2 would cancel to 0 here; Welford merging must not
+        vals = 1e8 + np.arange(10, dtype=np.float64)
+        ds = rdata.from_numpy({"x": vals}, parallelism=3)
+        assert ds.std("x") == pytest.approx(vals.std(ddof=1), rel=1e-6)
+
+    def test_aggregate_with_empty_blocks(self):
+        ds = rdata.from_numpy({"x": np.arange(3.0)}).repartition(6)
+        assert ds.sum("x") == 3.0
+        assert ds.mean("x") == pytest.approx(1.0)
