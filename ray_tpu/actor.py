@@ -203,9 +203,9 @@ class ActorClass:
         capped lower here because each in-flight call holds an exec
         thread while its coroutine runs on the shared loop)."""
         import inspect
-        for m in vars(self._cls).values():
-            if inspect.iscoroutinefunction(m):
-                return 100
+        for _, m in inspect.getmembers(
+                self._cls, inspect.iscoroutinefunction):
+            return 100
         return 1
 
     def remote(self, *args: Any, **kwargs: Any) -> ActorHandle:

@@ -36,13 +36,26 @@ class Dataset:
 
     # -- transforms (lazy, fused per block) ---------------------------
 
-    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
-        return Dataset(self._inputs, self._ops + [("map", fn)])
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]], *,
+            num_cpus: Optional[float] = None) -> "Dataset":
+        return Dataset(self._inputs,
+                       self._boundary(num_cpus) + [("map", fn)])
 
     def map_batches(self, fn: Callable[[Block], Block], *,
-                    batch_size: Optional[int] = None) -> "Dataset":
+                    batch_size: Optional[int] = None,
+                    num_cpus: Optional[float] = None) -> "Dataset":
         return Dataset(self._inputs,
-                       self._ops + [("map_batches", fn, batch_size)])
+                       self._boundary(num_cpus)
+                       + [("map_batches", fn, batch_size)])
+
+    def _boundary(self, num_cpus: Optional[float]) -> List:
+        """Ops with their own resource request start a new (unfused)
+        pipeline stage — the reference's operator-fusion rule (operators
+        with unequal resource requests don't fuse; streaming_executor
+        then runs them as separate bounded operators)."""
+        if num_cpus is None:
+            return list(self._ops)
+        return self._ops + [("boundary", num_cpus)]
 
     def flat_map(self, fn: Callable[[Dict[str, Any]], Sequence[Dict]]
                  ) -> "Dataset":

@@ -164,7 +164,17 @@ class Transformer:
         constrain = functools.partial(
             with_logical_constraint, mesh=mesh, rules=rules)
 
-        x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+        # Constrain the lookup operand's embed dim to the ACTIVATION
+        # sharding (replicated / tensor) rather than the param's fsdp
+        # sharding: with the table's feature dim matching the output
+        # layout, the gather partitions on the (batch/seq-sharded) index
+        # dims directly. Leaving it fsdp-sharded makes SPMD emit a
+        # d-sharded gather then an "involuntary full rematerialization"
+        # to reshard d->batch/seq. This is the FSDP gather-at-use
+        # pattern: fwd all-gathers the table's d shards, bwd
+        # reduce-scatters the grad.
+        emb = constrain(params["embed"], ("vocab", "act_embed"))
+        x = jnp.take(emb, tokens, axis=0).astype(cdt)
         x = constrain(x, ("batch", "seq", "act_embed"))
 
         attn_fn = Transformer._make_attention(cfg, mesh, rules)
@@ -184,13 +194,12 @@ class Transformer:
                 k, v = kv[:, :, 0], kv[:, :, 1]
             q = _rope(q, cos, sin)
             k = _rope(k, cos, sin)
-            if cfg.kv_heads != cfg.n_heads:
-                rep = cfg.n_heads // cfg.kv_heads
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            # GQA: k/v keep their true kv_heads width end-to-end — the
+            # attention ops broadcast per group internally (ring then
+            # rotates Hkv-wide tensors over ICI, not Hq-wide repeats)
             q = constrain(q, ("batch", "seq", "heads", "head_dim"))
-            k = constrain(k, ("batch", "seq", "heads", "head_dim"))
-            v = constrain(v, ("batch", "seq", "heads", "head_dim"))
+            k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
+            v = constrain(v, ("batch", "seq", "kv_heads", "head_dim"))
             o = attn_fn(q, k, v, scale)
             # name the (pallas) attention output so the "dots" remat
             # policy can save it — it isn't a dot, and recomputing the
@@ -261,20 +270,51 @@ class Transformer:
         if impl == "flash" and not seq_unsharded:
             raise ValueError("attention_impl='flash' requires an unsharded "
                              "seq axis; use ring/ulysses for SP")
-        # [B, T, H, D] spec shared by every shard_map path; only the seq
+        # [B, T, H, D] specs shared by every shard_map path; only the seq
         # entry differs (sharded for ring/ulysses SP, local for flash).
-        def qkv_spec(seq_entry):
-            return P(rules.mesh_axes("batch"), seq_entry,
-                     rules.mesh_axes("heads"), None)
+        # k/v get their own spec so GQA kv heads shard by the kv_heads
+        # rule without being repeated to query-head width — UNLESS the
+        # backing mesh axis doesn't divide kv_heads (TP degree > kv
+        # heads), in which case k/v are widened to query heads first
+        # (the pre-round-4 behavior) so shard_map can still split them.
+        def axis_size(logical):
+            ax = rules.mesh_axes(logical)
+            if ax is None:
+                return 1
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            size = 1
+            for a in axes:
+                size *= mesh.shape.get(a, 1)
+            return size
 
-        def shard_mapped(body, spec, **shard_map_kw):
+        kv_narrow = (mesh is not None and cfg.kv_heads != cfg.n_heads
+                     and cfg.kv_heads % axis_size("kv_heads") == 0)
+        kv_axis = "kv_heads" if (kv_narrow or cfg.kv_heads == cfg.n_heads) \
+            else "heads"
+
+        def maybe_widen(fn):
+            if kv_axis == "kv_heads":
+                return fn
+            import jax.numpy as jnp
+            rep = cfg.n_heads // cfg.kv_heads
+
+            def widened(q, k, v, scale):
+                return fn(q, jnp.repeat(k, rep, axis=2),
+                          jnp.repeat(v, rep, axis=2), scale)
+            return widened
+
+        def qkv_spec(seq_entry, head_axis="heads"):
+            return P(rules.mesh_axes("batch"), seq_entry,
+                     rules.mesh_axes(head_axis), None)
+
+        def shard_mapped(body, spec, kv_spec, **shard_map_kw):
             def wrapped(q, k, v, scale):
                 fn = jax.shard_map(
                     functools.partial(body, scale=scale), mesh=mesh,
-                    in_specs=(spec, spec, spec), out_specs=spec,
+                    in_specs=(spec, kv_spec, kv_spec), out_specs=spec,
                     **shard_map_kw)
                 return fn(q, k, v)
-            return wrapped
+            return maybe_widen(wrapped)
 
         if impl in ("dense", "flash") or seq_unsharded:
             local = flash_attention if impl == "flash" else dense_attention
@@ -282,7 +322,9 @@ class Transformer:
             if impl == "flash" and mesh is not None:
                 # pallas kernels don't GSPMD-partition; run per-shard under
                 # shard_map with batch/heads sharded as the constraints say.
-                return shard_mapped(body, qkv_spec(None), check_vma=False)
+                return shard_mapped(body, qkv_spec(None),
+                                    qkv_spec(None, kv_axis),
+                                    check_vma=False)
             return lambda q, k, v, scale: body(q, k, v, scale=scale)
 
         from ray_tpu.parallel.ring import ring_attention
@@ -292,7 +334,8 @@ class Transformer:
         # SP composes with TP instead of all-gathering Q/K/V heads.
         sp = ring_attention if impl == "ring" else ulysses_attention
         return shard_mapped(functools.partial(sp, causal=True),
-                            qkv_spec(AXIS_SEQ))
+                            qkv_spec(AXIS_SEQ),
+                            qkv_spec(AXIS_SEQ, kv_axis))
 
     # ---- loss -------------------------------------------------------
     @staticmethod

@@ -11,23 +11,64 @@ from __future__ import annotations
 from typing import Optional
 
 
+def gqa_scores(q, k, scale):
+    """Scores [B, Hq, Tq, Tk] (f32) for MHA or GQA inputs.
+
+    q [B,Tq,Hq,D], k [B,Tk,Hkv,D] with Hkv | Hq. GQA contracts via a
+    grouped einsum — K is never materialized at Hq width. Head order
+    convention: q head h attends to kv head h // (Hq//Hkv), i.e. query
+    heads are contiguous per kv group (same as jnp.repeat on axis 2).
+    """
+    import jax.numpy as jnp
+
+    b, tq, hq, d = q.shape
+    hkv, tk = k.shape[2], k.shape[1]
+    if hq == hkv:
+        return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                          preferred_element_type=jnp.float32) * scale
+    if hq % hkv:
+        raise ValueError(
+            f"GQA needs kv heads ({hkv}) to divide query heads ({hq})")
+    rep = hq // hkv
+    qg = q.reshape(b, tq, hkv, rep, d)
+    s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    return s.reshape(b, hq, tq, tk)
+
+
+def gqa_pv(p, v):
+    """probs [B, Hq, Tq, Tk] @ v [B, Tk, Hkv, D] -> [B, Tq, Hq, D] (f32
+    accumulation), grouped for GQA like gqa_scores."""
+    import jax.numpy as jnp
+
+    b, hq, tq, tk = p.shape
+    hkv, d = v.shape[2], v.shape[3]
+    if hq == hkv:
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                          preferred_element_type=jnp.float32)
+    rep = hq // hkv
+    pg = p.reshape(b, hkv, rep, tq, tk)
+    o = jnp.einsum("bgrqk,bkgd->bqgrd", pg, v,
+                   preferred_element_type=jnp.float32)
+    return o.reshape(b, tq, hq, d)
+
+
 def dense_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None):
-    """Multi-head attention on [batch, seq, heads, head_dim] arrays."""
+    """Multi-head / grouped-query attention on [batch, seq, heads,
+    head_dim] arrays; k/v may carry fewer (kv) heads than q."""
     import jax
     import jax.numpy as jnp
 
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    s = gqa_scores(q, k, scale)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((tq, tk), bool))
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+    return gqa_pv(p, v).astype(q.dtype)
 
 
 def _flash_supported(t: int, head_dim: int) -> bool:
@@ -54,6 +95,12 @@ def flash_attention(q, k, v, *, causal: bool = True,
     platform = jax.devices()[0].platform
     if platform != "tpu" or not _flash_supported(t, d):
         return dense_attention(q, k, v, causal=causal, scale=scale)
+    if k.shape[2] != h:
+        # the pallas kernel wants equal head counts; materialize the
+        # GQA repeat only on this (single-device-local) path
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
 
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as _pallas_flash)

@@ -26,14 +26,17 @@ def _block_attention(q, k, v, bias, causal, q_offset, k_offset, scale):
     """One blockwise attention contribution with running-max bookkeeping.
 
     Returns (unnormalized_out, row_max, row_sumexp) for online-softmax
-    merging across blocks. Shapes: q [B, Tq, H, D]; k, v [B, Tk, H, D].
+    merging across blocks. Shapes: q [B, Tq, Hq, D]; k, v [B, Tk, Hkv, D]
+    with Hkv | Hq (GQA contracts grouped — kv heads are never repeated,
+    so the ring rotates only the true kv tensors).
     """
     import jax
     import jax.numpy as jnp
 
-    # [B, H, Tq, Tk] scores on the MXU; accumulate in f32.
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    from ray_tpu.ops.attention import gqa_pv, gqa_scores
+
+    # [B, Hq, Tq, Tk] scores on the MXU; accumulate in f32.
+    s = gqa_scores(q, k, scale)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         q_pos = q_offset + jnp.arange(tq)
@@ -42,13 +45,12 @@ def _block_attention(q, k, v, bias, causal, q_offset, k_offset, scale):
         s = jnp.where(mask[None, None], s, -jnp.inf)
     if bias is not None:
         s = s + bias
-    m = jnp.max(s, axis=-1)                       # [B, H, Tq]
+    m = jnp.max(s, axis=-1)                       # [B, Hq, Tq]
     # Guard fully-masked rows (all -inf): exp(-inf - -inf) would be NaN.
     m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
-    p = jnp.exp(s - m_safe[..., None])            # [B, H, Tq, Tk]
-    l = jnp.sum(p, axis=-1)                       # [B, H, Tq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                   preferred_element_type=jnp.float32)
+    p = jnp.exp(s - m_safe[..., None])            # [B, Hq, Tq, Tk]
+    l = jnp.sum(p, axis=-1)                       # [B, Hq, Tq]
+    o = gqa_pv(p.astype(v.dtype), v)
     return o, m_safe, l, jnp.isneginf(m)
 
 
@@ -62,8 +64,10 @@ def ring_attention(q, k, v, *,
     sequence dimension of q/k/v is already the local shard. Layout is
     [batch, seq_local, heads, head_dim]. Supports causal masking with
     correct global positions (each shard knows its ring index via
-    `lax.axis_index`). GQA is handled by the caller repeating K/V heads
-    (cheap: K/V are small) or by ulysses_attention.
+    `lax.axis_index`). GQA: pass k/v with their true kv_heads — the
+    block computation broadcasts per group internally, so the ring
+    rotates Hkv-wide tensors (Hq/Hkv times less ICI traffic than
+    repeating K/V to full head width).
     """
     import jax
     import jax.numpy as jnp

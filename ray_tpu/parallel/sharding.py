@@ -80,22 +80,39 @@ DEFAULT_RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
 
 
 def logical_sharding(logical_spec: LogicalSpec, mesh,
-                     rules: Optional[ShardingRules] = None):
-    """NamedSharding for one array given its logical spec."""
+                     rules: Optional[ShardingRules] = None,
+                     shape: Optional[Tuple[int, ...]] = None):
+    """NamedSharding for one array given its logical spec.
+
+    When `shape` is known, entries whose mesh-axis product does not
+    divide the dimension degrade to replicated — e.g. 2 kv heads with
+    rules mapping kv_heads -> a 4-wide tensor axis keep the kv-head dim
+    replicated instead of erroring (the matching compute path then
+    widens K/V to query heads; see models/transformer._make_attention).
+    """
     import jax
     rules = rules or ShardingRules()
     # Drop mesh axes of size 1 from specs: XLA treats them as replicated
     # anyway, and it keeps specs valid on degenerate meshes (e.g. 1 chip).
     spec = rules.spec(logical_spec)
     cleaned = []
-    for entry in spec:
+    for d, entry in enumerate(spec):
         if entry is None:
             cleaned.append(None)
-        elif isinstance(entry, tuple):
+            continue
+        if isinstance(entry, tuple):
             kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
-            cleaned.append(kept if kept else None)
-        else:
-            cleaned.append(entry if mesh.shape.get(entry, 1) > 1 else None)
+            entry = kept if kept else None
+        elif mesh.shape.get(entry, 1) <= 1:
+            entry = None
+        if entry is not None and shape is not None and d < len(shape):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            size = 1
+            for a in axes:
+                size *= mesh.shape.get(a, 1)
+            if size and shape[d] % size != 0:
+                entry = None
+        cleaned.append(entry)
     return jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(*cleaned))
 
@@ -126,7 +143,8 @@ def with_logical_constraint(x: Any, logical_spec: LogicalSpec,
     or when no mesh is available (keeps model code runnable un-sharded)."""
     import jax
     rules = rules or ShardingRules()
-    spec = rules.spec(logical_spec)  # KeyError on typo'd names: propagate
+    rules.spec(logical_spec)  # KeyError on typo'd names: propagate
+    shape = getattr(x, "shape", None)
     if mesh is None:
         try:
             env_mesh = jax.sharding.get_abstract_mesh()
@@ -134,7 +152,8 @@ def with_logical_constraint(x: Any, logical_spec: LogicalSpec,
             return x
         if env_mesh is None or not env_mesh.shape:
             return x
-        sharding = jax.sharding.NamedSharding(env_mesh, spec)
+        sharding = logical_sharding(logical_spec, env_mesh, rules,
+                                    shape=shape)
     else:
-        sharding = logical_sharding(logical_spec, mesh, rules)
+        sharding = logical_sharding(logical_spec, mesh, rules, shape=shape)
     return jax.lax.with_sharding_constraint(x, sharding)

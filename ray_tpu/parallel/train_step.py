@@ -52,7 +52,7 @@ def make_train_step(
     batch_sharding = jax.sharding.NamedSharding(
         mesh, rules.spec(batch_logical))
 
-    def _opt_shardings(params_shape):
+    def _opt_shardings(params_shape, fitted_p_shardings):
         # optax states are pytrees whose array leaves either mirror the
         # param tree (momenta: the leaf path *ends with* the param's path,
         # e.g. (0, 'mu', 'layers', 'wq') for param ('layers', 'wq')) or
@@ -64,7 +64,7 @@ def make_train_step(
         def path_key(path):
             return tuple(str(k) for k in path)
 
-        p_leaves = tree_flatten_with_path(p_shardings)[0]
+        p_leaves = tree_flatten_with_path(fitted_p_shardings)[0]
         by_path = {path_key(path): sh for path, sh in p_leaves}
         max_len = max((len(k) for k in by_path), default=0)
 
@@ -112,11 +112,31 @@ def make_train_step(
                    "step": new_state["step"], **aux}
         return new_state, metrics
 
+    def _fit(sharding, leaf):
+        # degrade non-dividing spec entries to replicated (e.g. kv_heads
+        # narrower than the tensor axis); mirrors logical_sharding's
+        # shape-aware cleanup for the constraint path
+        shape = getattr(leaf, "shape", ())
+        spec = sharding.spec
+        new = []
+        for d, entry in enumerate(spec):
+            if entry is not None and d < len(shape):
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                size = 1
+                for a in axes:
+                    size *= mesh.shape.get(a, 1)
+                if size and shape[d] % size != 0:
+                    entry = None
+            new.append(entry)
+        return jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(*new))
+
     def make_state_shardings(params):
         params_shape = jax.eval_shape(lambda x: x, params)
+        fitted = jax.tree.map(_fit, p_shardings, params_shape)
         return {
-            "params": p_shardings,
-            "opt_state": _opt_shardings(params_shape),
+            "params": fitted,
+            "opt_state": _opt_shardings(params_shape, fitted),
             "step": replicated,
         }
 

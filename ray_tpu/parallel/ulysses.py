@@ -42,6 +42,8 @@ def ulysses_attention(q, k, v, *,
     import jax
     from jax import lax
 
+    import jax.numpy as jnp
+
     sp = lax.axis_size(axis_name)
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -50,6 +52,13 @@ def ulysses_attention(q, k, v, *,
             f"heads ({q.shape[2]}) must be divisible by seq-parallel "
             f"size {sp}; "
             "use ring_attention for head counts below the seq axis size")
+    if k.shape[2] != q.shape[2] and k.shape[2] % sp != 0:
+        # GQA with kv_heads not divisible by the seq axis: widen K/V to
+        # query heads before the all-to-all (the divisible case below
+        # moves only the true kv heads)
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
 
     # [B, T/sp, H, D] -> [B, T, H/sp, D]: split heads (axis 2), gather seq
     # (axis 1).

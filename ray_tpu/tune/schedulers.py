@@ -186,8 +186,16 @@ class PopulationBasedTraining:
             elif isinstance(cur, (int, float)):
                 # continuous space: scale by 1.2 / 0.8
                 factor = 1.2 if self._rng.random() < 0.5 else 0.8
-                out[key] = type(cur)(cur * factor) \
-                    if isinstance(cur, float) else max(1, int(cur * factor))
+                if isinstance(cur, float):
+                    out[key] = type(cur)(cur * factor)
+                else:
+                    nxt = int(round(cur * factor))
+                    if nxt == cur:
+                        # small ints: truncation would pin the value
+                        # forever; force a step of 1 in the chosen
+                        # direction instead
+                        nxt = cur + 1 if factor > 1 else cur - 1
+                    out[key] = max(1, nxt)
             else:
                 out[key] = self._resample(space)
         return out

@@ -54,6 +54,19 @@ class _TrialRunner:
     def save(self, checkpoint_dir: str) -> str:
         return self._t.save(checkpoint_dir)
 
+    def save_auto(self, trial_dir: str) -> str:
+        """Save under checkpoint_{iteration} named from the trainable's
+        OWN iteration at save time. Used when the controller cannot know
+        the iteration in advance (a train() is still in flight ahead of
+        this call in the actor's queue, so controller-side naming would
+        be one iteration behind the contents)."""
+        import os
+        # Trainable exposes .iteration; RLlib Algorithm keeps _iteration
+        it = getattr(self._t, "iteration",
+                     getattr(self._t, "_iteration", 0))
+        return self._t.save(os.path.join(
+            trial_dir, f"checkpoint_{int(it):06d}"))
+
     def restore(self, checkpoint_dir: str) -> None:
         self._t.restore(checkpoint_dir)
 
@@ -306,9 +319,10 @@ class TuneController:
         try:
             if src.state == RUNNING and src.actor is not None:
                 # actor calls are ordered: save runs after the source's
-                # in-flight train() completes
+                # in-flight train() completes, so the actor (not the
+                # controller) must pick the checkpoint_{iteration} name
                 src.checkpoint_dir = ray_tpu.get(
-                    src.actor.save.remote(self._next_ckpt_dir(src)),
+                    src.actor.save_auto.remote(src.trial_dir),
                     timeout=300)
         except Exception:  # noqa: BLE001
             logger.warning("PBT source snapshot failed for %s",

@@ -118,3 +118,48 @@ def test_train_get_dataset_shard(ray_start):
     # rank-0 metrics carry rank 0's ids; disjointness checked via count
     ids0 = history[-1]["ids"]
     assert len(ids0) == 16 and len(set(ids0)) == 16
+
+
+def test_two_stage_pipeline_bounded_intermediates(ray_start):
+    """VERDICT r4 #4: a 100-block dataset through a 2-STAGE (unfused)
+    map pipeline streams with peak live intermediate refs bounded by
+    the per-stage caps — stage 2 consumes stage-1 blocks as they
+    finish, no materialization barrier between stages."""
+    ds = (rd.range(400, parallelism=100)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .map_batches(lambda b: {"id": b["id"] + 1}, num_cpus=0.5))
+    ex = StreamingExecutor(ds._inputs, ds._ops, max_in_flight_blocks=3)
+    assert len(ex.stages) == 2, [st.ops for st in ex.stages]
+    total = 0
+    for ref in ex.execute():
+        blk = ray_tpu.get(ref)
+        total += len(blk["id"])
+    assert total == 400
+    # two stages x cap 3 = at most 6 live intermediates at any moment
+    assert ex.peak_in_flight <= 6, (
+        f"streaming property violated: {ex.peak_in_flight} live blocks")
+    # correctness: values are id*2+1
+    vals = sorted(r["id"] for r in rd.range(10, parallelism=2)
+                  .map_batches(lambda b: {"id": b["id"] * 2})
+                  .map_batches(lambda b: {"id": b["id"] + 1}, num_cpus=0.5)
+                  .iter_rows())
+    assert vals == [2 * i + 1 for i in range(10)]
+
+
+def test_stage_boundary_resources_propagate(ray_start):
+    # explicit num_cpus starts a new stage; a following op with no
+    # request FUSES into it (the reference's fusion rule)
+    fused = (rd.range(8, parallelism=2)
+             .map(lambda r: {"id": r["id"]}, num_cpus=0.25)
+             .map(lambda r: {"id": r["id"] + 1}))
+    ex = StreamingExecutor(fused._inputs, fused._ops)
+    assert len(ex.stages) == 1
+    assert ex.stages[0].num_cpus == 0.25
+    # unequal requests -> separate stages carrying their own resources
+    split = (rd.range(8, parallelism=2)
+             .map(lambda r: {"id": r["id"]}, num_cpus=0.25)
+             .map(lambda r: {"id": r["id"] + 1}, num_cpus=0.5))
+    ex2 = StreamingExecutor(split._inputs, split._ops)
+    assert [st.num_cpus for st in ex2.stages] == [0.25, 0.5]
+    got = sorted(r["id"] for r in split.iter_rows())
+    assert got == [i + 1 for i in range(8)]

@@ -73,3 +73,49 @@ class TestLongContextRing:
         out = jax.block_until_ready(_ring(mesh)(q, k, v))
         assert out.shape == (b, t, h, d)
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestRingGQA:
+    """Round-4: ring attention rotates TRUE kv heads (VERDICT r3 #9) —
+    GQA must not repeat K/V to query-head width before the ring."""
+
+    def test_ring_gqa_matches_dense(self):
+        mesh = make_mesh(MeshConfig(data=2, seq=4))
+        b, t, hq, hkv, d = 2, 256, 8, 2, 16
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.standard_normal((b, t, hq, d)),
+                        jnp.float32) * 0.1
+        k = jnp.asarray(rng.standard_normal((b, t, hkv, d)),
+                        jnp.float32) * 0.1
+        v = jnp.asarray(rng.standard_normal((b, t, hkv, d)),
+                        jnp.float32) * 0.1
+        out = _ring(mesh)(q, k, v)
+        # reference: dense attention with K/V explicitly repeated
+        rep = hq // hkv
+        ref = _dense_causal(q, jnp.repeat(k, rep, axis=2),
+                            jnp.repeat(v, rep, axis=2))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_ring_gqa_comm_volume_is_kv_width(self):
+        """The compiled SPMD program's collective-permutes (the K/V ring
+        hops) must carry kv_heads-wide tensors, not query-head-wide
+        repeats — Hq/Hkv x less ICI traffic at 7B-class GQA."""
+        import re
+        mesh = make_mesh(MeshConfig(data=2, seq=4))
+        b, t, hq, hkv, d = 2, 256, 8, 2, 16
+        shapes = [jax.ShapeDtypeStruct((b, t, h, d), jnp.float32)
+                  for h in (hq, hkv, hkv)]
+        compiled = _ring(mesh).lower(*shapes).compile()
+        text = compiled.as_text()
+        dims = re.findall(
+            r"f32\[([0-9,]+)\]\{[^}]*\} collective-permute", text)
+        assert dims, f"no collective-permute in program:\n{text[:2000]}"
+        t_local = t // 4
+        for shape in dims:
+            parts = [int(x) for x in shape.split(",")]
+            assert parts[1] == t_local, parts
+            assert parts[2] == hkv, (
+                f"ring rotated a {parts}-shaped tensor; kv head dim "
+                f"should be {hkv}, not {hq}")
+        assert len(dims) >= 2  # k and v both rotate

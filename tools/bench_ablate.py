@@ -1,0 +1,103 @@
+"""Ablation harness for the headline bench: times train-step variants
+to localize non-matmul overhead. Not part of the driver flow — dev tool.
+
+Usage: python tools/bench_ablate.py [name ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BATCH = 16
+WARMUP = 3
+STEPS = 10
+FLOPS_PER_TOKEN = 968e6
+
+
+def run_variant(name: str, *, n_heads=12, loss_chunk=256, batch=BATCH,
+                no_head=False, attention_impl="auto", scan_unroll=12,
+                remat=False):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import GPT2_125M, Transformer
+    from ray_tpu.parallel import MeshConfig, make_mesh
+    from ray_tpu.parallel.train_step import make_train_step
+
+    devices = jax.devices()
+    mesh = make_mesh(MeshConfig(data=-1), devices=devices)
+    cfg = GPT2_125M.replace(
+        n_heads=n_heads, remat=remat, remat_policy="dots",
+        attention_impl=attention_impl, scan_unroll=scan_unroll,
+        loss_chunk=loss_chunk)
+    params = Transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch * len(devices), cfg.max_seq_len + 1),
+        0, 50257)
+
+    if no_head:
+        def loss_fn(p, b):
+            h = Transformer.hidden(p, b["tokens"][:, :-1], cfg, mesh=mesh)
+            return jnp.mean(jnp.square(h.astype(jnp.float32)))
+    else:
+        def loss_fn(p, b):
+            return Transformer.loss(p, b, cfg, mesh=mesh)
+
+    init_state, train_step = make_train_step(
+        loss_fn, Transformer.param_specs(cfg), mesh,
+        optimizer=optax.adamw(1e-4, weight_decay=0.01))
+    state = init_state(params)
+    batch_d = {"tokens": tokens}
+    for _ in range(WARMUP):
+        state, metrics = train_step(state, batch_d)
+    jax.device_get(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = train_step(state, batch_d)
+    loss = float(jax.device_get(metrics["loss"]))
+    dt = (time.perf_counter() - t0) / STEPS
+    toks = batch * len(devices) * cfg.max_seq_len
+    tps = toks / dt
+    print(f"{name:28s} step={dt*1e3:7.1f}ms tok/s={tps:9.0f} "
+          f"tflops={tps*FLOPS_PER_TOKEN/1e12:6.1f} loss={loss:.4f}",
+          flush=True)
+    del state
+
+
+VARIANTS = {
+    "baseline": {},
+    "heads6": {"n_heads": 6},
+    "chunk512": {"loss_chunk": 512},
+    "heads6_chunk512": {"n_heads": 6, "loss_chunk": 512},
+    "nohead": {"no_head": True},
+    "nohead_heads6": {"no_head": True, "n_heads": 6},
+    "dense": {"attention_impl": "dense"},
+    "batch32": {"batch": 32},
+    "heads6_batch32": {"n_heads": 6, "batch": 32},
+    "chunk128": {"loss_chunk": 128},
+    "nochunk": {"loss_chunk": 0},
+    "heads6_b32_c512": {"n_heads": 6, "batch": 32, "loss_chunk": 512},
+    "heads6_dense_c512": {"n_heads": 6, "attention_impl": "dense",
+                          "loss_chunk": 512},
+    "heads6_nochunk": {"n_heads": 6, "loss_chunk": 0},
+    "heads4_c512": {"n_heads": 4, "loss_chunk": 512},
+}
+
+
+def main():
+    names = sys.argv[1:] or list(VARIANTS)
+    for n in names:
+        try:
+            run_variant(n, **VARIANTS[n])
+        except Exception as e:  # noqa: BLE001
+            print(f"{n:28s} FAILED: {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
