@@ -821,7 +821,24 @@ class CoreWorker:
         with self._lock:
             entry = self.tasks.get(h)
             duplicate = entry is None or entry.done
-            if not duplicate:
+            retrying = False
+            if (not duplicate and results and not dynamic_children
+                    and entry.retries_left > 0
+                    and entry.spec.task_type == TaskType.NORMAL_TASK
+                    and getattr(entry.spec, "retry_exceptions", False)
+                    and all(r and r[0] == ERROR for r in results)):
+                # application-error retry (reference
+                # TaskManager::RetryTaskIfPossible with retry_exceptions,
+                # task_manager.cc:869): only RayTaskError (user code
+                # raised) retries — cancellation/system errors don't.
+                try:
+                    err0 = pickle.loads(results[0][1])
+                except Exception:  # noqa: BLE001
+                    err0 = None
+                if isinstance(err0, exc.RayTaskError):
+                    entry.retries_left -= 1
+                    retrying = True
+            if not duplicate and not retrying:
                 entry.done = True
                 # submit-side backpressure accounting (max_pending_calls)
                 self._decr_actor_pending_locked(entry)
@@ -833,6 +850,16 @@ class CoreWorker:
                     ev = self.object_events.get(oid.hex())
                     if ev is not None:  # recovery getters waiting
                         ev.set()
+        if retrying:
+            if lease_id is not None:
+                self._return_lease(lease_id, entry,
+                                   reuse=not worker_exiting)
+            logger.warning(
+                "retrying task %s after application error, %d retries "
+                "left", entry.spec.function_name, entry.retries_left)
+            threading.Thread(target=self._request_lease,
+                             args=(entry.spec,), daemon=True).start()
+            return
         if duplicate:
             # Late/duplicate completion (e.g. after cancel or retry): the
             # first writer won; just hand back any lease that rode in —
@@ -1362,9 +1389,24 @@ class _Executor:
         self._threads: List[threading.Thread] = []
         # named concurrency groups: group -> dedicated task queue
         self._group_queues: Dict[str, "queue.Queue"] = {}
+        # per-group running-execution counts; queued + running is the
+        # server-side "ongoing" depth (reference: replica queue length
+        # probed by serve's PowerOfTwoChoicesReplicaScheduler,
+        # router.py:893)
+        self._running: Dict[str, int] = {}
         # per-function execution counts for max_calls worker recycling
         self._calls_by_fn: Dict[str, int] = {}
         self._spawn_exec_threads(1)
+
+    def queue_depth(self, group: str = "") -> int:
+        """Queued + currently-executing tasks for one concurrency group
+        (default group when unnamed). Readable from a DIFFERENT group's
+        thread even while this group is saturated."""
+        q = self._group_queues.get(group, self._queue) if group \
+            else self._queue
+        with self._lock:
+            running = self._running.get(group, 0)
+        return q.qsize() + running
 
     def _spawn_exec_threads(self, n: int) -> None:
         while len(self._threads) < n:
@@ -1426,7 +1468,7 @@ class _Executor:
             q: "queue.Queue" = queue.Queue()
             self._group_queues[group] = q
         for i in range(max(1, width)):
-            t = threading.Thread(target=self._exec_loop, args=(q,),
+            t = threading.Thread(target=self._exec_loop, args=(q, group),
                                  daemon=True,
                                  name=f"exec-{group}-{i}")
             t.start()
@@ -1435,16 +1477,22 @@ class _Executor:
     def cancel_task(self, task_id_hex: str) -> None:
         self._cancelled.add(task_id_hex)
 
-    def _exec_loop(self, q: Optional["queue.Queue"] = None) -> None:
+    def _exec_loop(self, q: Optional["queue.Queue"] = None,
+                   group: str = "") -> None:
         q = q if q is not None else self._queue
         while True:
             spec = q.get()
             if spec is None:
                 return
+            with self._lock:
+                self._running[group] = self._running.get(group, 0) + 1
             try:
                 self._execute(spec)
             except Exception:  # noqa: BLE001
                 logger.exception("executor crashed on %s", spec.function_name)
+            finally:
+                with self._lock:
+                    self._running[group] = self._running.get(group, 1) - 1
 
     def _resolve_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:
         args, kwargs = ser.unpack(memoryview(spec.args))

@@ -95,6 +95,8 @@ class NodeManager:
         self.node_id = NodeID.from_random()
         self.session_dir = session_dir
         self.gcs_address = tuple(gcs_address)
+        from ray_tpu._private.runtime_env import RuntimeEnvManager
+        self._runtime_env_mgr = RuntimeEnvManager()
         self._pool = rpc_lib.ClientPool(timeout=60)
         self._gcs = rpc_lib.RpcClient(self.gcs_address, timeout=60)
         self._lock = threading.Lock()
@@ -328,9 +330,12 @@ class NodeManager:
         caching): a worker started for one env must not serve tasks whose
         env_vars/working_dir/py_modules differ."""
         renv = spec.runtime_env or {}
+        from ray_tpu._private.runtime_env import pip_spec, pip_uri
+        pspec = pip_spec(renv)
         return repr((sorted((renv.get("env_vars") or {}).items()),
                      renv.get("working_dir"),
-                     tuple(renv.get("py_modules") or ())))
+                     tuple(renv.get("py_modules") or ()),
+                     pip_uri(pspec) if pspec else None))
 
     def _spawn_worker(self, runtime_env_key: str,
                       runtime_env: Optional[Dict[str, Any]]) -> _WorkerHandle:
@@ -359,6 +364,23 @@ class NodeManager:
             extra_paths.append(os.path.abspath(renv["working_dir"]))
         for mod in renv.get("py_modules") or ():
             extra_paths.append(os.path.dirname(os.path.abspath(mod)))
+        if renv.get("pip"):
+            # cached per-URI install; only the first worker of a given
+            # pip spec pays the install (reference pip.py + URI cache).
+            # Failure must not leak the _starting counters (that would
+            # wedge every future spawn for this env key) nor kill the
+            # dispatch loop — fail the env's queued leases instead
+            # (reference: runtime-env agent setup failure fails the
+            # lease with RuntimeEnvSetupError).
+            try:
+                site = self._runtime_env_mgr.setup_pip(renv)
+            except Exception as e:  # noqa: BLE001
+                logger.error("runtime_env setup failed for %s: %s",
+                             runtime_env_key, e)
+                self._fail_env_leases(runtime_env_key, str(e))
+                return None
+            if site:
+                extra_paths.append(site)
         if extra_paths:
             env["PYTHONPATH"] = os.pathsep.join(
                 extra_paths + [env.get("PYTHONPATH", "")])
@@ -377,6 +399,28 @@ class NodeManager:
         threading.Thread(target=self._monitor_worker, args=(handle,),
                          daemon=True).start()
         return handle
+
+    def _fail_env_leases(self, runtime_env_key: str, message: str) -> None:
+        """Runtime-env setup failed: release the spawn slot and fail
+        every queued lease whose env resolves to this key so callers
+        see the error instead of hanging."""
+        with self._lock:
+            self._starting = max(0, self._starting - 1)
+            self._starting_by_key[runtime_env_key] = max(
+                0, self._starting_by_key.get(runtime_env_key, 1) - 1)
+            doomed = [pl for pl in self.pending
+                      if pl.acquired is None
+                      and self._runtime_env_key(pl.spec) == runtime_env_key]
+            self.pending = [pl for pl in self.pending
+                            if pl not in doomed]
+        for pl in doomed:
+            try:
+                self._pool.get(pl.reply_to).call(
+                    "cw_task_failed", task_id=pl.spec.task_id,
+                    error_type="RUNTIME_ENV_SETUP_FAILED",
+                    message=message)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _monitor_worker(self, handle: _WorkerHandle) -> None:
         proc = handle.proc
@@ -769,6 +813,14 @@ class NodeManager:
                 "available": self.available.to_dict(),
                 "num_workers": len(self.workers),
                 "num_pending_leases": len(self.pending),
+                # resource shape per unplaced lease: the autoscaler's
+                # demand scheduler bin-packs these into candidate node
+                # types (reference resource_demand_scheduler.py)
+                "pending_resource_shapes": [
+                    dict(pl.spec.resources) if isinstance(
+                        pl.spec.resources, dict)
+                    else pl.spec.resources.to_dict()
+                    for pl in self.pending if pl.acquired is None],
                 "num_args_prefetched": self.num_args_prefetched,
             }
 

@@ -1,0 +1,128 @@
+"""Runtime-env plugins with URI caching.
+
+reference parity: python/ray/_private/runtime_env/pip.py (pip plugin:
+per-env package installs), plugin.py (plugin protocol), and the URI
+cache (uri_cache.py / working_dir URI reuse): each distinct pip spec
+hashes to a content URI; the install happens ONCE per node into a
+cache directory keyed by that URI, and every worker whose env carries
+the same spec just gets the cached site prepended to PYTHONPATH. The
+reference runs this in a per-node runtime-env agent; here the node
+manager calls it in-process before spawning the worker (same
+serialization point — worker spawn already happens on the node
+manager's spawn path).
+
+Installs run `pip install --target <cache>/<uri>` with
+`--no-build-isolation` so local source trees install without network
+(this environment has no egress; callers ship wheels/source dirs and
+pass `--no-index --find-links ...` via pip_args).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_CACHE = os.path.expanduser("~/.cache/ray_tpu/runtime_env")
+
+
+def pip_spec(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Normalize the pip field: list of requirements or
+    {"packages": [...], "pip_args": [...]} -> canonical dict."""
+    pip = (renv or {}).get("pip")
+    if pip is None:
+        return None
+    if isinstance(pip, (list, tuple)):
+        return {"packages": [str(p) for p in pip], "pip_args": []}
+    if isinstance(pip, dict):
+        return {"packages": [str(p) for p in pip.get("packages") or ()],
+                "pip_args": [str(a) for a in pip.get("pip_args") or ()]}
+    raise ValueError(f"runtime_env pip must be a list or dict, got {pip!r}")
+
+
+def pip_uri(spec: Dict[str, Any]) -> str:
+    """Content-hash URI for a pip spec (reference: pip.py get_uri)."""
+    blob = json.dumps(spec, sort_keys=True).encode()
+    py = f"py{sys.version_info.major}.{sys.version_info.minor}"
+    return f"pip-{py}-{hashlib.sha1(blob).hexdigest()[:20]}"
+
+
+class RuntimeEnvManager:
+    """Per-node plugin resolver with a content-addressed install cache."""
+
+    def __init__(self, cache_dir: str = _DEFAULT_CACHE):
+        self.cache_dir = cache_dir
+        self._locks: Dict[str, threading.Lock] = {}
+        self._guard = threading.Lock()
+
+    def _lock_for(self, uri: str) -> threading.Lock:
+        with self._guard:
+            return self._locks.setdefault(uri, threading.Lock())
+
+    def setup_pip(self, renv: Optional[Dict[str, Any]]) -> Optional[str]:
+        """Ensure the env's pip packages are installed in the cache;
+        returns the site dir to prepend to PYTHONPATH (None if no pip
+        field). Concurrent workers for the same URI serialize on a
+        lock; a `.ready` marker makes completed installs reusable
+        across node-manager restarts."""
+        spec = pip_spec(renv)
+        if spec is None or not spec["packages"]:
+            return None
+        uri = pip_uri(spec)
+        target = os.path.join(self.cache_dir, uri)
+        marker = os.path.join(target, ".ready")
+        with self._lock_for(uri):
+            if os.path.exists(marker):
+                self._touch(marker)
+                return target
+            os.makedirs(target, exist_ok=True)
+            cmd = [sys.executable, "-m", "pip", "install",
+                   "--quiet", "--no-build-isolation",
+                   "--target", target, *spec["pip_args"],
+                   *spec["packages"]]
+            logger.info("runtime_env pip install (%s): %s", uri,
+                        " ".join(spec["packages"]))
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"runtime_env pip install failed "
+                    f"({spec['packages']}): {proc.stderr[-2000:]}")
+            self._touch(marker)
+            return target
+
+    @staticmethod
+    def _touch(marker: str) -> None:
+        with open(marker, "w", encoding="utf-8") as f:
+            f.write(str(time.time()))
+
+    def gc(self, max_entries: int = 10) -> List[str]:
+        """Drop least-recently-used cached envs beyond max_entries
+        (reference: URI cache eviction). Returns removed URIs."""
+        import shutil
+        if not os.path.isdir(self.cache_dir):
+            return []
+        entries = []
+        for name in os.listdir(self.cache_dir):
+            marker = os.path.join(self.cache_dir, name, ".ready")
+            try:
+                with open(marker, encoding="utf-8") as f:
+                    stamp = float(f.read().strip() or 0)
+            except OSError:
+                stamp = 0.0
+            entries.append((stamp, name))
+        entries.sort(reverse=True)
+        removed = []
+        for _, name in entries[max_entries:]:
+            shutil.rmtree(os.path.join(self.cache_dir, name),
+                          ignore_errors=True)
+            removed.append(name)
+        return removed

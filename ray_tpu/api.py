@@ -177,6 +177,15 @@ class _RuntimeContext:
             return cw.executor.actor_id.hex()
         return None
 
+    def get_task_queue_depth(self, group: str = "") -> int:
+        """Queued + running tasks on this worker's executor for one
+        concurrency group — the server-side ongoing-request count serve
+        replicas report to the router (reference: replica queue-length
+        probes behind PowerOfTwoChoicesReplicaScheduler,
+        serve/_private/router.py:893)."""
+        ex = self.worker.core_worker.executor
+        return ex.queue_depth(group) if ex is not None else 0
+
     @property
     def was_current_actor_reconstructed(self) -> bool:
         return False

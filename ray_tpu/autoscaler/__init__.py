@@ -8,8 +8,15 @@ provider-free testing — here LocalNodeProvider spawns real node-manager
 processes on this machine.
 """
 
-from ray_tpu.autoscaler.autoscaler import (LocalNodeProvider,  # noqa: F401
+from ray_tpu.autoscaler.autoscaler import (FakeMultiNodeProvider,  # noqa: F401
+                                           GKETPUNodeProvider,
+                                           LocalNodeProvider,
                                            NodeProvider,
                                            StandardAutoscaler)
+from ray_tpu.autoscaler.demand_scheduler import (NodeType,  # noqa: F401
+                                                 PlacementGroupDemand,
+                                                 get_nodes_to_launch)
 
-__all__ = ["NodeProvider", "LocalNodeProvider", "StandardAutoscaler"]
+__all__ = ["NodeProvider", "LocalNodeProvider", "FakeMultiNodeProvider",
+           "GKETPUNodeProvider", "StandardAutoscaler", "NodeType",
+           "PlacementGroupDemand", "get_nodes_to_launch"]

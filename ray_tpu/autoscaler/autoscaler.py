@@ -79,6 +79,94 @@ class LocalNodeProvider(NodeProvider):
                 if n.handle.poll() is None]
 
 
+class FakeMultiNodeProvider(NodeProvider):
+    """Instant in-memory nodes (reference
+    autoscaler/_private/fake_multi_node/node_provider.py — the testable
+    fake behind AutoscalingCluster): no processes, no GCS; scaling
+    logic and bin-packing are testable at zero spawn latency. Each
+    fake node records the resource shape it was launched with."""
+
+    def __init__(self):
+        self._nodes: Dict[str, ProviderNode] = {}
+        self.created_shapes: List[Dict[str, float]] = []
+
+    def create_node(self, resources: Dict[str, float]) -> ProviderNode:
+        node = ProviderNode(provider_id=uuid.uuid4().hex[:8],
+                            node_id_hex=uuid.uuid4().hex,
+                            handle=dict(resources))
+        self._nodes[node.provider_id] = node
+        self.created_shapes.append(dict(resources))
+        return node
+
+    def terminate_node(self, node: ProviderNode) -> None:
+        self._nodes.pop(node.provider_id, None)
+
+    def non_terminated_nodes(self) -> List[ProviderNode]:
+        return list(self._nodes.values())
+
+
+class GKETPUNodeProvider(NodeProvider):
+    """GKE TPU node-pool provider sketch: one "node" = one TPU pod
+    slice (node pool with `tpu-topology`), the platform this framework
+    targets. Follows the reference provider contract
+    (node_provider.py) + the TPU accelerator manager's pod-slice
+    resource naming (accelerators/tpu.py: `TPU-<type>-head` on worker 0
+    of a slice) so gang-scheduled slice actors land on freshly-launched
+    slices.
+
+    The gcloud calls are behind `_run` so tests can stub them; without
+    a reachable cluster every operation raises with a clear message
+    rather than pretending to scale.
+    """
+
+    def __init__(self, cluster: str, zone: str,
+                 accelerator_type: str = "v5p-8",
+                 node_pool_prefix: str = "ray-tpu"):
+        self.cluster = cluster
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.node_pool_prefix = node_pool_prefix
+        self._nodes: Dict[str, ProviderNode] = {}
+
+    def _run(self, args: List[str]) -> str:
+        proc = subprocess.run(["gcloud", *args], capture_output=True,
+                              text=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"gcloud {' '.join(args[:3])}... failed: "
+                f"{proc.stderr[-500:]}")
+        return proc.stdout
+
+    def create_node(self, resources: Dict[str, float]) -> ProviderNode:
+        pool = f"{self.node_pool_prefix}-{uuid.uuid4().hex[:6]}"
+        chips = int(resources.get("TPU", 4))
+        self._run([
+            "container", "node-pools", "create", pool,
+            f"--cluster={self.cluster}", f"--zone={self.zone}",
+            "--num-nodes=1", "--machine-type=ct5p-hightpu-4t",
+            f"--tpu-topology={self._topology_for(chips)}",
+        ])
+        node = ProviderNode(provider_id=pool, handle={"pool": pool})
+        self._nodes[pool] = node
+        return node
+
+    @staticmethod
+    def _topology_for(chips: int) -> str:
+        # v5p topologies: 4 chips per host; 2x2x1 = one host
+        hosts = max(1, chips // 4)
+        return {1: "2x2x1", 2: "2x2x2", 4: "2x2x4"}.get(hosts, "2x2x1")
+
+    def terminate_node(self, node: ProviderNode) -> None:
+        self._run([
+            "container", "node-pools", "delete", node.provider_id,
+            f"--cluster={self.cluster}", f"--zone={self.zone}",
+            "--quiet"])
+        self._nodes.pop(node.provider_id, None)
+
+    def non_terminated_nodes(self) -> List[ProviderNode]:
+        return list(self._nodes.values())
+
+
 class StandardAutoscaler:
     """Polls cluster load via the GCS; scales worker nodes between
     min_workers and max_workers. Scale-up when leases are queued anywhere
@@ -87,22 +175,36 @@ class StandardAutoscaler:
 
     def __init__(self, gcs_address: str, provider: NodeProvider, *,
                  resources_per_node: Optional[Dict[str, float]] = None,
+                 node_types: Optional[List[Any]] = None,
                  min_workers: int = 0, max_workers: int = 4,
-                 idle_timeout_s: float = 30.0, poll_period_s: float = 2.0):
+                 idle_timeout_s: float = 30.0, poll_period_s: float = 2.0,
+                 load_fn: Optional[Any] = None):
         from ray_tpu._private import rpc as rpc_lib
+        from ray_tpu.autoscaler.demand_scheduler import NodeType
 
-        host, port = gcs_address.rsplit(":", 1)
-        self._gcs = rpc_lib.RpcClient((host, int(port)), timeout=60)
+        if gcs_address:
+            host, port = gcs_address.rsplit(":", 1)
+            self._gcs = rpc_lib.RpcClient((host, int(port)), timeout=60)
+        else:
+            self._gcs = None  # test mode: load injected via load_fn
         self._pool = rpc_lib.ClientPool(timeout=30)
         self.provider = provider
         self.resources_per_node = dict(resources_per_node or {"CPU": 2.0})
+        # heterogeneous launchable shapes for the demand scheduler
+        # (reference available_node_types); default: one type matching
+        # resources_per_node
+        self.node_types = list(node_types or [
+            NodeType("default", dict(self.resources_per_node),
+                     max_workers=max_workers)])
         self.min_workers = min_workers
         self.max_workers = max_workers
         self.idle_timeout_s = idle_timeout_s
         self.poll_period_s = poll_period_s
+        self._load_fn = load_fn
         self._idle_since: Dict[str, float] = {}
         self.num_scale_ups = 0
         self.num_scale_downs = 0
+        self.last_unplaceable: List[Dict[str, float]] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -117,8 +219,14 @@ class StandardAutoscaler:
             self._thread.join(timeout=10)
 
     def _cluster_load(self) -> Dict[str, Any]:
-        """Queued leases + busy workers per alive node."""
-        out: Dict[str, Any] = {"pending": 0, "busy_by_node": {}}
+        """Queued lease shapes, per-node availability, busy workers."""
+        out: Dict[str, Any] = {"pending": 0, "pending_shapes": [],
+                               "available": [], "busy_by_node": {}}
+        if self._load_fn is not None:
+            out.update(self._load_fn())
+            out["pending"] = max(out.get("pending", 0),
+                                 len(out.get("pending_shapes", [])))
+            return out
         try:
             nodes = [n for n in self._gcs.call("get_all_nodes") if n.alive]
         except Exception:  # noqa: BLE001
@@ -131,26 +239,58 @@ class StandardAutoscaler:
             except Exception:  # noqa: BLE001
                 continue
             out["pending"] += info.get("num_pending_leases", 0)
+            out["pending_shapes"].extend(
+                info.get("pending_resource_shapes") or [])
+            out["available"].append(dict(info.get("available") or {}))
             out["busy_by_node"][n.node_id.hex()] = sum(
                 1 for w in workers if not w["idle"])
         return out
 
     def run_once(self) -> None:
+        from ray_tpu.autoscaler.demand_scheduler import get_nodes_to_launch
         load = self._cluster_load()
         nodes = self.provider.non_terminated_nodes()
-        # ---- scale up (reference resource_demand_scheduler: demand the
-        # cluster can't place right now → launch) --------------------
-        if (load["pending"] > 0 or len(nodes) < self.min_workers) \
-                and len(nodes) < self.max_workers:
-            logger.info("autoscaler: %d queued leases, launching node "
-                        "(%d -> %d)", load["pending"], len(nodes),
-                        len(nodes) + 1)
-            self._emit("AUTOSCALER_SCALE_UP",
-                       f"{load['pending']} queued leases",
-                       nodes_before=len(nodes))
-            self.provider.create_node(self.resources_per_node)
+        # ---- scale up: bin-pack unplaced demand into candidate node
+        # shapes (reference resource_demand_scheduler.py) -------------
+        shapes = list(load.get("pending_shapes") or [])
+        if not shapes and load["pending"]:
+            # older node managers report counts only: assume 1-CPU tasks
+            shapes = [{"CPU": 1.0}] * int(load["pending"])
+        if len(nodes) < self.min_workers:
+            # node-COUNT floor, not capacity demand: launch directly
+            # (head-node availability must not satisfy min_workers)
+            self.provider.create_node(dict(self.resources_per_node))
             self.num_scale_ups += 1
+            self._emit("AUTOSCALER_SCALE_UP",
+                       f"below min_workers={self.min_workers}",
+                       nodes_before=len(nodes))
             return
+        if shapes and len(nodes) < self.max_workers:
+            to_launch, unplaceable = get_nodes_to_launch(
+                shapes, list(load.get("available") or []),
+                self.node_types,
+                max_total_nodes=self.max_workers + 1)  # +1: head node
+            self.last_unplaceable = unplaceable
+            launched = 0
+            for type_name, count in to_launch.items():
+                t = next(t for t in self.node_types
+                         if t.name == type_name)
+                for _ in range(count):
+                    if len(self.provider.non_terminated_nodes()) >= \
+                            self.max_workers:
+                        break
+                    logger.info(
+                        "autoscaler: launching %s for %d queued "
+                        "demands", type_name, len(shapes))
+                    self.provider.create_node(dict(t.resources))
+                    self.num_scale_ups += 1
+                    launched += 1
+            if launched:
+                self._emit("AUTOSCALER_SCALE_UP",
+                           f"{len(shapes)} queued demands -> "
+                           f"{launched} nodes",
+                           nodes_before=len(nodes))
+                return
         # ---- scale down idle provider nodes ------------------------
         now = time.time()
         for node in nodes:
@@ -174,6 +314,8 @@ class StandardAutoscaler:
                 self._idle_since.pop(node.provider_id, None)
 
     def _emit(self, event_type: str, message: str, **fields) -> None:
+        if self._gcs is None:  # provider-only test mode
+            return
         from ray_tpu._private.events import emit_via
         emit_via(self._gcs.call, "autoscaler", event_type, message,
                  **fields)

@@ -277,15 +277,11 @@ class Transformer:
         # backing mesh axis doesn't divide kv_heads (TP degree > kv
         # heads), in which case k/v are widened to query heads first
         # (the pre-round-4 behavior) so shard_map can still split them.
+        from ray_tpu.parallel.sharding import spec_entry_size
+
         def axis_size(logical):
-            ax = rules.mesh_axes(logical)
-            if ax is None:
-                return 1
-            axes = ax if isinstance(ax, tuple) else (ax,)
-            size = 1
-            for a in axes:
-                size *= mesh.shape.get(a, 1)
-            return size
+            return spec_entry_size(rules.mesh_axes(logical), mesh) \
+                if mesh is not None else 1
 
         kv_narrow = (mesh is not None and cfg.kv_heads != cfg.n_heads
                      and cfg.kv_heads % axis_size("kv_heads") == 0)

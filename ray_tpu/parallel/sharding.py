@@ -79,6 +79,33 @@ DEFAULT_RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
 }
 
 
+def spec_entry_size(entry, mesh) -> int:
+    """Product of mesh-axis sizes behind one PartitionSpec entry
+    (str | tuple | None) — the shard count of that dimension."""
+    if entry is None:
+        return 1
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape.get(a, 1)
+    return size
+
+
+def fit_spec_to_shape(spec, shape, mesh) -> Tuple:
+    """Degrade PartitionSpec entries whose shard count doesn't divide
+    the dimension to replicated (single source of the divisibility
+    rule — used by constraints, param/optimizer shardings, and the
+    attention GQA dispatch)."""
+    cleaned = []
+    for d, entry in enumerate(spec):
+        if entry is not None and shape is not None and d < len(shape):
+            size = spec_entry_size(entry, mesh)
+            if size and shape[d] % size != 0:
+                entry = None
+        cleaned.append(entry)
+    return tuple(cleaned)
+
+
 def logical_sharding(logical_spec: LogicalSpec, mesh,
                      rules: Optional[ShardingRules] = None,
                      shape: Optional[Tuple[int, ...]] = None):
@@ -96,23 +123,15 @@ def logical_sharding(logical_spec: LogicalSpec, mesh,
     # anyway, and it keeps specs valid on degenerate meshes (e.g. 1 chip).
     spec = rules.spec(logical_spec)
     cleaned = []
-    for d, entry in enumerate(spec):
-        if entry is None:
-            cleaned.append(None)
-            continue
+    for entry in spec:
         if isinstance(entry, tuple):
             kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
-            entry = kept if kept else None
-        elif mesh.shape.get(entry, 1) <= 1:
-            entry = None
-        if entry is not None and shape is not None and d < len(shape):
-            axes = entry if isinstance(entry, tuple) else (entry,)
-            size = 1
-            for a in axes:
-                size *= mesh.shape.get(a, 1)
-            if size and shape[d] % size != 0:
-                entry = None
-        cleaned.append(entry)
+            cleaned.append(kept if kept else None)
+        elif entry is not None and mesh.shape.get(entry, 1) <= 1:
+            cleaned.append(None)
+        else:
+            cleaned.append(entry)
+    cleaned = fit_spec_to_shape(cleaned, shape, mesh)
     return jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(*cleaned))
 

@@ -114,20 +114,11 @@ def make_train_step(
 
     def _fit(sharding, leaf):
         # degrade non-dividing spec entries to replicated (e.g. kv_heads
-        # narrower than the tensor axis); mirrors logical_sharding's
-        # shape-aware cleanup for the constraint path
-        shape = getattr(leaf, "shape", ())
-        spec = sharding.spec
-        new = []
-        for d, entry in enumerate(spec):
-            if entry is not None and d < len(shape):
-                axes = entry if isinstance(entry, tuple) else (entry,)
-                size = 1
-                for a in axes:
-                    size *= mesh.shape.get(a, 1)
-                if size and shape[d] % size != 0:
-                    entry = None
-            new.append(entry)
+        # narrower than the tensor axis); same rule as the constraint
+        # path (sharding.fit_spec_to_shape)
+        from ray_tpu.parallel.sharding import fit_spec_to_shape
+        new = fit_spec_to_shape(sharding.spec,
+                                getattr(leaf, "shape", ()), mesh)
         return jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(*new))
 

@@ -27,14 +27,15 @@ _TASK_OPTIONS = {
 }
 
 
-_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "py_modules"}
+_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
 
 
 def validate_runtime_env(renv):
     """Reject runtime_env fields this runtime doesn't implement
-    (reference supports pip/conda/container via a per-node agent;
-    package installation is unsupported here) — accepting and silently
-    ignoring them would be worse than failing fast."""
+    (reference supports conda/container via a per-node agent) —
+    accepting and silently ignoring them would be worse than failing
+    fast. pip IS implemented (cached per-env installs,
+    _private/runtime_env.py; reference _private/runtime_env/pip.py)."""
     if renv is None:
         return None
     bad = set(renv) - _RUNTIME_ENV_KEYS
@@ -42,8 +43,11 @@ def validate_runtime_env(renv):
         raise ValueError(
             f"unsupported runtime_env field(s) {sorted(bad)}; this "
             f"runtime implements {sorted(_RUNTIME_ENV_KEYS)} "
-            f"(pip/conda/container need package installation, which "
-            f"is not available)")
+            f"(conda/container need containerization, which is not "
+            f"available)")
+    if "pip" in renv:
+        from ray_tpu._private.runtime_env import pip_spec
+        pip_spec(renv)  # raises on malformed specs at submission time
     return renv
 
 

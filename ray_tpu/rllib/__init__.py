@@ -31,6 +31,7 @@ from ray_tpu.rllib.core.rl_module import RLModule  # noqa: F401
 from ray_tpu.rllib.env.base import Env, make_env, register_env  # noqa: F401
 from ray_tpu.rllib.env import cartpole  # noqa: F401  (registers CartPole-v1)
 from ray_tpu.rllib.env import catch_pixels  # noqa: F401  (CatchPixels-v0)
+from ray_tpu.rllib.env import minipong  # noqa: F401  (MiniPong-v0)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner  # noqa: F401
 from ray_tpu.rllib.env.multi_agent import (MultiAgentEnv,  # noqa: F401
                                            make_multi_agent)

@@ -98,20 +98,33 @@ def deployment(_func_or_class: Any = None, *, name: Optional[str] = None,
 
 
 class DeploymentHandle:
-    """Client-side handle with power-of-two-choices routing (reference
-    router.py:893): pick two random replicas, send to the one with fewer
-    locally-tracked in-flight requests."""
+    """Client-side handle with queue-aware power-of-two-choices routing
+    (reference router.py:893 PowerOfTwoChoicesReplicaScheduler): pick
+    two random replicas, probe each one's SERVER-SIDE queue length
+    (executing + queued, reported by the replica's control concurrency
+    group — visible work from every caller, not just this handle), and
+    send to the shorter queue. Probes are cached briefly and adjusted
+    by this handle's own in-flight deltas between probes; replicas at
+    or over max_concurrent_queries are avoided while any candidate has
+    room."""
 
     REFRESH_PERIOD_S = 2.0
+    PROBE_TTL_S = 0.25
+    PROBE_TIMEOUT_S = 2.0
 
     def __init__(self, deployment_name: str, controller=None):
         self.deployment_name = deployment_name
         self._controller = controller or _get_or_create_controller()
         self._replicas: List[Any] = []
+        self._max_queries = 0  # 0 = unknown/unlimited
         # in-flight keyed by replica ACTOR id (stable across replica-set
         # refreshes; index-keyed counts would drift onto the wrong actor
         # whenever the controller replaces a dead replica)
         self._in_flight: Dict[str, int] = {}
+        # last probed server-side queue length + local delta since probe
+        self._probed: Dict[str, float] = {}   # key -> (stamp)
+        self._probe_len: Dict[str, int] = {}  # key -> server queue len
+        self._probe_delta: Dict[str, int] = {}  # sends since probe
         self._lock = threading.Lock()
         self._refresh_lock = threading.Lock()
         # Lazy first refresh (on first .remote()): an eager call home
@@ -132,11 +145,13 @@ class DeploymentHandle:
             if self._replicas and \
                     time.time() - self._last_refresh < self.REFRESH_PERIOD_S:
                 return  # another thread refreshed while we waited
-            replicas = ray_tpu.get(
-                self._controller.get_replicas.remote(self.deployment_name),
-                timeout=30)
+            info = ray_tpu.get(
+                self._controller.get_routing_info.remote(
+                    self.deployment_name), timeout=30)
+            replicas = info["replicas"]
             with self._lock:
                 self._replicas = replicas
+                self._max_queries = info.get("max_concurrent_queries", 0)
                 live = {r._actor_id.hex() for r in replicas}
                 self._in_flight = {k: v for k, v in self._in_flight.items()
                                    if k in live}
@@ -148,6 +163,34 @@ class DeploymentHandle:
         # reconstructs it against its own controller connection
         return (DeploymentHandle, (self.deployment_name,))
 
+    def _queue_len(self, replica) -> int:
+        """Server-side ongoing count for one replica, probe-cached for
+        PROBE_TTL_S with local sends since the probe added on top."""
+        key = replica._actor_id.hex()
+        now = time.time()
+        with self._lock:
+            fresh = now - self._probed.get(key, 0.0) < self.PROBE_TTL_S
+            if fresh:
+                return (self._probe_len.get(key, 0)
+                        + self._probe_delta.get(key, 0))
+        try:
+            qlen = ray_tpu.get(replica.queue_len.remote(),
+                               timeout=self.PROBE_TIMEOUT_S)
+        except Exception:  # noqa: BLE001 — probe failure: fall back to
+            # the handle-local count, and NEGATIVE-CACHE the failure so
+            # a dead/restarting replica costs one timeout per TTL, not
+            # one per request
+            with self._lock:
+                self._probed[key] = time.time()
+                self._probe_len[key] = self._in_flight.get(key, 0)
+                self._probe_delta[key] = 0
+                return self._probe_len[key]
+        with self._lock:
+            self._probed[key] = time.time()
+            self._probe_len[key] = int(qlen)
+            self._probe_delta[key] = 0
+            return int(qlen)
+
     def _pick(self):
         with self._lock:
             n = len(self._replicas)
@@ -157,9 +200,17 @@ class DeploymentHandle:
             if n == 1:
                 return self._replicas[0]
             a, b = random.sample(self._replicas, 2)
-            ka, kb = a._actor_id.hex(), b._actor_id.hex()
-            return a if self._in_flight.get(ka, 0) <= \
-                self._in_flight.get(kb, 0) else b
+            limit = self._max_queries
+        la, lb = self._queue_len(a), self._queue_len(b)
+        # avoid saturated replicas while the other candidate has room
+        # (server-side max_concurrent_queries enforcement at the router,
+        # reference router.py:893 candidate filtering)
+        if limit > 0:
+            if la >= limit and lb < limit:
+                return b
+            if lb >= limit and la < limit:
+                return a
+        return a if la <= lb else b
 
     def remote(self, *args: Any, **kwargs: Any):
         self._refresh()
@@ -167,12 +218,14 @@ class DeploymentHandle:
         key = replica._actor_id.hex()
         with self._lock:
             self._in_flight[key] = self._in_flight.get(key, 0) + 1
+            self._probe_delta[key] = self._probe_delta.get(key, 0) + 1
         ref = replica.handle_request.remote(args, kwargs)
 
         def _done() -> None:
             with self._lock:
                 self._in_flight[key] = max(
                     0, self._in_flight.get(key, 1) - 1)
+                self._probe_delta[key] = self._probe_delta.get(key, 1) - 1
 
         # completion observer — no extra thread, no second result fetch
         import ray_tpu._private.worker as worker_mod

@@ -27,6 +27,14 @@ def replica_ping(replica) -> bool:
         return False
 
 
+def _control_group(fn):
+    """Tag a Replica method onto the 'control' concurrency group (the
+    plain ray_tpu.method decorator, applied without importing ray_tpu at
+    module import time)."""
+    fn.__ray_tpu_method_options__ = {"concurrency_group": "control"}
+    return fn
+
+
 class Replica:
     """The per-replica actor: hosts one instance of the user deployment
     (reference serve/_private/replica.py)."""
@@ -43,6 +51,7 @@ class Replica:
         self._total = 0
         self._lock = threading.Lock()
 
+    @_control_group
     def ping(self) -> str:
         return "pong"
 
@@ -58,6 +67,17 @@ class Replica:
         finally:
             with self._lock:
                 self._in_flight -= 1
+
+    @_control_group
+    def queue_len(self) -> int:
+        """Server-side ongoing count: requests executing + waiting in
+        this replica's default-group queue. Runs on the dedicated
+        "control" concurrency group so it answers instantly even when
+        every handle_request slot is saturated (reference: replica
+        queue-length probe consumed by router.py:893
+        PowerOfTwoChoicesReplicaScheduler)."""
+        import ray_tpu
+        return ray_tpu.get_runtime_context().get_task_queue_depth("")
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
@@ -127,6 +147,16 @@ class ServeController:
             state = self._deployments.get(name)
             return list(state.replicas) if state else []
 
+    def get_routing_info(self, name: str) -> Dict[str, Any]:
+        """Replica set + limits the router needs (reference: the long
+        poll updates handles receive from the controller)."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return {"replicas": [], "max_concurrent_queries": 0}
+            return {"replicas": list(state.replicas),
+                    "max_concurrent_queries": state.max_concurrent_queries}
+
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
             return {n: {"target_replicas": s.target_replicas,
@@ -158,6 +188,9 @@ class ServeController:
         opts: Dict[str, Any] = {"num_cpus": 0.1}
         opts.update(state.ray_actor_options)
         opts["max_concurrency"] = state.max_concurrent_queries
+        # control group: health pings + queue-length probes stay
+        # responsive while all request slots are saturated
+        opts["concurrency_groups"] = {"control": 2}
         return cls.options(**opts).remote(
             state.target_blob, state.init_args, state.init_kwargs)
 

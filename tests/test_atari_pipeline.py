@@ -1,0 +1,233 @@
+"""Atari-class pixel pipeline (VERDICT r3 #3 / north-star configs #2-3).
+
+Covers: the DeepMind wrapper stack (reference
+rllib/env/wrappers/atari_wrappers.py — WarpFrame/FrameStack/MaxAndSkip/
+ClipReward/NoopReset), the MiniPong procedural Pong stand-in (the ALE
+is not installable here), scripted-player solvability, EnvRunner
+throughput on the conv module, and an IMPALA learning smoke on pixels.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env.base import Env, make_env
+from ray_tpu.rllib.env.minipong import SIZE, MiniPongRaw
+from ray_tpu.rllib.env.spaces import Box, Discrete
+from ray_tpu.rllib.env.wrappers import (ClipRewardEnv, FrameStack,
+                                        MaxAndSkipEnv, TimeLimit,
+                                        WarpFrame, resize_image,
+                                        wrap_atari)
+
+
+class _StaticImageEnv(Env):
+    """Deterministic RGB env for wrapper unit tests."""
+
+    def __init__(self, h=168, w=168):
+        self.observation_space = Box(0, 255, (h, w, 3), np.uint8)
+        self.action_space = Discrete(2)
+        self.t = 0
+
+    def reset(self, seed=None):
+        self.t = 0
+        return self._frame(), {}
+
+    def _frame(self):
+        f = np.full(self.observation_space.shape, self.t * 10, np.uint8)
+        return f
+
+    def step(self, action):
+        self.t += 1
+        return self._frame(), float(self.t), self.t >= 12, False, {}
+
+
+class TestWrappers:
+    def test_resize_integer_area(self):
+        img = np.arange(16, dtype=np.uint8).reshape(4, 4)
+        out = resize_image(img, 2, 2)
+        assert out.shape == (2, 2)
+        assert out[0, 0] == np.mean([0, 1, 4, 5]).astype(np.uint8)
+
+    def test_resize_bilinear_shape(self):
+        img = np.random.default_rng(0).integers(
+            0, 255, (100, 80, 3), dtype=np.uint8).astype(np.uint8)
+        out = resize_image(img, 84, 84)
+        assert out.shape == (84, 84, 3)
+
+    def test_warp_frame_gray_84(self):
+        env = WarpFrame(_StaticImageEnv())
+        obs, _ = env.reset()
+        assert obs.shape == (84, 84, 1) and obs.dtype == np.uint8
+        assert env.observation_space.shape == (84, 84, 1)
+
+    def test_frame_stack_rolls(self):
+        env = FrameStack(WarpFrame(_StaticImageEnv()), k=4)
+        obs, _ = env.reset()
+        assert obs.shape == (84, 84, 4)
+        assert obs[..., :3].max() == 0  # padding before first frames
+        o1, *_ = env.step(0)
+        o2, *_ = env.step(0)
+        # newest frame is last; frames shift left
+        assert (o2[..., 2] == o1[..., 3]).all()
+
+    def test_max_and_skip_sums_reward_and_maxes(self):
+        env = MaxAndSkipEnv(_StaticImageEnv(), skip=4)
+        env.reset()
+        obs, r, term, trunc, _ = env.step(0)
+        assert r == 1 + 2 + 3 + 4  # summed over skip
+        assert obs.max() == 40  # max of last two raw frames (30, 40)
+
+    def test_clip_reward_sign(self):
+        env = ClipRewardEnv(_StaticImageEnv())
+        env.reset()
+        _, r, *_ = env.step(0)
+        assert r == 1.0
+
+    def test_time_limit_truncates(self):
+        env = TimeLimit(_StaticImageEnv(), max_episode_steps=3)
+        env.reset()
+        for i in range(3):
+            _, _, term, trunc, _ = env.step(0)
+        assert trunc and not term
+
+    def test_wrap_atari_contract(self):
+        env = wrap_atari(_StaticImageEnv(), frameskip=2,
+                         max_episode_steps=100)
+        obs, _ = env.reset()
+        assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+
+
+def _scripted_return(env_cfg=None, episodes=5, seed=0):
+    """Play MiniPong raw with a cheating tracker that reads the ball
+    state directly; returns mean episode reward."""
+    total = 0.0
+    for ep in range(episodes):
+        env = MiniPongRaw({"seed": seed + ep, **(env_cfg or {})})
+        env.reset()
+        done = False
+        ep_ret = 0.0
+        steps = 0
+        while not done and steps < 500:
+            # predict where the ball is heading; just track its x
+            target = env._bx
+            a = 1 + int(np.sign(target - env._paddle))
+            _, r, done, trunc, _ = env.step(a)
+            ep_ret += r
+            done = done or trunc
+            steps += 1
+        total += ep_ret
+    return total / episodes
+
+
+class TestMiniPong:
+    def test_obs_contract(self):
+        env = make_env("MiniPong-v0")
+        obs, _ = env.reset(seed=0)
+        assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+        obs, r, term, trunc, _ = env.step(1)
+        assert obs.shape == (84, 84, 4)
+
+    def test_scripted_player_wins(self):
+        """A tracker that follows the ball x wins (5 returns = +5):
+        proves the game is solvable by paddle-speed-1 play."""
+        assert _scripted_return() >= 4.0
+
+    def test_random_play_loses(self):
+        rng = np.random.default_rng(0)
+        env = make_env("MiniPong-v0", {"seed": 0})
+        env.reset(seed=0)
+        total, eps = 0.0, 0
+        for _ in range(6):
+            done = False
+            ep = 0.0
+            env.reset()
+            steps = 0
+            while not done and steps < 300:
+                _, r, term, trunc, _ = env.step(int(rng.integers(3)))
+                ep += r
+                done = term or trunc
+                steps += 1
+            total += ep
+            eps += 1
+        assert total / eps < 0.5  # random play doesn't rack up returns
+
+    def test_longer_horizon_than_catch(self):
+        env = make_env("MiniPong-v0", {"seed": 1})
+        env.reset(seed=1)
+        steps = 0
+        done = False
+        while not done and steps < 500:
+            _, _, term, trunc, _ = env.step(1)
+            done = term or trunc
+            steps += 1
+        assert steps > 7  # CatchPixels episodes are 7 steps
+
+
+class TestEnvRunnerThroughput:
+    def test_pixel_env_steps_per_sec(self):
+        """Batched conv inference over a vector of pixel envs; prints
+        the env-steps/sec the runner sustains (recorded to
+        BENCH_RL_r04.json by tools/bench_rl.py on the bench box)."""
+        import jax
+
+        from ray_tpu.rllib.core.catalog import default_module_for
+        from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+        probe = make_env("MiniPong-v0")
+        module = default_module_for(probe.observation_space,
+                                    probe.action_space)
+        runner = SingleAgentEnvRunner("MiniPong-v0", module,
+                                      num_envs=4, seed=0)
+        runner.set_weights(module.init_params(jax.random.PRNGKey(0)))
+        runner.sample(64)  # warm the jit
+        t0 = time.perf_counter()
+        batch = runner.sample(512)
+        dt = time.perf_counter() - t0
+        steps = batch["obs"].shape[0] * batch["obs"].shape[1]
+        print(f"\nMiniPong env-steps/sec (4 envs, 1 worker): "
+              f"{steps / dt:.0f}")
+        assert batch["obs"].shape[2:] == (84, 84, 4)
+        assert steps / dt > 50  # sanity floor, not a perf target
+        runner.stop()
+
+
+@pytest.mark.slow
+class TestPixelLearning:
+    def test_impala_minipong_improves(self, ray_start):
+        """IMPALA with conv RLModule on MiniPong (easy difficulty —
+        wide paddle, slow ball; the default config needs more env steps
+        than a single CI core can generate in-budget): mean return must
+        climb clearly above the random-play baseline (~ -0.5 easy)
+        within the budget."""
+        import numpy as np
+
+        from ray_tpu.rllib.algorithms.impala import ImpalaConfig
+
+        config = (ImpalaConfig()
+                  .environment("MiniPong-v0",
+                               env_config={"paddle_w": 5,
+                                           "max_returns": 3,
+                                           "speeds": (-0.5, 0.5)})
+                  .env_runners(num_env_runners=2,
+                               num_envs_per_env_runner=4,
+                               rollout_fragment_length=32)
+                  .training(train_batch_size=256, lr=6e-4,
+                            entropy_coeff=0.02, vf_loss_coeff=0.5)
+                  .debugging(seed=0))
+        algo = config.build()
+        try:
+            best = -np.inf
+            # probe curve (1-CPU box): random ~-0.8 until ~10 min, then
+            # climbs through +0.5 by ~11 min and +1.4 by 12 — budget
+            # leaves headroom for a loaded box
+            deadline = time.time() + 1200
+            while time.time() < deadline:
+                result = algo.train()
+                reward = result.get("episode_reward_mean", -np.inf)
+                best = max(best, reward)
+                if best >= 0.5:
+                    break
+            assert best >= 0.5, f"IMPALA on MiniPong plateaued at {best}"
+        finally:
+            algo.stop()

@@ -1,0 +1,120 @@
+"""Autoscaler demand bin-packing + fake provider (VERDICT r3 #6;
+reference autoscaler/_private/resource_demand_scheduler.py and
+fake_multi_node/node_provider.py).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.autoscaler import (FakeMultiNodeProvider, NodeType,
+                                PlacementGroupDemand, StandardAutoscaler,
+                                get_nodes_to_launch)
+
+
+class TestDemandScheduler:
+    def test_packs_onto_existing_capacity_first(self):
+        to_launch, unplaceable = get_nodes_to_launch(
+            [{"CPU": 1}, {"CPU": 1}],
+            [{"CPU": 4}],  # existing node has room
+            [NodeType("cpu4", {"CPU": 4})])
+        assert to_launch == {} and unplaceable == []
+
+    def test_launches_smallest_fitting_type(self):
+        to_launch, _ = get_nodes_to_launch(
+            [{"CPU": 1}], [],
+            [NodeType("big", {"CPU": 64, "TPU": 4}),
+             NodeType("small", {"CPU": 4})])
+        assert to_launch == {"small": 1}
+
+    def test_heterogeneous_demands_pack_into_mixed_types(self):
+        demands = ([{"CPU": 1}] * 6) + [{"TPU": 4, "CPU": 1}]
+        to_launch, unplaceable = get_nodes_to_launch(
+            demands, [],
+            [NodeType("cpu4", {"CPU": 4}),
+             NodeType("tpu", {"TPU": 4, "CPU": 8})])
+        assert unplaceable == []
+        # the TPU demand opens one tpu node; its spare 7 CPUs absorb
+        # CPU tasks, remainder packs into cpu4 nodes
+        assert to_launch["tpu"] == 1
+        assert to_launch.get("cpu4", 0) <= 2
+        total_cpu = (to_launch.get("cpu4", 0) * 4
+                     + to_launch["tpu"] * 8)
+        assert total_cpu >= 7
+
+    def test_respects_type_max_workers(self):
+        to_launch, unplaceable = get_nodes_to_launch(
+            [{"CPU": 4}] * 5, [],
+            [NodeType("cpu4", {"CPU": 4}, max_workers=2)])
+        assert to_launch == {"cpu4": 2}
+        assert len(unplaceable) == 3
+
+    def test_respects_max_total_nodes(self):
+        to_launch, unplaceable = get_nodes_to_launch(
+            [{"CPU": 4}] * 5, [], [NodeType("cpu4", {"CPU": 4})],
+            max_total_nodes=3)
+        assert sum(to_launch.values()) == 3
+        assert len(unplaceable) == 2
+
+    def test_oversize_demand_unplaceable(self):
+        to_launch, unplaceable = get_nodes_to_launch(
+            [{"CPU": 128}], [], [NodeType("cpu4", {"CPU": 4})])
+        assert to_launch == {}
+        assert unplaceable == [{"CPU": 128}]
+
+    def test_pg_strict_pack_merges_bundles(self):
+        pg = PlacementGroupDemand(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+        assert pg.expand() == [{"CPU": 4}]
+        spread = PlacementGroupDemand(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="SPREAD")
+        assert len(spread.expand()) == 2
+
+
+class TestFakeProviderAutoscaler:
+    def _scaler(self, load, **kw):
+        provider = FakeMultiNodeProvider()
+        scaler = StandardAutoscaler(
+            "", provider, load_fn=lambda: dict(load),
+            idle_timeout_s=0.0, **kw)
+        return scaler, provider
+
+    def test_scales_up_for_shaped_demand(self):
+        load = {"pending_shapes": [{"CPU": 1}] * 5, "available": [],
+                "busy_by_node": {}}
+        scaler, provider = self._scaler(
+            load, max_workers=4,
+            node_types=[NodeType("cpu2", {"CPU": 2})])
+        scaler.run_once()
+        # 5 one-CPU demands -> ceil(5/2) = 3 cpu2 nodes
+        assert len(provider.non_terminated_nodes()) == 3
+        assert all(s == {"CPU": 2} for s in provider.created_shapes)
+
+    def test_tpu_demand_launches_tpu_type(self):
+        load = {"pending_shapes": [{"TPU": 4}], "available": [],
+                "busy_by_node": {}}
+        scaler, provider = self._scaler(
+            load, max_workers=4,
+            node_types=[NodeType("cpu2", {"CPU": 2}),
+                        NodeType("v5p", {"TPU": 4, "CPU": 8})])
+        scaler.run_once()
+        assert provider.created_shapes == [{"TPU": 4, "CPU": 8}]
+
+    def test_no_demand_no_launch_then_idle_scale_down(self):
+        load = {"pending_shapes": [], "available": [], "busy_by_node": {}}
+        scaler, provider = self._scaler(load, max_workers=4)
+        scaler.run_once()
+        assert len(provider.non_terminated_nodes()) == 0
+        # seed one node, no demand + idle_timeout 0 -> terminated
+        node = provider.create_node({"CPU": 2})
+        load["busy_by_node"] = {node.node_id_hex: 0}
+        scaler.run_once()
+        assert len(provider.non_terminated_nodes()) == 0
+        assert scaler.num_scale_downs == 1
+
+    def test_existing_capacity_suppresses_launch(self):
+        load = {"pending_shapes": [{"CPU": 1}],
+                "available": [{"CPU": 8}],  # a node reports room
+                "busy_by_node": {}}
+        scaler, provider = self._scaler(load, max_workers=4)
+        scaler.run_once()
+        assert len(provider.non_terminated_nodes()) == 0

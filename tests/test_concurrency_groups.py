@@ -155,7 +155,7 @@ def test_unsupported_runtime_env_rejected():
         return 1
 
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        f.options(runtime_env={"pip": ["requests"]}).remote()
+        f.options(runtime_env={"conda": ["python=3.11"]}).remote()
 
     @ray_tpu.remote
     class A:

@@ -48,18 +48,20 @@ def test_sharded_train_step_collectives_and_no_full_remat():
     # run one real partitioned step while capturing the C++ XLA log fd:
     # the involuntary-remat warning is emitted by spmd_partitioner.cc at
     # compile time, to stderr, bypassing Python logging entirely.
-    r, w = os.pipe()
-    saved = os.dup(2)
-    os.dup2(w, 2)
-    try:
-        state, metrics = train_step(state, batch)
-        loss = float(jax.device_get(metrics["loss"]))
-    finally:
-        os.dup2(saved, 2)
-        os.close(saved)
-        os.close(w)
-    with os.fdopen(r, "rb") as f:
-        captured = f.read().decode(errors="replace")
+    # (tempfile, not os.pipe: an unread pipe blocks the writer past
+    # ~64KB of compile chatter and would deadlock the compile.)
+    import tempfile
+    with tempfile.TemporaryFile() as cap:
+        saved = os.dup(2)
+        os.dup2(cap.fileno(), 2)
+        try:
+            state, metrics = train_step(state, batch)
+            loss = float(jax.device_get(metrics["loss"]))
+        finally:
+            os.dup2(saved, 2)
+            os.close(saved)
+        cap.seek(0)
+        captured = cap.read().decode(errors="replace")
     assert "Involuntary full rematerialization" not in captured, captured
     assert 0.0 < loss < 20.0
 

@@ -1,0 +1,101 @@
+"""Core-runtime microbenchmarks -> BENCH_CORE_r{N}.json.
+
+reference parity: python/ray/_private/ray_perf.py:93-241 (the `ray
+microbenchmark` suites: task throughput, sync/async actor calls,
+put/get throughput, wait over many refs) and the single-node rows of
+release/benchmarks/README.md:27-31. Numbers are machine-dependent;
+committing the JSON gives each round a recorded baseline on the CI box
+(VERDICT r3 #5).
+
+Usage: python tools/bench_core.py [--out BENCH_CORE_r04.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_CORE_r04.json")
+    ap.add_argument("--n", type=int, default=2000,
+                    help="ops per throughput suite")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    results = {}
+
+    def timed(name, fn, ops, unit="ops/s"):
+        fn()  # warm (workers spawned, code paths jitted)
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        results[name] = {"value": round(ops / dt, 1), "unit": unit,
+                         "ops": ops, "seconds": round(dt, 3)}
+        print(f"{name}: {ops / dt:,.0f} {unit}", flush=True)
+
+    n = args.n
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    timed("tasks_per_sec",
+          lambda: ray_tpu.get([tiny.remote() for _ in range(n)]), n)
+
+    @ray_tpu.remote
+    class Sync:
+        def m(self):
+            return b"ok"
+
+    a = Sync.options(num_cpus=0.05).remote()
+    timed("sync_actor_calls_per_sec",
+          lambda: ray_tpu.get([a.m.remote() for _ in range(n)]), n)
+
+    @ray_tpu.remote
+    class Async:
+        async def m(self):
+            return b"ok"
+
+    b = Async.options(num_cpus=0.05).remote()
+    timed("async_actor_calls_per_sec",
+          lambda: ray_tpu.get([b.m.remote() for _ in range(n)]), n)
+
+    arr = np.zeros(1 << 20, dtype=np.uint8)  # 1 MiB
+    m = max(10, n // 10)
+    timed("put_1mib_mb_per_sec",
+          lambda: [ray_tpu.put(arr) for _ in range(m)], m, unit="MB/s")
+    refs = [ray_tpu.put(arr) for _ in range(m)]
+    timed("get_1mib_mb_per_sec",
+          lambda: ray_tpu.get(refs), m, unit="MB/s")
+
+    wait_refs = [ray_tpu.put(np.int64(i)) for i in range(1000)]
+    timed("wait_1k_refs_per_sec",
+          lambda: ray_tpu.wait(wait_refs, num_returns=1000,
+                               timeout=60.0), 1000)
+
+    out = {
+        "suite": "core_microbenchmark",
+        "host": {"cpus": os.cpu_count()},
+        "results": results,
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.out}")
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
