@@ -151,7 +151,7 @@ def main() -> None:
                 raise  # remat path itself failed; nothing smaller to try
             print("fast-path retry failed; falling back to remat",
                   file=sys.stderr)
-            del state
+            state = None  # may be unbound if build() itself failed
             used_remat = True
             cfg, state, train_step, batch = build(remat=True)
             for _ in range(WARMUP):

@@ -996,7 +996,9 @@ class CoreWorker:
                           arg_refs: List[ObjectID],
                           num_returns: int,
                           concurrency_group: str = "",
-                          max_pending_calls: int = -1) -> List[ObjectRef]:
+                          max_pending_calls: int = -1,
+                          dynamic_returns: bool = False
+                          ) -> List[ObjectRef]:
         spec = TaskSpec(
             task_id=TaskID.of(self.job_id), job_id=self.job_id,
             task_type=TaskType.ACTOR_TASK, function_key=function_key,
@@ -1006,6 +1008,7 @@ class CoreWorker:
             owner_worker_id=self.worker_id, actor_id=actor_id,
             actor_method_name=method_name,
             concurrency_group=concurrency_group)
+        spec.dynamic_returns = dynamic_returns
         # before the spec becomes reachable by other threads: a queued
         # spec can be popped+pickled by an in-flight _resolve_actor the
         # moment the lock below releases
@@ -1567,6 +1570,12 @@ class _Executor:
                         import asyncio
                         out = asyncio.run_coroutine_threadsafe(
                             out, self._ensure_aio_loop()).result()
+                    if spec.dynamic_returns:
+                        # generator ACTOR method (streaming responses):
+                        # same child-object protocol as generator tasks
+                        self._emit_dynamic_children(spec, out,
+                                                    decide_exit)
+                        return
                     values = self._split_returns(out, spec.num_returns)
                 elif spec.dynamic_returns:
                     # generator task (reference dynamic returns): store
@@ -1576,47 +1585,8 @@ class _Executor:
                     # consumers iterate before the task finishes.
                     fn = cw.import_function(spec.function_key)
                     args, kwargs = self._resolve_args(spec)
-                    # incremental reports go through a background drainer
-                    # so a slow owner never blocks the producing
-                    # generator; the task-end batch is the safety net
-                    report_q: "queue.Queue" = queue.Queue()
-
-                    def _report_children() -> None:
-                        owner = cw._pool.get(spec.owner_address)
-                        while True:
-                            item = report_q.get()
-                            if item is None:
-                                return
-                            child, loc = item
-                            try:
-                                owner.call("cw_dynamic_child",
-                                           task_id=spec.task_id,
-                                           child=child, loc=loc)
-                            except Exception:  # noqa: BLE001
-                                return  # batch report covers the rest
-
-                    reporter = threading.Thread(
-                        target=_report_children, daemon=True,
-                        name="dynamic-child-report")
-                    reporter.start()
-                    children = []
-                    for i, item in enumerate(fn(*args, **kwargs)):
-                        child = ObjectID.for_task_return(spec.task_id,
-                                                         i + 2)
-                        loc = cw.store_blob(child.hex(), ser.pack(item))
-                        children.append((child, loc))
-                        report_q.put((child, loc))
-                    report_q.put(None)
-                    reporter.join(timeout=30)
-                    will_exit = decide_exit()
-                    self._report_done(
-                        spec,
-                        [(INLINE,
-                          ser.pack([ObjectRef(oid, spec.owner_address,
-                                              _register=False)
-                                    for oid, _ in children]))],
-                        dynamic_children=children,
-                        worker_exiting=will_exit)
+                    self._emit_dynamic_children(
+                        spec, fn(*args, **kwargs), decide_exit)
                     return
                 else:
                     fn = cw.import_function(spec.function_key)
@@ -1660,6 +1630,53 @@ class _Executor:
                 except Exception:  # noqa: BLE001
                     pass
                 os._exit(0)
+
+    def _emit_dynamic_children(self, spec: TaskSpec, iterator: Any,
+                               decide_exit) -> None:
+        """Drain a generator's items into child objects, reporting each
+        incrementally (streaming consumers iterate before the task
+        finishes); the declared return resolves to the child-ref list.
+        Incremental reports ride a background drainer so a slow owner
+        never blocks the producer; the task-end batch is the safety
+        net."""
+        cw = self.cw
+        report_q: "queue.Queue" = queue.Queue()
+
+        def _report_children() -> None:
+            owner = cw._pool.get(spec.owner_address)
+            while True:
+                item = report_q.get()
+                if item is None:
+                    return
+                child, loc = item
+                try:
+                    owner.call("cw_dynamic_child",
+                               task_id=spec.task_id,
+                               child=child, loc=loc)
+                except Exception:  # noqa: BLE001
+                    return  # batch report covers the rest
+
+        reporter = threading.Thread(
+            target=_report_children, daemon=True,
+            name="dynamic-child-report")
+        reporter.start()
+        children = []
+        for i, item in enumerate(iterator):
+            child = ObjectID.for_task_return(spec.task_id, i + 2)
+            loc = cw.store_blob(child.hex(), ser.pack(item))
+            children.append((child, loc))
+            report_q.put((child, loc))
+        report_q.put(None)
+        reporter.join(timeout=30)
+        will_exit = decide_exit()
+        self._report_done(
+            spec,
+            [(INLINE,
+              ser.pack([ObjectRef(oid, spec.owner_address,
+                                  _register=False)
+                        for oid, _ in children]))],
+            dynamic_children=children,
+            worker_exiting=will_exit)
 
     @staticmethod
     def _split_returns(out: Any, num_returns: int) -> List[Any]:

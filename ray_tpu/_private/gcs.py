@@ -147,6 +147,9 @@ class GcsServer:
         self.nodes: Dict[str, NodeInfo] = {}
         # node_id hex -> {resource: available} (synced by node managers)
         self.node_available: Dict[str, Dict[str, float]] = {}
+        # last accepted resource-report version per node (syncer-style
+        # out-of-order protection)
+        self.node_resource_version: Dict[str, int] = {}
         self.node_health_failures: Dict[str, int] = {}
         # actor_id hex -> ActorInfo ; actor specs kept for restart
         self.actors: Dict[str, ActorInfo] = {}
@@ -299,9 +302,17 @@ class GcsServer:
             return list(self.nodes.values())
 
     def report_resources(self, node_id_hex: str,
-                         available: Dict[str, float]) -> str:
+                         available: Dict[str, float],
+                         version: int = 0) -> str:
         with self._lock:
             if node_id_hex in self.nodes and self.nodes[node_id_hex].alive:
+                # versioned, change-triggered reports (reference
+                # RaySyncer ray_syncer.h:88): drop stale out-of-order
+                # updates; version resets (node-manager restart) accept
+                last = self.node_resource_version.get(node_id_hex, 0)
+                if version and version < last and version > 1:
+                    return "ok"  # stale in-flight report
+                self.node_resource_version[node_id_hex] = version
                 self.node_available[node_id_hex] = dict(available)
                 self.node_health_failures[node_id_hex] = 0
                 return "ok"

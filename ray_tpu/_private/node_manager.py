@@ -113,7 +113,28 @@ class NodeManager:
         for k, v in detect_node_accelerators().items():
             resources.setdefault(k, v)
         self.resources_total = ResourceSet(resources)
-        self.available = ResourceSet(resources)
+        # change-triggered resource sync (reference RaySyncer,
+        # common/ray_syncer/ray_syncer.h:88 — raylets push resource
+        # deltas to the GCS the moment they change over a streaming
+        # channel, instead of the GCS discovering them at the next
+        # poll): every add/subtract sets the dirty event the report
+        # loop waits on; versioning makes stale reports droppable.
+        self._resync_event = threading.Event()
+        self._resource_version = 0
+
+        class _SyncedResources(ResourceSet):
+            __slots__ = ("_nm",)
+
+            def add(rs, other):  # noqa: N805
+                ResourceSet.add(rs, other)
+                rs._nm._resync_event.set()
+
+            def subtract(rs, other):  # noqa: N805
+                ResourceSet.subtract(rs, other)
+                rs._nm._resync_event.set()
+
+        self.available = _SyncedResources(resources)
+        self.available._nm = self
 
         node_store_dir = os.path.join(session_dir, self.node_id.hex()[:12])
         os.makedirs(node_store_dir, exist_ok=True)
@@ -146,6 +167,7 @@ class NodeManager:
             "nm_return_bundle": self.return_bundle,
             "nm_get_info": self.get_info,
             "nm_list_workers": self.list_workers,
+            "nm_profile_worker": self.profile_worker,
             "nm_drain": self.drain,
         }, host=host)
         self.address = self.server.address
@@ -181,11 +203,17 @@ class NodeManager:
     def _resource_report_loop(self) -> None:
         while not self._dead:
             try:
+                # clear BEFORE snapshotting: a change landing during the
+                # report re-sets the event and re-wakes immediately
+                self._resync_event.clear()
                 with self._lock:
                     avail = self.available.to_dict()
+                    self._resource_version += 1
+                    version = self._resource_version
                 resp = self._gcs.call(
                     "report_resources",
-                    node_id_hex=self.node_id.hex(), available=avail)
+                    node_id_hex=self.node_id.hex(), available=avail,
+                    version=version)
                 if resp == "unknown_node" and not self._dead:
                     # the GCS restarted (or declared us dead during a
                     # blip): re-register so scheduling resumes — but
@@ -211,7 +239,13 @@ class NodeManager:
                 self._reap_idle_workers()
             except Exception:  # noqa: BLE001
                 logger.warning("idle reap failed", exc_info=True)
-            time.sleep(Config.resource_report_period_s)
+            # syncer semantics: wake IMMEDIATELY when availability
+            # changes (lease grant/return, worker death), else
+            # heartbeat at the poll period; the short sleep after a
+            # wake coalesces bursts into one report
+            if self._resync_event.wait(
+                    timeout=Config.resource_report_period_s):
+                time.sleep(0.02)
 
     def _reap_idle_workers(self) -> None:
         """Kill workers idle past idle_worker_kill_timeout_s while the
@@ -338,7 +372,24 @@ class NodeManager:
                      pip_uri(pspec) if pspec else None))
 
     def _spawn_worker(self, runtime_env_key: str,
-                      runtime_env: Optional[Dict[str, Any]]) -> _WorkerHandle:
+                      runtime_env: Optional[Dict[str, Any]]
+                      ) -> Optional[_WorkerHandle]:
+        if (runtime_env or {}).get("pip"):
+            # env setup can take minutes (pip install): run the whole
+            # spawn on a setup thread so the dispatch path (and the
+            # lease-request RPC behind it) never blocks on it — the
+            # reference keeps env setup in an async per-node agent for
+            # the same reason (runtime_env_agent).
+            threading.Thread(
+                target=self._spawn_worker_sync,
+                args=(runtime_env_key, runtime_env),
+                daemon=True, name="worker-env-setup").start()
+            return None
+        return self._spawn_worker_sync(runtime_env_key, runtime_env)
+
+    def _spawn_worker_sync(self, runtime_env_key: str,
+                           runtime_env: Optional[Dict[str, Any]]
+                           ) -> Optional[_WorkerHandle]:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         # Make sure workers can import ray_tpu regardless of cwd.
@@ -403,16 +454,20 @@ class NodeManager:
     def _fail_env_leases(self, runtime_env_key: str, message: str) -> None:
         """Runtime-env setup failed: release the spawn slot and fail
         every queued lease whose env resolves to this key so callers
-        see the error instead of hanging."""
+        see the error instead of hanging. Covers leases that ALREADY
+        acquired resources (the lease that triggered the spawn holds
+        its reservation) by returning them to the pool."""
         with self._lock:
             self._starting = max(0, self._starting - 1)
             self._starting_by_key[runtime_env_key] = max(
                 0, self._starting_by_key.get(runtime_env_key, 1) - 1)
             doomed = [pl for pl in self.pending
-                      if pl.acquired is None
-                      and self._runtime_env_key(pl.spec) == runtime_env_key]
+                      if self._runtime_env_key(pl.spec) == runtime_env_key]
             self.pending = [pl for pl in self.pending
                             if pl not in doomed]
+            for pl in doomed:
+                if pl.acquired is not None:
+                    self.available.add(pl.acquired)
         for pl in doomed:
             try:
                 self._pool.get(pl.reply_to).call(
@@ -866,6 +921,42 @@ class NodeManager:
         threading.Thread(target=_oom_event, daemon=True,
                          name="oom-event").start()
         return True
+
+    def profile_worker(self, worker_id_hex: str,
+                       timeout: float = 3.0) -> Dict[str, Any]:
+        """Live stack dump of one worker process (reference: dashboard
+        reporter module's py-spy stack dumps,
+        dashboard/modules/reporter/profile_manager.py:11-19). Workers
+        register faulthandler on SIGUSR1 (worker_main.py): the signal
+        makes the worker append all-thread tracebacks to its log; this
+        returns the bytes the dump added."""
+        import signal as _signal
+        with self._lock:
+            handle = self.workers.get(worker_id_hex)
+        if handle is None or handle.proc is None:
+            raise KeyError(f"no live worker {worker_id_hex[:12]} "
+                           f"on this node")
+        log_path = os.path.join(
+            self.session_dir, "logs",
+            f"worker-{worker_id_hex[:12]}.log")
+        before = os.path.getsize(log_path) \
+            if os.path.exists(log_path) else 0
+        os.kill(handle.proc.pid, _signal.SIGUSR1)
+        deadline = time.time() + timeout
+        stack = ""
+        while time.time() < deadline:
+            time.sleep(0.1)
+            if os.path.exists(log_path) and \
+                    os.path.getsize(log_path) > before:
+                time.sleep(0.2)  # let the full dump flush
+                with open(log_path, "rb") as f:
+                    f.seek(before)
+                    stack = f.read().decode(errors="replace")
+                break
+        return {"worker_id": worker_id_hex,
+                "pid": handle.proc.pid,
+                "node_id": self.node_id.hex(),
+                "stack": stack}
 
     def list_workers(self) -> List[Dict[str, Any]]:
         """Worker-level metadata for the state API (`ray list workers`)."""

@@ -62,6 +62,9 @@ class RuntimeEnvManager:
         self.cache_dir = cache_dir
         self._locks: Dict[str, threading.Lock] = {}
         self._guard = threading.Lock()
+        # failed URIs fail fast on retry instead of re-running a long
+        # doomed install per task-retry attempt
+        self._failed: Dict[str, str] = {}
 
     def _lock_for(self, uri: str) -> threading.Lock:
         with self._guard:
@@ -80,6 +83,11 @@ class RuntimeEnvManager:
         target = os.path.join(self.cache_dir, uri)
         marker = os.path.join(target, ".ready")
         with self._lock_for(uri):
+            prior = self._failed.get(uri)
+            if prior is not None:
+                raise RuntimeError(
+                    f"runtime_env pip install previously failed for "
+                    f"{spec['packages']}: {prior}")
             if os.path.exists(marker):
                 self._touch(marker)
                 return target
@@ -93,6 +101,7 @@ class RuntimeEnvManager:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=600)
             if proc.returncode != 0:
+                self._failed[uri] = proc.stderr[-500:]
                 raise RuntimeError(
                     f"runtime_env pip install failed "
                     f"({spec['packages']}): {proc.stderr[-2000:]}")

@@ -115,11 +115,19 @@ class ActorHandle:
                 f"{self._concurrency_groups}")
         w = worker_mod.global_worker()
         args_blob, arg_refs = pack_args(args, kwargs)
+        # generator actor methods (reference StreamingObjectRefGenerator
+        # works for actor tasks too, _raylet.pyx:269)
+        dynamic = num_returns in ("dynamic", "streaming")
         refs = w.core_worker.submit_actor_task(
             self._actor_id, method_name, self._fn_key, args_blob, arg_refs,
-            num_returns, concurrency_group=concurrency_group,
-            max_pending_calls=self._max_pending_calls)
-        if num_returns == 1:
+            1 if dynamic else num_returns,
+            concurrency_group=concurrency_group,
+            max_pending_calls=self._max_pending_calls,
+            dynamic_returns=dynamic)
+        if dynamic and num_returns == "streaming":
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(refs[0])
+        if dynamic or num_returns == 1:
             return refs[0]
         return refs
 

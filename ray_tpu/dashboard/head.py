@@ -40,15 +40,23 @@ function esc(v) {
       c => '&#' + c.charCodeAt(0) + ';');
 }
 async function load() {
-  const [cluster, summary, actors] = await Promise.all([
+  const [cluster, summary, actors, workers, events] = await Promise.all([
     fetch('/api/cluster').then(r => r.json()),
     fetch('/api/summary').then(r => r.json()),
-    fetch('/api/actors').then(r => r.json())]);
+    fetch('/api/actors').then(r => r.json()),
+    fetch('/api/workers').then(r => r.json()),
+    fetch('/api/events').then(r => r.json())]);
   let html = '<h2>cluster</h2><table>';
   for (const [k, v] of Object.entries(cluster.resources_total)) {
     html += `<tr><td>${esc(k)}</td>`
           + `<td>${esc(cluster.resources_available[k] ?? 0)}`
           + ` / ${esc(v)} available</td></tr>`;
+  }
+  html += '</table><h2>nodes</h2><table>'
+        + '<tr><th>id</th><th>state</th><th>head</th></tr>';
+  for (const n of cluster.nodes) {
+    html += `<tr><td>${esc(n.node_id.slice(0,12))}</td>`
+          + `<td>${esc(n.state)}</td><td>${esc(n.is_head)}</td></tr>`;
   }
   html += `</table><h2>tasks</h2><table>`;
   for (const [k, v] of Object.entries(summary)) {
@@ -59,6 +67,21 @@ async function load() {
   for (const a of actors.slice(0, 50)) {
     html += `<tr><td>${esc(a.actor_id.slice(0,12))}</td>`
           + `<td>${esc(a.class_name)}</td><td>${esc(a.state)}</td></tr>`;
+  }
+  html += '</table><h2>workers</h2><table>'
+        + '<tr><th>id</th><th>pid</th><th>busy on</th>'
+        + '<th>stack</th></tr>';
+  for (const w of workers.slice(0, 50)) {
+    html += `<tr><td>${esc(w.worker_id.slice(0,12))}</td>`
+          + `<td>${esc(w.pid)}</td>`
+          + `<td>${esc(w.current_task ?? '-')}</td>`
+          + `<td><a href="/api/profile/stack?worker_id=${esc(w.worker_id)}">`
+          + `dump</a></td></tr>`;
+  }
+  html += '</table><h2>recent events</h2><table>';
+  for (const e of events.slice(-20).reverse()) {
+    html += `<tr><td>${esc(e.event_type ?? e.type ?? '?')}</td>`
+          + `<td>${esc(e.message ?? '')}</td></tr>`;
   }
   html += '</table>';
   document.getElementById('content').innerHTML = html;
@@ -151,6 +174,15 @@ class DashboardHead:
                     "store_stats": s.object_store_stats()}
         if route == "/api/summary":
             return s.summarize_tasks()
+        if route == "/api/profile/stack":
+            # live stack dump (reference dashboard reporter module):
+            # ?worker_id=<hex> for one worker, else every live worker
+            if "worker_id" in params:
+                return s.profile_worker_stack(params["worker_id"])
+            return s.profile_all_worker_stacks()
+        if route == "/api/metrics/config":
+            from ray_tpu.dashboard.metrics import write_metrics_configs
+            return write_metrics_configs()
         if route == "/api/events":
             return s.list_cluster_events(
                 event_type=params.get("type"),

@@ -1,0 +1,93 @@
+"""Metrics-stack wiring: Prometheus scrape config + Grafana dashboard.
+
+reference parity: dashboard/modules/metrics/ — the reference ships a
+prometheus.yml pointed at the cluster's metric endpoints and generated
+Grafana dashboard JSONs (grafana_dashboard_factory.py); `ray metrics
+launch-prometheus` style tooling consumes them. Here
+write_metrics_configs() materializes both under the session dir so an
+operator (or the bundled docker-compose in real deployments) can point
+Prometheus/Grafana at a running cluster with zero hand-editing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+GRAFANA_DASHBOARD: Dict[str, Any] = {
+    "title": "ray_tpu cluster",
+    "uid": "ray-tpu-default",
+    "timezone": "browser",
+    "refresh": "10s",
+    "panels": [
+        {"title": "Tasks finished/sec", "type": "timeseries",
+         "targets": [{"expr": "rate(ray_tpu_tasks_finished_total[1m])"}]},
+        {"title": "Queued leases", "type": "timeseries",
+         "targets": [{"expr": "ray_tpu_pending_leases"}]},
+        {"title": "Object store bytes", "type": "timeseries",
+         "targets": [{"expr": "ray_tpu_object_store_used_bytes"}]},
+        {"title": "Live workers", "type": "timeseries",
+         "targets": [{"expr": "ray_tpu_num_workers"}]},
+        {"title": "Actor calls/sec", "type": "timeseries",
+         "targets": [{"expr": "rate(ray_tpu_actor_calls_total[1m])"}]},
+        {"title": "Train tokens/sec", "type": "timeseries",
+         "targets": [{"expr": "ray_tpu_train_tokens_per_second"}]},
+    ],
+}
+
+
+def prometheus_config(targets: List[str]) -> Dict[str, Any]:
+    return {
+        "global": {"scrape_interval": "10s"},
+        "scrape_configs": [{
+            "job_name": "ray_tpu",
+            "metrics_path": "/metrics",
+            "static_configs": [{"targets": targets}],
+        }],
+    }
+
+
+def _yaml_dump(obj: Any, indent: int = 0) -> str:
+    """Minimal YAML emitter for the scrape config (no pyyaml dep)."""
+    pad = "  " * indent
+    if isinstance(obj, dict):
+        lines = []
+        for k, v in obj.items():
+            if isinstance(v, (dict, list)) and v:
+                lines.append(f"{pad}{k}:")
+                lines.append(_yaml_dump(v, indent + 1))
+            else:
+                lines.append(f"{pad}{k}: {json.dumps(v)}")
+        return "\n".join(lines)
+    if isinstance(obj, list):
+        lines = []
+        for item in obj:
+            if isinstance(item, (dict, list)):
+                body = _yaml_dump(item, indent + 1)
+                first, _, rest = body.partition("\n")
+                lines.append(f"{pad}- {first.strip()}")
+                if rest:
+                    lines.append(rest)
+            else:
+                lines.append(f"{pad}- {json.dumps(item)}")
+        return "\n".join(lines)
+    return f"{pad}{json.dumps(obj)}"
+
+
+def write_metrics_configs(out_dir: Optional[str] = None,
+                          dashboard_port: int = 8265) -> Dict[str, str]:
+    """Write prometheus.yml + grafana dashboard JSON; returns paths."""
+    import ray_tpu
+    if out_dir is None:
+        w = ray_tpu._private.worker.global_worker()
+        out_dir = os.path.join(w.session_dir, "metrics")
+    os.makedirs(out_dir, exist_ok=True)
+    targets = [f"127.0.0.1:{dashboard_port}"]
+    prom_path = os.path.join(out_dir, "prometheus.yml")
+    with open(prom_path, "w", encoding="utf-8") as f:
+        f.write(_yaml_dump(prometheus_config(targets)) + "\n")
+    graf_path = os.path.join(out_dir, "grafana_dashboard.json")
+    with open(graf_path, "w", encoding="utf-8") as f:
+        json.dump(GRAFANA_DASHBOARD, f, indent=1)
+    return {"prometheus": prom_path, "grafana_dashboard": graf_path}

@@ -153,13 +153,15 @@ class Dataset:
         bounds = np.asarray(cuts)
         split_remote = ray_tpu.remote(_split_by_range) \
             .options(num_returns=n)
-        pieces = [split_remote.remote(r, key, bounds, n)
-                  for r in mat._refs]
-        if n == 1:
-            pieces = [[p] for p in pieces]
+        # push-based shuffle (reference _internal/push_based_shuffle.py):
+        # map-side range splits tree-merge into per-partition partials
+        # round by round, overlapping with later map rounds, so each
+        # reducer gets O(maps/merge_factor) refs instead of one per map
+        from ray_tpu.data.shuffle import push_based_shuffle
+        partials = push_based_shuffle(
+            mat._refs, n, split_remote, (key, bounds, n))
         merge_remote = ray_tpu.remote(_merge_sorted)
-        refs = [merge_remote.remote([pc[p] for pc in pieces], key,
-                                    descending)
+        refs = [merge_remote.remote(partials[p], key, descending)
                 for p in builtins.range(n)]
         if descending:
             refs = refs[::-1]

@@ -167,6 +167,24 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Live all-thread stack dumps (reference scripts.py:1810 ray
+    stack; py-spy equivalent via SIGUSR1 faulthandler)."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    if args.worker_id:
+        dumps = [s.profile_worker_stack(args.worker_id)]
+    else:
+        dumps = s.profile_all_worker_stacks()
+    for dump in dumps:
+        print(f"== worker {dump['worker_id'][:12]} "
+              f"pid={dump.get('pid')} "
+              f"node={str(dump.get('node_id', '?'))[:12]}")
+        print(dump.get("stack") or dump.get("error")
+              or "(no dump captured)")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     rt = _connect(args)
     events = rt.timeline(args.output)
@@ -288,6 +306,13 @@ def main(argv=None) -> int:
                                 "placement-groups")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("stack", help="live stack dump of workers "
+                                     "(reference `ray stack`)")
+    p.add_argument("--worker-id", default=None,
+                   help="one worker id; default: all live workers")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("timeline", help="dump Chrome-trace timeline")
     p.add_argument("--output", "-o", default="/tmp/ray_tpu_timeline.json")

@@ -12,10 +12,13 @@ from ray_tpu.serve.api import (Application, Deployment,  # noqa: F401
                                DeploymentHandle, delete, deployment,
                                get_handle, run, shutdown, start_http)
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.controller import (get_multiplexed_model_id,  # noqa: F401
+                                      multiplexed)
 
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
     "run", "get_handle", "delete", "shutdown", "start_http", "batch",
+    "multiplexed", "get_multiplexed_model_id",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -117,6 +117,8 @@ class DeploymentHandle:
         self._controller = controller or _get_or_create_controller()
         self._replicas: List[Any] = []
         self._max_queries = 0  # 0 = unknown/unlimited
+        # replica actor id -> (stamp, loaded multiplexed model ids)
+        self._model_cache: Dict[str, Any] = {}
         # in-flight keyed by replica ACTOR id (stable across replica-set
         # refreshes; index-keyed counts would drift onto the wrong actor
         # whenever the controller replaces a dead replica)
@@ -191,7 +193,25 @@ class DeploymentHandle:
             self._probe_delta[key] = 0
             return int(qlen)
 
-    def _pick(self):
+    def _model_ids(self, replica) -> List[str]:
+        """Loaded multiplexed-model ids for one replica, probe-cached."""
+        key = replica._actor_id.hex()
+        now = time.time()
+        with self._lock:
+            cached = self._model_cache.get(key)
+            if cached is not None and now - cached[0] < 2.0:
+                return cached[1]
+        try:
+            ids = list(ray_tpu.get(
+                replica.multiplexed_model_ids.remote(),
+                timeout=self.PROBE_TIMEOUT_S))
+        except Exception:  # noqa: BLE001
+            ids = []
+        with self._lock:
+            self._model_cache[key] = (time.time(), ids)
+        return ids
+
+    def _pick(self, model_id: str = ""):
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -201,6 +221,13 @@ class DeploymentHandle:
                 return self._replicas[0]
             a, b = random.sample(self._replicas, 2)
             limit = self._max_queries
+        if model_id:
+            # model multiplexing affinity (reference multiplex router):
+            # prefer the candidate that already holds the model
+            a_has = model_id in self._model_ids(a)
+            b_has = model_id in self._model_ids(b)
+            if a_has != b_has:
+                return a if a_has else b
         la, lb = self._queue_len(a), self._queue_len(b)
         # avoid saturated replicas while the other candidate has room
         # (server-side max_concurrent_queries enforcement at the router,
@@ -212,14 +239,30 @@ class DeploymentHandle:
                 return a
         return a if la <= lb else b
 
+    def options(self, *, multiplexed_model_id: str = "",
+                stream: bool = False) -> "_HandleOptions":
+        """Per-call routing options (reference handle .options():
+        multiplexed_model_id steers to replicas holding the model;
+        stream=True returns a generator of response chunks)."""
+        return _HandleOptions(self, multiplexed_model_id, stream)
+
     def remote(self, *args: Any, **kwargs: Any):
+        return self._submit(args, kwargs, model_id="", stream=False)
+
+    def _submit(self, args: tuple, kwargs: Dict[str, Any], *,
+                model_id: str, stream: bool):
         self._refresh()
-        replica = self._pick()
+        replica = self._pick(model_id)
         key = replica._actor_id.hex()
         with self._lock:
             self._in_flight[key] = self._in_flight.get(key, 0) + 1
             self._probe_delta[key] = self._probe_delta.get(key, 0) + 1
-        ref = replica.handle_request.remote(args, kwargs)
+        if stream:
+            method = replica.handle_request_stream.options(
+                num_returns="streaming")
+        else:
+            method = replica.handle_request
+        ref = method.remote(args, kwargs, model_id)
 
         def _done() -> None:
             with self._lock:
@@ -229,8 +272,45 @@ class DeploymentHandle:
 
         # completion observer — no extra thread, no second result fetch
         import ray_tpu._private.worker as worker_mod
-        worker_mod.global_worker().core_worker.add_done_callback(ref, _done)
+        cw = worker_mod.global_worker().core_worker
+        if stream:
+            # account completion on the generator TASK's handle ref —
+            # it fires when the replica finishes producing, whether or
+            # not the caller ever iterates the response (an abandoned
+            # stream must not inflate the replica's load counters)
+            cw.add_done_callback(ref.handle, _done)
+            return _StreamingResponse(ref)
+        cw.add_done_callback(ref, _done)
         return ref
+
+
+class _HandleOptions:
+    """Per-call view over a DeploymentHandle (reference handle
+    .options(...))."""
+
+    def __init__(self, handle: DeploymentHandle, model_id: str,
+                 stream: bool):
+        self._handle = handle
+        self._model_id = model_id
+        self._stream = stream
+
+    def remote(self, *args: Any, **kwargs: Any):
+        return self._handle._submit(args, kwargs,
+                                    model_id=self._model_id,
+                                    stream=self._stream)
+
+
+class _StreamingResponse:
+    """Iterates a streaming deployment call's chunks as values
+    (reference serve streaming responses: the proxy iterates the
+    ObjectRefGenerator and yields chunk bytes)."""
+
+    def __init__(self, gen):
+        self._gen = gen
+
+    def __iter__(self):
+        for ref in self._gen:
+            yield ray_tpu.get(ref)
 
 
 def run(app: Any, *, name: Optional[str] = None) -> DeploymentHandle:

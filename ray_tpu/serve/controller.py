@@ -35,6 +35,97 @@ def _control_group(fn):
     return fn
 
 
+# current request's multiplexed model id (reference
+# serve/_private/replica.py request context + serve.api
+# get_multiplexed_model_id)
+_current_model_id = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    return getattr(_current_model_id, "value", "")
+
+
+class _MultiplexWrapper:
+    """Per-replica LRU of loaded models behind a user loader fn
+    (reference serve/api.py @serve.multiplexed + multiplex.py
+    _ModelMultiplexWrapper)."""
+
+    def __init__(self, loader, max_num_models_per_replica: int = 3):
+        self.loader = loader
+        self.max_models = max(1, max_num_models_per_replica)
+        self.models: Dict[str, Any] = {}   # insertion order = LRU
+        self._loading: Dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    def load(self, owner, model_id: str):
+        # per-model-id load serialization: concurrent requests for the
+        # same missing model must not both run the (possibly HBM-
+        # hungry) loader — the reference wrapper serializes loads too
+        with self._lock:
+            if model_id in self.models:
+                model = self.models.pop(model_id)
+                self.models[model_id] = model  # refresh LRU position
+                return model
+            gate = self._loading.get(model_id)
+            if gate is None:
+                gate = threading.Event()
+                self._loading[model_id] = gate
+                is_loader = True
+            else:
+                is_loader = False
+        if not is_loader:
+            gate.wait(timeout=600)
+            with self._lock:
+                if model_id in self.models:
+                    return self.models[model_id]
+            # loader failed: fall through and try ourselves
+            with self._lock:
+                self._loading[model_id] = gate = threading.Event()
+        try:
+            model = self.loader(owner, model_id)
+        finally:
+            with self._lock:
+                self._loading.pop(model_id, None)
+            gate.set()
+        with self._lock:
+            self.models[model_id] = model
+            while len(self.models) > self.max_models:
+                evicted_id = next(iter(self.models))
+                self.models.pop(evicted_id)
+                logger.info("multiplex: evicted model %s (dropped; "
+                            "resources release with its refcount)",
+                            evicted_id)
+        return model
+
+    def loaded_ids(self) -> List[str]:
+        with self._lock:
+            return list(self.models)
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator for a deployment method that loads a model by id; the
+    wrapper caches up to max_num_models_per_replica loaded models per
+    replica with LRU eviction (reference serve.multiplexed)."""
+
+    def wrap(fn):
+        state_attr = f"__mux_{fn.__name__}"
+
+        def getter(self, model_id: str):
+            mux = getattr(self, state_attr, None)
+            if mux is None:
+                mux = _MultiplexWrapper(fn, max_num_models_per_replica)
+                setattr(self, state_attr, mux)
+            return mux.load(self, model_id)
+
+        getter.__mux_marker__ = True
+        getter.__wrapped__ = fn
+        return getter
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
 class Replica:
     """The per-replica actor: hosts one instance of the user deployment
     (reference serve/_private/replica.py)."""
@@ -55,18 +146,54 @@ class Replica:
     def ping(self) -> str:
         return "pong"
 
-    def handle_request(self, args: tuple, kwargs: Dict[str, Any]) -> Any:
+    def handle_request(self, args: tuple, kwargs: Dict[str, Any],
+                       model_id: str = "") -> Any:
         with self._lock:
             self._in_flight += 1
             self._total += 1
+        _current_model_id.value = model_id
         try:
             fn = self._callable
             if not callable(fn):
                 raise TypeError(f"deployment target {fn!r} is not callable")
             return fn(*args, **kwargs)
         finally:
+            _current_model_id.value = ""
             with self._lock:
                 self._in_flight -= 1
+
+    def handle_request_stream(self, args: tuple,
+                              kwargs: Dict[str, Any],
+                              model_id: str = ""):
+        """Generator variant (reference serve streaming responses /
+        proxy.py:556): the deployment callable returns an iterable and
+        chunks stream back as they are produced (num_returns=
+        "streaming" on the caller side)."""
+        with self._lock:
+            self._in_flight += 1
+            self._total += 1
+        _current_model_id.value = model_id
+        try:
+            fn = self._callable
+            out = fn(*args, **kwargs)
+            for chunk in out:
+                yield chunk
+        finally:
+            _current_model_id.value = ""
+            with self._lock:
+                self._in_flight -= 1
+
+    @_control_group
+    def multiplexed_model_ids(self) -> List[str]:
+        """Model ids loaded by any @multiplexed loader on the target
+        (router affinity signal; reference multiplex router prefers
+        replicas that already hold the model)."""
+        out: List[str] = []
+        target = self._callable
+        for v in vars(target).values():
+            if isinstance(v, _MultiplexWrapper):
+                out.extend(v.loaded_ids())
+        return out
 
     @_control_group
     def queue_len(self) -> int:
@@ -189,8 +316,10 @@ class ServeController:
         opts.update(state.ray_actor_options)
         opts["max_concurrency"] = state.max_concurrent_queries
         # control group: health pings + queue-length probes stay
-        # responsive while all request slots are saturated
-        opts["concurrency_groups"] = {"control": 2}
+        # responsive while all request slots are saturated (merged so
+        # user-declared groups in ray_actor_options survive)
+        opts["concurrency_groups"] = {
+            **(opts.get("concurrency_groups") or {}), "control": 2}
         return cls.options(**opts).remote(
             state.target_blob, state.init_args, state.init_kwargs)
 

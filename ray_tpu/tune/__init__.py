@@ -8,6 +8,8 @@ per-trial failure retry from checkpoint.
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,  # noqa: F401
                                      MedianStoppingRule,
                                      PopulationBasedTraining)
+from ray_tpu.tune.search import (OptunaSearcher, Searcher,  # noqa: F401
+                                 TPESearcher)
 from ray_tpu.tune.search import (choice, grid_search, loguniform,  # noqa: F401
                                  randint, uniform)
 from ray_tpu.tune.trainable import (FunctionTrainable, Trainable,  # noqa: F401

@@ -102,7 +102,12 @@ class FunctionTrainable(Trainable):
 
         def runner() -> None:
             try:
-                type(self)._fn(config)
+                out = type(self)._fn(config)
+                if isinstance(out, dict):
+                    # returning a metrics dict is a final report
+                    # (reference function trainables support both
+                    # tune.report(...) and a returned dict)
+                    self._session.results.put(("result", dict(out)))
                 self._session.results.put(("done", {}))
             except BaseException as e:  # noqa: BLE001
                 self._session.results.put(("error", e))

@@ -104,10 +104,16 @@ class TuneController:
                  max_failures_per_trial: int = 1,
                  checkpoint_frequency: int = 0,
                  resources_per_trial: Optional[Dict[str, float]] = None,
-                 resume_state: Optional[Dict[str, Any]] = None):
+                 resume_state: Optional[Dict[str, Any]] = None,
+                 searcher: Optional[Any] = None,
+                 num_searcher_trials: int = 0):
         self._factory = factory
         self._stop = dict(stop or {})
         self._scheduler = scheduler or FIFOScheduler()
+        # sequential search algorithm (reference search_alg): suggests
+        # one config per new trial, fed completed results
+        self._searcher = searcher
+        self._num_searcher_trials = num_searcher_trials
         self._max_concurrent = max_concurrent_trials
         self._max_failures = max_failures_per_trial
         self._ckpt_freq = checkpoint_frequency
@@ -212,9 +218,10 @@ class TuneController:
         trial.actor = None
         trial.in_flight = None
         trial.state = state
-        if state in (TERMINATED, ERROR) and \
-                hasattr(self._scheduler, "on_trial_remove"):
-            self._scheduler.on_trial_remove(trial.trial_id)
+        if state in (TERMINATED, ERROR):
+            self._notify_searcher(trial)
+            if hasattr(self._scheduler, "on_trial_remove"):
+                self._scheduler.on_trial_remove(trial.trial_id)
 
     def _next_ckpt_dir(self, trial: Trial) -> str:
         return os.path.join(trial.trial_dir,
@@ -232,9 +239,45 @@ class TuneController:
 
     # -- the loop ----------------------------------------------------------
 
+    def _maybe_suggest_trials(self) -> None:
+        """Create new trials from the searcher up to the concurrency
+        cap, until its trial budget is spent (reference: SearchGenerator
+        feeding TuneController)."""
+        if self._searcher is None:
+            return
+        active = [t for t in self.trials
+                  if t.state in (PENDING, RUNNING)]
+        while len(self.trials) < self._num_searcher_trials and \
+                len(active) < self._max_concurrent:
+            trial_id = f"trial_{len(self.trials):05d}"
+            cfg = self._searcher.suggest(trial_id)
+            if cfg is None:
+                return
+            t = Trial(trial_id=trial_id, config=cfg,
+                      trial_dir=os.path.join(self.run_dir, trial_id))
+            os.makedirs(t.trial_dir, exist_ok=True)
+            self.trials.append(t)
+            active.append(t)
+            if hasattr(self._scheduler, "on_trial_add"):
+                self._scheduler.on_trial_add(t.trial_id, t.config)
+
+    def _notify_searcher(self, trial: Trial) -> None:
+        if self._searcher is None or \
+                getattr(trial, "_searcher_notified", False):
+            return
+        trial._searcher_notified = True  # type: ignore[attr-defined]
+        try:
+            self._searcher.on_trial_complete(
+                trial.trial_id, trial.last_result or None,
+                error=trial.state == ERROR)
+        except Exception:  # noqa: BLE001
+            logger.warning("searcher on_trial_complete failed",
+                           exc_info=True)
+
     def run(self, timeout_s: float = 3600.0) -> List[Trial]:
         deadline = time.time() + timeout_s
         while time.time() < deadline:
+            self._maybe_suggest_trials()
             # launch pending trials up to the concurrency cap
             running = [t for t in self.trials if t.state == RUNNING]
             pending = [t for t in self.trials if t.state == PENDING]
@@ -245,6 +288,7 @@ class TuneController:
                 except Exception as e:  # noqa: BLE001
                     t.error = e
                     t.state = ERROR
+                    self._notify_searcher(t)
             running = [t for t in self.trials if t.state == RUNNING]
             if not running:
                 break

@@ -29,6 +29,10 @@ class TuneConfig:
     max_concurrent_trials: int = 4
     scheduler: Any = None
     search_seed: Optional[int] = None
+    # sequential search algorithm (a tune.search.Searcher, e.g.
+    # TPESearcher / OptunaSearcher); when set, num_samples trials are
+    # suggested one-by-one with results fed back (reference search_alg)
+    search_alg: Any = None
 
 
 @dataclass
@@ -189,9 +193,10 @@ class Tuner:
             name = rc.name or f"tune_{time.strftime('%Y%m%d_%H%M%S')}"
             run_dir = os.path.join(rc.storage_path, name)
             os.makedirs(run_dir, exist_ok=True)
-            variants = list(BasicVariantGenerator(
-                self._param_space, num_samples=tc.num_samples,
-                seed=tc.search_seed).variants())
+            variants = [] if tc.search_alg is not None else list(
+                BasicVariantGenerator(
+                    self._param_space, num_samples=tc.num_samples,
+                    seed=tc.search_seed).variants())
             import pickle
             try:
                 with open(os.path.join(run_dir, "tuner_config.pkl"),
@@ -207,7 +212,10 @@ class Tuner:
             max_failures_per_trial=rc.max_failures_per_trial,
             checkpoint_frequency=rc.checkpoint_frequency,
             resources_per_trial=rc.resources_per_trial,
-            resume_state=self._resume_state)
+            resume_state=self._resume_state,
+            searcher=tc.search_alg,
+            num_searcher_trials=(tc.num_samples
+                                 if tc.search_alg is not None else 0))
         trials = controller.run()
         results = [
             TrialResult(

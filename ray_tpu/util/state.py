@@ -72,6 +72,54 @@ def list_workers() -> List[Dict[str, Any]]:
     return out
 
 
+def _workers_by_node() -> Dict[Any, List[Dict[str, Any]]]:
+    out: Dict[Any, List[Dict[str, Any]]] = {}
+    for n in _gcs().call("get_all_nodes"):
+        if not n.alive:
+            continue
+        try:
+            out[tuple(n.address)] = _pool().get(
+                tuple(n.address)).call("nm_list_workers")
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+def profile_worker_stack(worker_id: str,
+                         timeout: float = 3.0) -> Dict[str, Any]:
+    """Live all-thread stack dump of one worker (reference: dashboard
+    reporter module / `ray stack` CLI, scripts.py:1810): resolves the
+    worker's node and asks its node manager to SIGUSR1 the process and
+    return the faulthandler dump."""
+    for addr, workers in _workers_by_node().items():
+        if any(w["worker_id"] == worker_id for w in workers):
+            return _pool().get(addr).call(
+                "nm_profile_worker", worker_id_hex=worker_id,
+                timeout=timeout)
+    raise KeyError(f"worker {worker_id[:12]} not found on any "
+                   f"alive node")
+
+
+def profile_all_worker_stacks(timeout: float = 3.0
+                              ) -> List[Dict[str, Any]]:
+    """Stack dumps for every live worker — one worker-list RPC per
+    node (not per worker), dumps issued node by node."""
+    out: List[Dict[str, Any]] = []
+    for addr, workers in _workers_by_node().items():
+        for w in workers:
+            if w.get("pid") is None:
+                continue
+            try:
+                out.append(_pool().get(addr).call(
+                    "nm_profile_worker",
+                    worker_id_hex=w["worker_id"], timeout=timeout))
+            except Exception as e:  # noqa: BLE001
+                out.append({"worker_id": w["worker_id"],
+                            "pid": w.get("pid"), "stack": "",
+                            "error": str(e)})
+    return out
+
+
 def list_objects() -> List[Dict[str, Any]]:
     """Objects resident in every alive node's shared-memory store."""
     out: List[Dict[str, Any]] = []

@@ -49,6 +49,51 @@ def continuation(dag: DAGNode, *, dag_input: Any = None) -> Continuation:
     return Continuation(dag, dag_input)
 
 
+class EventNode(DAGNode):
+    """A workflow step that resolves when an external event named
+    `name` is delivered via `send_event` (reference: workflow events —
+    api.wait_for_event / event listeners). Durable like any step: once
+    satisfied, the payload checkpoints and resume never waits again."""
+
+    def __init__(self, name: str, timeout_s: float = 300.0):
+        super().__init__((), {})
+        self.event_name = name
+        self.timeout_s = timeout_s
+
+
+def wait_for_event(name: str, *, timeout_s: float = 300.0) -> EventNode:
+    """DAG node that blocks the workflow until `send_event(workflow_id,
+    name, payload)` delivers; resolves to the payload."""
+    return EventNode(name, timeout_s)
+
+
+def send_event(workflow_id: str, name: str, payload: Any = None, *,
+               storage: str = DEFAULT_STORAGE) -> None:
+    """Deliver an event to a (possibly running, possibly resumed-later)
+    workflow; payloads persist durably in the workflow's storage."""
+    events_dir = os.path.join(storage, workflow_id, "events")
+    os.makedirs(events_dir, exist_ok=True)
+    path = os.path.join(events_dir, f"{name}.pkl")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _await_event(events_dir: str, name: str, timeout_s: float) -> Any:
+    """Worker-side: poll the durable event file until delivered."""
+    import time as _time
+    path = os.path.join(events_dir, f"{name}.pkl")
+    deadline = _time.time() + timeout_s
+    while _time.time() < deadline:
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        _time.sleep(0.1)
+    raise TimeoutError(
+        f"workflow event {name!r} not delivered within {timeout_s}s")
+
+
 def options(node: DAGNode, *, max_retries: int = 0,
             retry_exceptions: bool = True) -> DAGNode:
     """Attach per-step durability options to a DAG node (reference:
@@ -71,7 +116,9 @@ def _step_id(node: DAGNode, memo: Dict[int, str],
     if node._id in memo:
         return memo[node._id]
     h = hashlib.sha1()
-    if isinstance(node, FunctionNode):
+    if isinstance(node, EventNode):
+        h.update(b"event:" + node.event_name.encode() + b";")
+    elif isinstance(node, FunctionNode):
         h.update(b"fn:" + node.name.encode() + b";")
     elif isinstance(node, InputNode):
         # the input value is part of step identity: a different input
@@ -107,6 +154,7 @@ class _DurableExecutor:
 
     def __init__(self, workflow_dir: str, dag_input: Any):
         self.steps_dir = os.path.join(workflow_dir, "steps")
+        self.events_dir = os.path.join(workflow_dir, "events")
         os.makedirs(self.steps_dir, exist_ok=True)
         self.dag_input = dag_input
         self._input_token = hashlib.sha1(
@@ -136,6 +184,11 @@ class _DurableExecutor:
                 with open(path, "rb") as f:
                     value = pickle.load(f)
                 self.steps_restored += 1
+            elif isinstance(node, EventNode):
+                value = ray_tpu.remote(_await_event).remote(
+                    self.events_dir, node.event_name, node.timeout_s)
+                self._pending.append((step_id, value))
+                self.steps_executed += 1
             else:
                 args = tuple(self._submit(a) if isinstance(a, DAGNode)
                              else a for a in node._bound_args)
@@ -234,4 +287,5 @@ def get_output(workflow_id: str, *,
 
 
 __all__ = ["run", "resume", "get_output", "options", "continuation",
-           "Continuation", "DEFAULT_STORAGE"]
+           "Continuation", "wait_for_event", "send_event", "EventNode",
+           "DEFAULT_STORAGE"]

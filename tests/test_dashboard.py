@@ -64,3 +64,46 @@ def test_dashboard_endpoints(ray_start):
         ray_tpu.get(dash.stop.remote())
         ray_tpu.kill(a)
         ray_tpu.kill(dash)
+
+
+def test_live_stack_profiling(ray_start):
+    """Reporter-module parity (reference profile_manager.py:11-19):
+    a busy worker's live stack dump shows the executing frame."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.util import state as s
+
+    @ray_tpu.remote
+    def spin_marker_fn():
+        t0 = _time.time()
+        while _time.time() - t0 < 20:
+            _time.sleep(0.05)
+        return 1
+
+    ref = spin_marker_fn.remote()
+    # wait until some worker reports the task as current
+    deadline = _time.time() + 60
+    busy = None
+    while _time.time() < deadline and busy is None:
+        for w in s.list_workers():
+            if w.get("current_task") == "spin_marker_fn":
+                busy = w
+                break
+        _time.sleep(0.2)
+    assert busy is not None, "task never started"
+    dump = s.profile_worker_stack(busy["worker_id"])
+    assert dump["pid"] == busy["pid"]
+    assert "spin_marker_fn" in dump["stack"], dump["stack"][-1500:]
+    assert ray_tpu.get(ref, timeout=120) == 1
+
+
+def test_metrics_configs_written(ray_start, tmp_path):
+    from ray_tpu.dashboard.metrics import write_metrics_configs
+    paths = write_metrics_configs(out_dir=str(tmp_path))
+    import json as _json
+    with open(paths["grafana_dashboard"]) as f:
+        dash = _json.load(f)
+    assert dash["panels"] and dash["title"]
+    prom = open(paths["prometheus"]).read()
+    assert "scrape_configs" in prom and "/metrics" in prom

@@ -204,3 +204,21 @@ class TestDatasetAggregates:
         ds = rdata.from_numpy({"x": np.arange(3.0)}).repartition(6)
         assert ds.sum("x") == 3.0
         assert ds.mean("x") == pytest.approx(1.0)
+
+
+def test_push_based_shuffle_sort_many_blocks(ray_start):
+    """Sort through the push-based (tree-merge) shuffle path with more
+    map tasks than the merge factor: reducers consume merged partials,
+    and the global order is exact (reference push_based_shuffle.py)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(300)
+    ds = rdata.from_blocks(
+        [{"v": vals[i * 30:(i + 1) * 30]} for i in range(10)])
+    out = ds.sort("v")
+    got = [r["v"] for r in out.iter_rows()]
+    assert got == sorted(vals.tolist())
+    # descending too
+    got_d = [r["v"] for r in ds.sort("v", descending=True).iter_rows()]
+    assert got_d == sorted(vals.tolist(), reverse=True)

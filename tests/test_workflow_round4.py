@@ -164,3 +164,40 @@ workflow.run(n2, workflow_id="wf_kill", storage={storage!r})
     out = workflow.resume(n2, workflow_id="wf_kill", storage=storage)
     assert out == 20
     assert os.path.isdir(steps_dir)
+
+
+def test_workflow_events_deliver_and_are_durable(tmp_path):
+    """Workflow events (reference workflow event system): a step blocks
+    on wait_for_event until send_event delivers; the payload is
+    durable, so resume never waits again."""
+    import threading
+
+    st = str(tmp_path / "wf")
+
+    def combine(payload, base):
+        return f"{base}-{payload}"
+
+    ev = workflow.wait_for_event("go", timeout_s=60)
+    node = ray_tpu.remote(combine).bind(ev, "job")
+
+    def deliver():
+        time.sleep(1.0)
+        workflow.send_event("wf_ev", "go", "payload42", storage=st)
+
+    threading.Thread(target=deliver, daemon=True).start()
+    t0 = time.time()
+    out = workflow.run(node, workflow_id="wf_ev", storage=st)
+    assert out == "job-payload42"
+    assert time.time() - t0 >= 0.9  # actually waited for delivery
+    # resume: event + step restore from checkpoints instantly
+    ev2 = workflow.wait_for_event("go", timeout_s=1)
+    node2 = ray_tpu.remote(combine).bind(ev2, "job")
+    assert workflow.resume(node2, workflow_id="wf_ev",
+                           storage=st) == "job-payload42"
+
+
+def test_workflow_event_timeout(tmp_path):
+    ev = workflow.wait_for_event("never", timeout_s=1.0)
+    with pytest.raises(Exception):
+        workflow.run(ev, workflow_id="wf_to",
+                     storage=str(tmp_path / "wf"))
