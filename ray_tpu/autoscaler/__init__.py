@@ -16,7 +16,10 @@ from ray_tpu.autoscaler.autoscaler import (FakeMultiNodeProvider,  # noqa: F401
 from ray_tpu.autoscaler.demand_scheduler import (NodeType,  # noqa: F401
                                                  PlacementGroupDemand,
                                                  get_nodes_to_launch)
+from ray_tpu.autoscaler.v2 import (AutoscalerV2,  # noqa: F401
+                                   ClusterStatusReader, InstanceManager)
 
 __all__ = ["NodeProvider", "LocalNodeProvider", "FakeMultiNodeProvider",
            "GKETPUNodeProvider", "StandardAutoscaler", "NodeType",
-           "PlacementGroupDemand", "get_nodes_to_launch"]
+           "PlacementGroupDemand", "get_nodes_to_launch",
+           "AutoscalerV2", "InstanceManager", "ClusterStatusReader"]

@@ -1,0 +1,223 @@
+"""Autoscaler v2: instance-manager architecture.
+
+reference parity: python/ray/autoscaler/v2/ — the v2 rewrite separates
+(a) a CLUSTER STATUS view served by the GCS
+(GcsAutoscalerStateManager, autoscaler.proto: pending resource
+requests + node states), (b) a pure SCHEDULER deciding desired
+instances from that status (v2/scheduler.py), and (c) an INSTANCE
+MANAGER owning each instance's lifecycle state machine
+(v2/instance_manager/: QUEUED -> REQUESTED -> ALLOCATED ->
+RAY_RUNNING -> TERMINATING -> TERMINATED) against a cloud provider.
+v1 conflates all three in StandardAutoscaler; v2's split makes each
+piece testable alone — the same property here: ClusterStatusReader is
+the GCS-facing piece, InstanceManager drives the provider, and
+AutoscalerV2.run_once wires them through the shared demand scheduler
+(demand_scheduler.get_nodes_to_launch).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.autoscaler import NodeProvider
+from ray_tpu.autoscaler.demand_scheduler import (NodeType,
+                                                 get_nodes_to_launch)
+
+logger = logging.getLogger(__name__)
+
+# instance lifecycle (reference v2/instance_manager/common.py states)
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    provider_node: Any = None
+    node_id_hex: Optional[str] = None
+    launched_at: float = field(default_factory=time.time)
+    status_history: List[str] = field(default_factory=list)
+
+    def set_status(self, status: str) -> None:
+        self.status_history.append(self.status)
+        self.status = status
+
+
+@dataclass
+class ClusterStatus:
+    """The GcsAutoscalerStateManager view (autoscaler.proto
+    GetClusterResourceState): what the scheduler needs, nothing else."""
+
+    pending_demands: List[Dict[str, float]] = field(default_factory=list)
+    node_available: List[Dict[str, float]] = field(default_factory=list)
+    alive_node_ids: List[str] = field(default_factory=list)
+    busy_node_ids: List[str] = field(default_factory=list)
+
+
+class ClusterStatusReader:
+    """Builds ClusterStatus from the GCS + node managers (the
+    in-process equivalent of the GCS autoscaler state RPC)."""
+
+    def __init__(self, gcs_address: str):
+        from ray_tpu._private import rpc as rpc_lib
+        host, port = gcs_address.rsplit(":", 1)
+        self._gcs = rpc_lib.RpcClient((host, int(port)), timeout=60)
+        self._pool = rpc_lib.ClientPool(timeout=30)
+
+    def read(self) -> ClusterStatus:
+        status = ClusterStatus()
+        try:
+            nodes = [n for n in self._gcs.call("get_all_nodes")
+                     if n.alive]
+        except Exception:  # noqa: BLE001
+            return status
+        for n in nodes:
+            try:
+                info = self._pool.get(tuple(n.address)).call(
+                    "nm_get_info")
+                workers = self._pool.get(tuple(n.address)).call(
+                    "nm_list_workers")
+            except Exception:  # noqa: BLE001
+                continue
+            nid = n.node_id.hex()
+            status.alive_node_ids.append(nid)
+            status.pending_demands.extend(
+                info.get("pending_resource_shapes") or [])
+            status.node_available.append(
+                dict(info.get("available") or {}))
+            if any(not w["idle"] for w in workers):
+                status.busy_node_ids.append(nid)
+        return status
+
+
+class InstanceManager:
+    """Owns instance records and drives them through the lifecycle
+    against the provider (reference v2/instance_manager)."""
+
+    def __init__(self, provider: NodeProvider):
+        self.provider = provider
+        self.instances: Dict[str, Instance] = {}
+
+    def launch(self, node_type: NodeType) -> Instance:
+        inst = Instance(instance_id=uuid.uuid4().hex[:12],
+                        node_type=node_type.name)
+        self.instances[inst.instance_id] = inst
+        inst.set_status(REQUESTED)
+        try:
+            node = self.provider.create_node(dict(node_type.resources))
+        except Exception:  # noqa: BLE001
+            logger.exception("provider launch failed for %s",
+                             node_type.name)
+            inst.set_status(TERMINATED)
+            return inst
+        inst.provider_node = node
+        inst.node_id_hex = node.node_id_hex
+        inst.set_status(ALLOCATED)
+        return inst
+
+    def terminate(self, inst: Instance) -> None:
+        if inst.status in (TERMINATING, TERMINATED):
+            return
+        inst.set_status(TERMINATING)
+        try:
+            if inst.provider_node is not None:
+                self.provider.terminate_node(inst.provider_node)
+        except Exception:  # noqa: BLE001
+            logger.exception("provider terminate failed for %s",
+                             inst.instance_id)
+        inst.set_status(TERMINATED)
+
+    def reconcile(self, alive_node_ids: List[str]) -> None:
+        """Advance ALLOCATED instances whose node joined the cluster to
+        RAY_RUNNING; mark instances whose provider node vanished
+        TERMINATED (reference: instance reconciler)."""
+        live = {n.provider_id for n in
+                self.provider.non_terminated_nodes()}
+        for inst in self.instances.values():
+            if inst.status == ALLOCATED and \
+                    inst.node_id_hex in alive_node_ids:
+                inst.set_status(RAY_RUNNING)
+            elif inst.status in (ALLOCATED, RAY_RUNNING) and \
+                    inst.provider_node is not None and \
+                    inst.provider_node.provider_id not in live:
+                inst.set_status(TERMINATED)
+
+    def active(self) -> List[Instance]:
+        return [i for i in self.instances.values()
+                if i.status in (REQUESTED, ALLOCATED, RAY_RUNNING)]
+
+
+class AutoscalerV2:
+    """run_once: read status -> schedule -> drive the instance manager
+    (reference v2 autoscaler loop)."""
+
+    def __init__(self, status_reader: Any, provider: NodeProvider,
+                 node_types: List[NodeType], *,
+                 max_nodes: int = 8, idle_timeout_s: float = 30.0):
+        self.reader = status_reader
+        self.im = InstanceManager(provider)
+        self.node_types = {t.name: t for t in node_types}
+        self.max_nodes = max_nodes
+        self.idle_timeout_s = idle_timeout_s
+        self._idle_since: Dict[str, float] = {}
+
+    def run_once(self) -> None:
+        status: ClusterStatus = self.reader.read()
+        self.im.reconcile(status.alive_node_ids)
+        active = self.im.active()
+        launched = 0
+        unplaceable: List[Dict[str, float]] = []
+        if status.pending_demands and len(active) < self.max_nodes:
+            # count BOOTING instances (REQUESTED/ALLOCATED — launched
+            # but not yet alive in the GCS) as existing capacity, or a
+            # single pending demand re-launches a node on every tick
+            # for the minutes a real node takes to boot
+            booting = [dict(self.node_types[i.node_type].resources)
+                       for i in active
+                       if i.status in (REQUESTED, ALLOCATED)
+                       and i.node_type in self.node_types]
+            to_launch, unplaceable = get_nodes_to_launch(
+                status.pending_demands,
+                list(status.node_available) + booting,
+                list(self.node_types.values()),
+                max_total_nodes=self.max_nodes + 1)
+            for type_name, count in to_launch.items():
+                for _ in range(count):
+                    if len(self.im.active()) >= self.max_nodes:
+                        break
+                    self.im.launch(self.node_types[type_name])
+                    launched += 1
+            if unplaceable:
+                logger.warning("autoscaler v2: %d unplaceable demands",
+                               len(unplaceable))
+        if launched:
+            return
+        # idle scale-down: runs unless there is PLACEABLE demand
+        # pressure — a permanently unplaceable demand must not pin idle
+        # nodes forever
+        placeable_pending = (len(status.pending_demands)
+                             - len(unplaceable)) if unplaceable else \
+            len(status.pending_demands)
+        now = time.time()
+        for inst in self.im.active():
+            if inst.status != RAY_RUNNING:
+                continue
+            busy = inst.node_id_hex in status.busy_node_ids
+            if not busy and placeable_pending == 0:
+                first = self._idle_since.setdefault(inst.instance_id,
+                                                    now)
+                if now - first >= self.idle_timeout_s:
+                    self.im.terminate(inst)
+                    self._idle_since.pop(inst.instance_id, None)
+            else:
+                self._idle_since.pop(inst.instance_id, None)

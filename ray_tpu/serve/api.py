@@ -157,6 +157,9 @@ class DeploymentHandle:
                 live = {r._actor_id.hex() for r in replicas}
                 self._in_flight = {k: v for k, v in self._in_flight.items()
                                    if k in live}
+                self._model_cache = {
+                    k: v for k, v in self._model_cache.items()
+                    if k in live}
             self._last_refresh = time.time()
 
     def __reduce__(self):

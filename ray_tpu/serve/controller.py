@@ -60,42 +60,40 @@ class _MultiplexWrapper:
     def load(self, owner, model_id: str):
         # per-model-id load serialization: concurrent requests for the
         # same missing model must not both run the (possibly HBM-
-        # hungry) loader — the reference wrapper serializes loads too
-        with self._lock:
-            if model_id in self.models:
-                model = self.models.pop(model_id)
-                self.models[model_id] = model  # refresh LRU position
-                return model
-            gate = self._loading.get(model_id)
-            if gate is None:
-                gate = threading.Event()
-                self._loading[model_id] = gate
-                is_loader = True
-            else:
-                is_loader = False
-        if not is_loader:
-            gate.wait(timeout=600)
+        # hungry) loader — the reference wrapper serializes loads too.
+        # Waiters loop: on wake they re-check the cache (the loader
+        # publishes the model BEFORE setting the gate), and if the
+        # loader failed exactly one waiter becomes the next loader.
+        while True:
             with self._lock:
                 if model_id in self.models:
-                    return self.models[model_id]
-            # loader failed: fall through and try ourselves
-            with self._lock:
-                self._loading[model_id] = gate = threading.Event()
+                    model = self.models.pop(model_id)
+                    self.models[model_id] = model  # refresh LRU position
+                    return model
+                gate = self._loading.get(model_id)
+                if gate is None:
+                    gate = threading.Event()
+                    self._loading[model_id] = gate
+                    break  # we are the loader
+            gate.wait(timeout=600)
         try:
             model = self.loader(owner, model_id)
+            with self._lock:
+                self.models[model_id] = model
+                while len(self.models) > self.max_models:
+                    evicted_id = next(iter(self.models))
+                    self.models.pop(evicted_id)
+                    logger.info(
+                        "multiplex: evicted model %s (dropped; "
+                        "resources release with its refcount)",
+                        evicted_id)
+            return model
         finally:
+            # publish-then-release ordering: models[...] is already set
+            # (on success) when waiters wake
             with self._lock:
                 self._loading.pop(model_id, None)
             gate.set()
-        with self._lock:
-            self.models[model_id] = model
-            while len(self.models) > self.max_models:
-                evicted_id = next(iter(self.models))
-                self.models.pop(evicted_id)
-                logger.info("multiplex: evicted model %s (dropped; "
-                            "resources release with its refcount)",
-                            evicted_id)
-        return model
 
     def loaded_ids(self) -> List[str]:
         with self._lock:

@@ -19,9 +19,9 @@ STEPS = 10
 FLOPS_PER_TOKEN = 968e6
 
 
-def run_variant(name: str, *, n_heads=12, loss_chunk=256, batch=BATCH,
+def run_variant(name: str, *, n_heads=6, loss_chunk=0, batch=BATCH,
                 no_head=False, attention_impl="auto", scan_unroll=12,
-                remat=False):
+                remat=False, sgd=False, no_attn=False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -41,6 +41,15 @@ def run_variant(name: str, *, n_heads=12, loss_chunk=256, batch=BATCH,
         jax.random.PRNGKey(1), (batch * len(devices), cfg.max_seq_len + 1),
         0, 50257)
 
+    restore_attn = None
+    if no_attn:
+        # identity attention: measures the whole attention block's cost
+        import ray_tpu.models.transformer as tr
+        restore_attn = tr.Transformer.__dict__["_make_attention"]
+
+        def fake_make(cfg2, mesh2, rules2):
+            return lambda q, k, v, scale: q
+        tr.Transformer._make_attention = staticmethod(fake_make)
     if no_head:
         def loss_fn(p, b):
             h = Transformer.hidden(p, b["tokens"][:, :-1], cfg, mesh=mesh)
@@ -49,9 +58,10 @@ def run_variant(name: str, *, n_heads=12, loss_chunk=256, batch=BATCH,
         def loss_fn(p, b):
             return Transformer.loss(p, b, cfg, mesh=mesh)
 
+    opt = optax.sgd(1e-4) if sgd else \
+        optax.adamw(1e-4, weight_decay=0.01)
     init_state, train_step = make_train_step(
-        loss_fn, Transformer.param_specs(cfg), mesh,
-        optimizer=optax.adamw(1e-4, weight_decay=0.01))
+        loss_fn, Transformer.param_specs(cfg), mesh, optimizer=opt)
     state = init_state(params)
     batch_d = {"tokens": tokens}
     for _ in range(WARMUP):
@@ -68,25 +78,34 @@ def run_variant(name: str, *, n_heads=12, loss_chunk=256, batch=BATCH,
           f"tflops={tps*FLOPS_PER_TOKEN/1e12:6.1f} loss={loss:.4f}",
           flush=True)
     del state
+    if restore_attn is not None:
+        import ray_tpu.models.transformer as tr
+        tr.Transformer._make_attention = restore_attn
 
 
+# NOTE: run_variant's defaults ARE the shipping bench config (heads6 +
+# unchunked CE). Legacy round-3/4a variants pin every divergent knob
+# explicitly so their meaning never drifts when defaults move.
 VARIANTS = {
-    "baseline": {},
-    "heads6": {"n_heads": 6},
-    "chunk512": {"loss_chunk": 512},
+    "r3_baseline": {"n_heads": 12, "loss_chunk": 256},
+    "r3_heads6": {"n_heads": 6, "loss_chunk": 256},
+    "r3_chunk512": {"n_heads": 12, "loss_chunk": 512},
     "heads6_chunk512": {"n_heads": 6, "loss_chunk": 512},
-    "nohead": {"no_head": True},
-    "nohead_heads6": {"no_head": True, "n_heads": 6},
-    "dense": {"attention_impl": "dense"},
-    "batch32": {"batch": 32},
-    "heads6_batch32": {"n_heads": 6, "batch": 32},
-    "chunk128": {"loss_chunk": 128},
-    "nochunk": {"loss_chunk": 0},
+    "nohead": {"no_head": True, "n_heads": 12, "loss_chunk": 256},
+    "nohead_heads6": {"no_head": True, "n_heads": 6, "loss_chunk": 256},
+    "r3_dense": {"n_heads": 12, "loss_chunk": 256,
+                 "attention_impl": "dense"},
     "heads6_b32_c512": {"n_heads": 6, "batch": 32, "loss_chunk": 512},
     "heads6_dense_c512": {"n_heads": 6, "attention_impl": "dense",
                           "loss_chunk": 512},
-    "heads6_nochunk": {"n_heads": 6, "loss_chunk": 0},
-    "heads4_c512": {"n_heads": 4, "loss_chunk": 512},
+    # round-4b: decompose the ~40% non-matmul time around the shipping
+    # config ("best" = the defaults)
+    "best": {},
+    "best_sgd": {"sgd": True},
+    "best_noattn": {"no_attn": True},
+    "best_dense": {"attention_impl": "dense"},
+    "best_b24": {"batch": 24},
+    "best_unroll1": {"scan_unroll": 1},
 }
 
 
