@@ -59,17 +59,32 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
+_chaos_rng = None
+
+
 def _chaos_delay() -> None:
     """Chaos testing: inject a random handler delay (reference
     asio_chaos.cc:29-40, env RAY_testing_asio_delay_us). Set
     RAY_TPU_testing_rpc_delay_us to randomize RPC handler latencies and
-    surface race/ordering bugs in tests."""
+    surface race/ordering bugs in tests. With
+    RAY_TPU_testing_rpc_delay_seed also set, every process draws from
+    the SAME seeded stream, so sweeping seeds explores different delay
+    schedules and re-running a seed replays the per-process schedules
+    (best effort — OS scheduling nondeterminism still varies the
+    interleaving across runs; the reference relies on TSAN + the same
+    asio randomization)."""
     from ray_tpu._private.config import Config
     max_us = Config.testing_rpc_delay_us
     if max_us > 0:
         import random
         import time
-        time.sleep(random.uniform(0, max_us) / 1e6)
+        global _chaos_rng
+        if _chaos_rng is None:
+            import os
+            seed = os.environ.get("RAY_TPU_testing_rpc_delay_seed")
+            _chaos_rng = random.Random(
+                None if seed is None else int(seed))
+        time.sleep(_chaos_rng.uniform(0, max_us) / 1e6)
 
 
 class _Handler(socketserver.BaseRequestHandler):

@@ -281,7 +281,24 @@ class ActorClass:
                                          method_opts, group_names,
                                          int(opts.get("max_pending_calls",
                                                       -1)))))
-        cw.create_actor(spec, name=name, namespace=namespace)
+        try:
+            cw.create_actor(spec, name=name, namespace=namespace)
+        except Exception as e:  # noqa: BLE001
+            # get_if_exists race: two creators checked the directory,
+            # found nothing, and both registered — the loser must fall
+            # back to the winner's actor, not error (reference
+            # get_if_exists semantics; surfaced by the seeded-chaos
+            # interleaving sweep, tests/test_fault_tolerance.py)
+            if name and opts.get("get_if_exists"):
+                info = cw._gcs.call("get_named_actor", name=name,
+                                    namespace=namespace)
+                if info is not None and info.state != "DEAD":
+                    return ActorHandle(
+                        info.actor_id, self._cls.__name__,
+                        self._method_names(), self._fn_key,
+                        method_opts, group_names,
+                        int(opts.get("max_pending_calls", -1)))
+            raise
         return ActorHandle(actor_id, self._cls.__name__,
                            self._method_names(), self._fn_key,
                            method_opts, group_names,

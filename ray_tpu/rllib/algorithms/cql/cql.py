@@ -1,0 +1,130 @@
+"""CQL: conservative Q-learning (offline RL on SAC machinery).
+
+reference parity: rllib/algorithms/cql/cql.py (CQLConfig —
+min_q_weight, num_actions over SACConfig; offline input required;
+the reference's bc_iters actor warm-up is NOT implemented here) and
+cql_torch_policy.py (cql_loss: the SAC actor-critic loss plus the
+conservative regularizer min_q_weight * (logsumexp_a Q(s,a) - Q(s,
+a_data)) estimated over `num_actions` uniform + policy-sampled actions
+with importance correction). TPU-first shape: the regularizer joins
+SAC's single fused jitted update; offline fragments stream from
+JsonReader shards and convert to transition tuples through DQN's exact
+n-step/truncation-aware converter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.dqn.dqn import fragment_to_transitions
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rllib.offline.json_io import JsonReader
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or CQL)
+        self.min_q_weight = 5.0       # conservative penalty scale
+        self.num_actions = 4          # sampled actions for logsumexp
+        self.input_ = None            # offline JSONL dir (required)
+        # offline: no env stepping, learn every iteration
+        self.num_steps_sampled_before_learning_starts = 0
+        self.evaluation_interval = 0
+        self.evaluation_duration = 256
+
+
+class CQLLearner(SACLearner):
+    """SAC's fused update + the conservative penalty on both critics."""
+
+    def compute_loss(self, params, batch, extra):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        loss, stats = super().compute_loss(params, batch, extra)
+        m = self.module
+        cfg = self.config
+        n = cfg.num_actions
+        obs = batch["obs"]
+        b = obs.shape[0]
+        k_unif, k_pi = jax.random.split(
+            jax.random.fold_in(extra["rng"], 991))
+
+        # candidate actions: uniform over the box + current policy
+        # samples, with the standard CQL importance corrections
+        low = jnp.asarray(m.low)
+        high = jnp.asarray(m.high)
+        unif = jax.random.uniform(
+            k_unif, (n, b, m.act_dim), minval=low, maxval=high)
+        rep_obs = jnp.broadcast_to(obs, (n, *obs.shape))
+        pi_a, pi_logp = m.sample_action(
+            params, rep_obs.reshape(n * b, -1), k_pi)
+        # the conservative penalty trains the CRITIC only (reference
+        # CQL keeps separate optimizers); with the fused update the
+        # reparameterized policy sample must be fenced or the penalty
+        # would train the actor to minimize its own Q
+        pi_a = lax.stop_gradient(pi_a.reshape(n, b, m.act_dim))
+        pi_logp = pi_logp.reshape(n, b)
+        # log-uniform density over the box volume
+        log_unif = -jnp.sum(jnp.log(high - low))
+
+        def q_of(actions):
+            q1, q2 = m.q_values(params, rep_obs.reshape(n * b, -1),
+                                actions.reshape(n * b, -1))
+            return q1.reshape(n, b), q2.reshape(n, b)
+
+        uq1, uq2 = q_of(unif)
+        pq1, pq2 = q_of(pi_a)
+        cat1 = jnp.concatenate(
+            [uq1 - log_unif, pq1 - lax.stop_gradient(pi_logp)], axis=0)
+        cat2 = jnp.concatenate(
+            [uq2 - log_unif, pq2 - lax.stop_gradient(pi_logp)], axis=0)
+        lse1 = jax.nn.logsumexp(cat1, axis=0) - jnp.log(2 * n)
+        lse2 = jax.nn.logsumexp(cat2, axis=0) - jnp.log(2 * n)
+        dq1, dq2 = m.q_values(params, obs, batch["actions"])
+        cql_term = (jnp.mean(lse1 - dq1) + jnp.mean(lse2 - dq2))
+        loss = loss + cfg.min_q_weight * cql_term
+        stats = dict(stats)
+        stats["cql_loss"] = cql_term
+        return loss, stats
+
+
+class CQL(SAC):
+    """Offline training loop: stream recorded fragments -> transition
+    tuples -> fused CQL update (no env sampling; reference cql.py
+    training_step reads from the offline input)."""
+
+    learner_cls = CQLLearner
+
+    def __init__(self, config: "CQLConfig"):
+        if not config.input_:
+            raise ValueError(
+                "CQL is an offline algorithm: point "
+                "config.offline_data(input_=...) at a JsonWriter dir")
+        super().__init__(config)
+        self._reader = JsonReader(config.input_, seed=config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        parts, rows = [], 0
+        while rows < cfg.train_batch_size:
+            frag = self._reader.next()
+            tr = fragment_to_transitions(frag, cfg.gamma, cfg.n_step)
+            parts.append(tr)
+            rows += len(tr["obs"])
+        batch = {k: np.concatenate([p[k] for p in parts])
+                 for k in parts[0]}
+        self._timesteps_total += rows
+        stats = self.learner_group.update(
+            batch, seed=cfg.seed + self._iteration)
+
+        if cfg.evaluation_interval and \
+                self._iteration % cfg.evaluation_interval == 0:
+            self.env_runners.sync_weights(
+                self.learner_group.get_weights())
+            frags = self.env_runners.sample_sync(
+                cfg.evaluation_duration // max(1, len(self.env_runners)))
+            self._record_episode_metrics(frags)
+        return {"learner": stats, "num_offline_steps_trained": rows}

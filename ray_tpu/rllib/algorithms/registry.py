@@ -1,9 +1,11 @@
 """Algorithm registry (reference rllib/algorithms/registry.py).
 
-The reference registers ~34 algorithms; the TPU build ships the
+The reference registers ~34 algorithms; the TPU build ships 14 — the
 north-star set (SURVEY §8.3: ppo, impala, + appo sharing IMPALA's
-machinery) behind the same lookup surface so `get_algorithm_class("PPO")`
-and Tuner-by-name work.
+machinery) plus the value-learning (DQN/SimpleQ/SAC/TD3/DDPG/CQL),
+on-policy (PG/A2C), derivative-free (ES) and offline (BC/MARWIL/CQL)
+families — behind the same lookup surface so
+`get_algorithm_class("PPO")` and Tuner-by-name work.
 """
 
 from __future__ import annotations
@@ -12,7 +14,11 @@ from typing import Tuple, Type
 
 
 def _registry():
+    from ray_tpu.rllib.algorithms.a2c.a2c import A2C, A2CConfig
     from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
+    from ray_tpu.rllib.algorithms.cql.cql import CQL, CQLConfig
+    from ray_tpu.rllib.algorithms.dqn.simple_q import (SimpleQ,
+                                                       SimpleQConfig)
     from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPG, DDPGConfig
     from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
     from ray_tpu.rllib.algorithms.impala.impala import Impala, ImpalaConfig
@@ -36,6 +42,9 @@ def _registry():
         "PG": (PG, PGConfig),
         "TD3": (TD3, TD3Config),
         "DDPG": (DDPG, DDPGConfig),
+        "A2C": (A2C, A2CConfig),
+        "SIMPLEQ": (SimpleQ, SimpleQConfig),
+        "CQL": (CQL, CQLConfig),
     }
 
 

@@ -197,3 +197,76 @@ print("CHAOS_OK")
                           cwd=os.path.dirname(os.path.dirname(__file__)))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "CHAOS_OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_chaos_seed_sweep_race_prone_workload():
+    """Systematic interleaving exploration (VERDICT r3 §5.2): the same
+    RACE-PRONE workload runs under several chaos seeds — each seed
+    yields a different reproducible RPC-delay schedule. The workload
+    concentrates historically racy paths: concurrent get_if_exists
+    named-actor creation, max_pending_calls backpressure, streaming
+    generator consumption mid-execution, and a kill racing in-flight
+    calls."""
+    script = """
+import threading
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+
+# 1) racing named-actor creation from two threads
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def bump(self):
+        self.n += 1
+        return self.n
+
+handles = []
+def make():
+    handles.append(Counter.options(
+        name="chaos_ctr", get_if_exists=True, num_cpus=0.05).remote())
+ts = [threading.Thread(target=make) for _ in range(2)]
+[t.start() for t in ts]; [t.join() for t in ts]
+# both threads must resolve to the SAME actor
+vals = ray_tpu.get([h.bump.remote() for h in handles], timeout=120)
+assert sorted(vals) == [1, 2], vals
+
+# 2) streaming generator consumed while producing, under delays
+@ray_tpu.remote(num_returns="streaming")
+def gen(n):
+    for i in range(n):
+        yield i
+got = [ray_tpu.get(r, timeout=60) for r in gen.remote(5)]
+assert got == list(range(5)), got
+
+# 3) kill racing in-flight calls -> every ref resolves to either a
+# result or an ACTOR-death error (never a hang, never a foreign error)
+victim = Counter.options(num_cpus=0.05).remote()
+refs = [victim.bump.remote() for _ in range(5)]
+ray_tpu.kill(victim)
+done, died = 0, 0
+for r in refs:
+    try:
+        assert isinstance(ray_tpu.get(r, timeout=60), int)
+        done += 1
+    except Exception as e:
+        assert "actor" in type(e).__name__.lower() or \
+            "actor" in str(e).lower(), (type(e).__name__, e)
+        died += 1
+assert done + died == 5, (done, died)
+ray_tpu.shutdown()
+print("SEEDED_CHAOS_OK")
+"""
+    for seed in (1, 7, 42):
+        env = dict(os.environ)
+        env["RAY_TPU_testing_rpc_delay_us"] = "3000"
+        env["RAY_TPU_testing_rpc_delay_seed"] = str(seed)
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", script], env=env,
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(__file__)))
+        assert proc.returncode == 0, (
+            f"seed {seed}:\n" + proc.stdout[-2000:]
+            + proc.stderr[-2000:])
+        assert "SEEDED_CHAOS_OK" in proc.stdout, f"seed {seed}"
