@@ -293,6 +293,12 @@ class ActorClass:
                 info = cw._gcs.call("get_named_actor", name=name,
                                     namespace=namespace)
                 if info is not None and info.state != "DEAD":
+                    try:  # reclaim the loser's orphaned spec metadata
+                        cw._gcs.call(
+                            "kv_del",
+                            key=f"__actor_spec_meta:{actor_id.hex()}")
+                    except Exception:  # noqa: BLE001
+                        pass
                     return ActorHandle(
                         info.actor_id, self._cls.__name__,
                         self._method_names(), self._fn_key,

@@ -3,13 +3,14 @@
 reference parity: rllib/algorithms/a2c/a2c.py (A2CConfig over
 PPOConfig's on-policy plumbing: microbatch_size accumulating gradients
 toward train_batch_size; loss = policy gradient with GAE advantages +
-value loss + entropy, a2c_torch_policy.py). Distinctions from PG here:
-bootstrapped GAE advantages (lambda < 1, n-step flavored) instead of
-Monte-Carlo returns, and microbatched updates — this build maps
-microbatch_size onto the learner's minibatch loop (per-microbatch Adam
-steps rather than the reference's gradient accumulation; at A2C's
-single-epoch on-policy regime the two are equivalent up to Adam's
-step-size normalization).
+value loss + entropy, a2c_torch_policy.py). Distinctions from PG here: fragment-boundary
+bootstrapping through GAE (PG uses whole-episode Monte-Carlo shaped
+rollouts; lambda is configurable — lower it below 1.0 for the
+bias/variance trade the reference's n-step returns provide) and
+microbatched updates — this build maps microbatch_size onto the
+learner's minibatch loop (per-microbatch Adam steps rather than the
+reference's gradient accumulation; at A2C's single-epoch on-policy
+regime the two are equivalent up to Adam's step-size normalization).
 """
 
 from __future__ import annotations

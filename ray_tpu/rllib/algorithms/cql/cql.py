@@ -114,11 +114,17 @@ class CQL(SAC):
             tr = fragment_to_transitions(frag, cfg.gamma, cfg.n_step)
             parts.append(tr)
             rows += len(tr["obs"])
+        # slice to EXACTLY train_batch_size: variable fragment sizes
+        # would otherwise recompile the fused update per new length
         batch = {k: np.concatenate([p[k] for p in parts])
-                 for k in parts[0]}
+                 [:cfg.train_batch_size] for k in parts[0]}
+        rows = cfg.train_batch_size
         self._timesteps_total += rows
         stats = self.learner_group.update(
             batch, seed=cfg.seed + self._iteration)
+        # polyak target update: SAC gets this from the replay loop's
+        # _after_each_update hook, which this offline loop replaces
+        self._after_each_update()
 
         if cfg.evaluation_interval and \
                 self._iteration % cfg.evaluation_interval == 0:

@@ -57,7 +57,11 @@ def test_simple_q_trains_smoke():
             result = algo.train()
         assert result["num_env_steps_sampled"] > 0
         learner = result.get("learner", {})
-        assert np.isfinite(list(learner.values())[0]) or learner
+        assert learner, "no learner stats after 3 iterations"
+        finite_stats = [v for v in learner.values()
+                        if isinstance(v, (int, float))]
+        assert finite_stats and all(np.isfinite(v)
+                                    for v in finite_stats), learner
     finally:
         algo.stop()
 
@@ -115,13 +119,16 @@ def test_cql_trains_on_recorded_fragments(tmp_path):
         assert "cql_loss" in learner
         assert np.isfinite(learner["cql_loss"])
         assert np.isfinite(learner["critic_loss"])
-        assert result["num_offline_steps_trained"] >= 64
-        # conservative penalty shrinks logsumexp-vs-data gap over a few
-        # updates on a fixed dataset (sanity, not a perf claim)
-        first = learner["cql_loss"]
+        assert result["num_offline_steps_trained"] == 64
+        first_cql = float(learner["cql_loss"])
         for _ in range(4):
             result = algo.train()
-        assert np.isfinite(result["learner"]["critic_loss"])
+        last = result["learner"]
+        assert np.isfinite(last["critic_loss"])
+        # the update is actually optimizing: the conservative gap moves
+        # on a fixed dataset (exact trajectory is data-dependent; a
+        # frozen/no-op update would leave it bit-identical)
+        assert float(last["cql_loss"]) != first_cql
     finally:
         algo.stop()
 
