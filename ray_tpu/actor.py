@@ -284,6 +284,14 @@ class ActorClass:
         try:
             cw.create_actor(spec, name=name, namespace=namespace)
         except Exception as e:  # noqa: BLE001
+            # EVERY failed creation reclaims the spec metadata written
+            # above — otherwise each failure leaks a permanent GCS KV
+            # entry for an actor that never existed
+            try:
+                cw._gcs.call("kv_del",
+                             key=f"__actor_spec_meta:{actor_id.hex()}")
+            except Exception:  # noqa: BLE001
+                pass
             # get_if_exists race: two creators checked the directory,
             # found nothing, and both registered — the loser must fall
             # back to the winner's actor, not error (reference
@@ -293,12 +301,6 @@ class ActorClass:
                 info = cw._gcs.call("get_named_actor", name=name,
                                     namespace=namespace)
                 if info is not None and info.state != "DEAD":
-                    try:  # reclaim the loser's orphaned spec metadata
-                        cw._gcs.call(
-                            "kv_del",
-                            key=f"__actor_spec_meta:{actor_id.hex()}")
-                    except Exception:  # noqa: BLE001
-                        pass
                     return ActorHandle(
                         info.actor_id, self._cls.__name__,
                         self._method_names(), self._fn_key,

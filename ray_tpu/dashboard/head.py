@@ -218,5 +218,7 @@ def start_dashboard(port: int = 8265, host: str = "127.0.0.1"):
         num_cpus=0.1, max_concurrency=4,
         scheduling_strategy=NodeAffinitySchedulingStrategy(
             node_id=here)).remote(port, host)
-    ray_tpu.get(dash.ready.remote(), timeout=60)
+    # generous readiness window: on a loaded CI box the spawn can sit
+    # behind a full worker pool (and occasionally ride a lease retry)
+    ray_tpu.get(dash.ready.remote(), timeout=180)
     return dash

@@ -82,7 +82,7 @@ class SklearnTrainer:
                     continue
                 Xv, yv = split(block)
                 metrics[f"{name}_score"] = float(est.score(Xv, yv))
-            ckpt_dir = os.path.join(run_dir, "checkpoint_000000")
+            ckpt_dir = os.path.join(run_dir, "_worker_staging")
             os.makedirs(ckpt_dir, exist_ok=True)
             with open(os.path.join(ckpt_dir, "estimator.pkl"),
                       "wb") as f:
@@ -93,12 +93,25 @@ class SklearnTrainer:
         cpus = (self.scaling_config.trainer_resources or
                 {"CPU": 1}).get("CPU", 1)
         fit_remote = ray_tpu.remote(_fit).options(num_cpus=cpus)
-        metrics = ray_tpu.get(fit_remote.remote(
-            pickle.dumps(self.estimator), self.datasets,
-            self.label_column, self.params, self.scoring, self.cv,
-            run_dir), timeout=3600)
+        try:
+            metrics = ray_tpu.get(fit_remote.remote(
+                pickle.dumps(self.estimator), self.datasets,
+                self.label_column, self.params, self.scoring, self.cv,
+                run_dir), timeout=3600)
+        except Exception as e:  # noqa: BLE001 — same contract as the
+            # other trainers: errors surface on Result.error, not raise
+            return Result(metrics={}, checkpoint=None, error=e,
+                          path=run_dir)
         ckpt_dir = metrics.pop("checkpoint_dir")
-        from ray_tpu.train.checkpoint import Checkpoint
-        return Result(metrics=metrics,
-                      checkpoint=Checkpoint(ckpt_dir),
-                      error=None, path=run_dir)
+        # register through the shared manager so
+        # RunConfig.checkpoint_config (num_to_keep, score attr) applies
+        from ray_tpu.train.checkpoint_manager import CheckpointManager
+        mgr = CheckpointManager(run_dir,
+                                self.run_config.checkpoint_config)
+        ckpt = mgr.register(ckpt_dir, metrics)
+        import shutil
+        shutil.rmtree(ckpt_dir, ignore_errors=True)  # staged copy
+        return Result(metrics=metrics, checkpoint=ckpt,
+                      error=None, path=run_dir,
+                      metrics_history=[dict(metrics)],
+                      _best_checkpoints=mgr.list())

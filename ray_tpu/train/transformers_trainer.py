@@ -38,6 +38,19 @@ def prepare_trainer(trainer):
                 metrics["step"] = state.global_step
                 train_mod.report(metrics)
 
+        def on_save(self, args, state, control, **kwargs):
+            # bridge HF checkpoint saves into the session's checkpoint
+            # stream (reference RayTrainReportCallback does the same),
+            # so fit() returns real checkpoints and resume works
+            import os
+            from ray_tpu.train.checkpoint import Checkpoint
+            ckpt_dir = os.path.join(
+                args.output_dir, f"checkpoint-{state.global_step}")
+            if os.path.isdir(ckpt_dir):
+                train_mod.report({"step": state.global_step,
+                                  "hf_checkpoint": True},
+                                 checkpoint=Checkpoint(ckpt_dir))
+
     if not any(type(cb).__name__ == "_RayTpuReportCallback"
                for cb in trainer.callback_handler.callbacks):
         trainer.add_callback(_RayTpuReportCallback())
@@ -62,9 +75,12 @@ class TransformersTrainer(DataParallelTrainer):
         init_fn = trainer_init_per_worker
 
         def train_loop(config: Dict[str, Any]) -> None:
+            import ray_tpu.train as train_mod
             trainer = init_fn(config)
             prepare_trainer(trainer)
-            trainer.train()
+            ckpt = train_mod.get_checkpoint()
+            trainer.train(resume_from_checkpoint=ckpt.path
+                          if ckpt is not None else None)
 
         super().__init__(
             train_loop,
