@@ -284,14 +284,20 @@ class ActorClass:
         try:
             cw.create_actor(spec, name=name, namespace=namespace)
         except Exception as e:  # noqa: BLE001
-            # EVERY failed creation reclaims the spec metadata written
-            # above — otherwise each failure leaks a permanent GCS KV
-            # entry for an actor that never existed
+            # Reclaim the spec metadata written above — but ONLY when
+            # the GCS confirms it never registered this actor. A lost
+            # RPC response can raise client-side after a server-side
+            # success; deleting the meta then would orphan a LIVE actor
+            # (get_actor() needs it forever after).
             try:
-                cw._gcs.call("kv_del",
-                             key=f"__actor_spec_meta:{actor_id.hex()}")
+                reg = cw._gcs.call("get_actor_info",
+                                   actor_id_hex=actor_id.hex())
+                if reg is None:
+                    cw._gcs.call(
+                        "kv_del",
+                        key=f"__actor_spec_meta:{actor_id.hex()}")
             except Exception:  # noqa: BLE001
-                pass
+                pass  # unreachable GCS: leave the meta in place
             # get_if_exists race: two creators checked the directory,
             # found nothing, and both registered — the loser must fall
             # back to the winner's actor, not error (reference

@@ -14,6 +14,7 @@ from ray_tpu.train.jax_backend import JaxConfig  # noqa: F401
 from ray_tpu.train.jax_trainer import JaxTrainer  # noqa: F401
 from ray_tpu.train.tensorflow_backend import TensorflowConfig  # noqa: F401
 from ray_tpu.train.tensorflow_trainer import TensorflowTrainer  # noqa: F401
+from ray_tpu.train.accelerate_trainer import AccelerateTrainer  # noqa: F401
 from ray_tpu.train.sklearn_trainer import SklearnTrainer  # noqa: F401
 from ray_tpu.train.torch_trainer import TorchTrainer  # noqa: F401
 from ray_tpu.train.transformers_trainer import (TransformersTrainer,  # noqa: F401,E501
@@ -27,6 +28,7 @@ __all__ = [
     "ScalingConfig", "DataParallelTrainer", "Result", "JaxConfig",
     "JaxTrainer", "TorchTrainer", "TorchConfig", "TensorflowTrainer",
     "TransformersTrainer", "prepare_trainer", "SklearnTrainer",
+    "AccelerateTrainer",
     "TensorflowConfig", "TrainContext", "report", "get_checkpoint",
     "get_context", "get_dataset_shard",
 ]

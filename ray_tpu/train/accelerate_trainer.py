@@ -1,0 +1,24 @@
+"""AccelerateTrainer: HF Accelerate train loops over the worker gang.
+
+reference parity: python/ray/train/huggingface/accelerate —
+AccelerateTrainer runs a user `train_loop_per_worker` that constructs
+`accelerate.Accelerator()` inside an already-wired torch process group
+(the Ray side provides RANK/WORLD_SIZE/MASTER_ADDR and the gloo/nccl
+group; Accelerate detects the environment and handles device placement
++ DDP wrapping + gradient accumulation). Here the torch backend wires
+gloo and the same env, so unmodified Accelerate loops run on the gang.
+TPU-first note: as with TransformersTrainer this exists for torch-side
+parity — TPU training's first-class path is JaxTrainer.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.torch_trainer import TorchTrainer
+
+
+class AccelerateTrainer(TorchTrainer):
+    """Exactly TorchTrainer (as in the reference): the
+    `train_loop_per_worker(config)` builds its own Accelerator inside
+    the torch process group the backend established; Accelerate detects
+    the distributed env and handles device placement/DDP/grad
+    accumulation itself."""

@@ -11,6 +11,7 @@ ray_tpu.tune).
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -22,6 +23,8 @@ from ray_tpu.train.backend_executor import (BackendExecutor,
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.config import RunConfig, ScalingConfig
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -100,10 +103,19 @@ class DataParallelTrainer:
                              if r.checkpoint_dir]
                 if ckpt_dirs:
                     # all ranks report the same logical checkpoint; rank 0
-                    # (or the only reporter) wins
-                    persisted = ckpt_mgr.register(
-                        r0.checkpoint_dir or ckpt_dirs[0], r0.metrics)
-                    executor.note_checkpoint(persisted.path)
+                    # (or the only reporter) wins. A vanished worker dir
+                    # (e.g. HF's save_total_limit rotated it away before
+                    # the copy) loses that checkpoint, not the run.
+                    try:
+                        persisted = ckpt_mgr.register(
+                            r0.checkpoint_dir or ckpt_dirs[0],
+                            r0.metrics)
+                        executor.note_checkpoint(persisted.path)
+                    except OSError as ce:
+                        logger.warning(
+                            "checkpoint dir %s disappeared before "
+                            "persisting (%s); continuing",
+                            r0.checkpoint_dir or ckpt_dirs[0], ce)
         except TrainingWorkerError as e:
             error = e
         finally:

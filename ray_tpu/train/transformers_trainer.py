@@ -75,12 +75,26 @@ class TransformersTrainer(DataParallelTrainer):
         init_fn = trainer_init_per_worker
 
         def train_loop(config: Dict[str, Any]) -> None:
+            import logging
+            import os
             import ray_tpu.train as train_mod
             trainer = init_fn(config)
             prepare_trainer(trainer)
             ckpt = train_mod.get_checkpoint()
-            trainer.train(resume_from_checkpoint=ckpt.path
-                          if ckpt is not None else None)
+            resume = None
+            if ckpt is not None:
+                # only hand HF a dir it can actually resume from; a
+                # non-HF checkpoint (user-reported dir, older run)
+                # would raise inside trainer.train on EVERY restart,
+                # turning a recoverable failure into a crash loop
+                if os.path.exists(os.path.join(ckpt.path,
+                                               "trainer_state.json")):
+                    resume = ckpt.path
+                else:
+                    logging.getLogger(__name__).warning(
+                        "checkpoint %s is not an HF trainer "
+                        "checkpoint; training from scratch", ckpt.path)
+            trainer.train(resume_from_checkpoint=resume)
 
         super().__init__(
             train_loop,

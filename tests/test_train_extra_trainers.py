@@ -97,3 +97,46 @@ def test_transformers_trainer_tiny_bert(tmp_path):
     # the report callback surfaced HF's loss logs
     assert any("loss" in m for m in result.metrics_history), \
         result.metrics_history
+
+
+def test_accelerate_trainer_runs_loop(tmp_path):
+    """AccelerateTrainer (reference train/huggingface/accelerate): an
+    unmodified Accelerate loop — Accelerator(), prepare(model,
+    optimizer, loader), backward — runs on the gang and reports."""
+    from ray_tpu.train import AccelerateTrainer
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+
+    def loop(config=None):
+        import torch
+        from accelerate import Accelerator
+
+        import ray_tpu.train as train
+
+        accelerator = Accelerator(cpu=True)
+        model = torch.nn.Linear(4, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        xs = torch.randn(64, 4)
+        ys = xs.sum(dim=1, keepdim=True)
+        loader = torch.utils.data.DataLoader(
+            torch.utils.data.TensorDataset(xs, ys), batch_size=16)
+        model, opt, loader = accelerator.prepare(model, opt, loader)
+        for epoch in range(3):
+            total = 0.0
+            for xb, yb in loader:
+                opt.zero_grad()
+                loss = torch.nn.functional.mse_loss(model(xb), yb)
+                accelerator.backward(loss)
+                opt.step()
+                total += float(loss.detach())
+            train.report({"epoch": epoch, "loss": total})
+
+    result = AccelerateTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None, result.error
+    losses = [m["loss"] for m in result.metrics_history
+              if "loss" in m]
+    assert len(losses) == 3 and losses[-1] < losses[0]

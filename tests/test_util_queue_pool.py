@@ -91,14 +91,17 @@ def test_queue_many_parked_consumers_no_deadlock(ray_start):
     try:
         @ray_tpu.remote
         def waiter(queue, i):
-            return (i, queue.get(timeout=60))
+            # generous park window: on a loaded 1-core CI box the 6
+            # worker processes spawn serially (~1-3s each) behind
+            # whatever the previous tests left busy
+            return (i, queue.get(timeout=240))
 
         refs = [waiter.options(num_cpus=0.2).remote(q, i)
                 for i in range(6)]
         import time
         time.sleep(1.0)  # let consumers park
         q.put_batch(list(range(6)))
-        out = ray_tpu.get(refs, timeout=120)
+        out = ray_tpu.get(refs, timeout=300)
         assert sorted(v for _, v in out) == list(range(6))
     finally:
         q.shutdown()
