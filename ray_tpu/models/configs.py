@@ -47,6 +47,14 @@ class TransformerConfig:
     # time for shallow stacks. Keep 1 (rolled) for deep models and for
     # the pipeline axis.
     scan_unroll: int = 1
+    # Mixture-of-Experts FFN (ops/moe.py Switch-style router): 0 = dense
+    # FFN; >0 replaces every layer's FFN with moe_experts experts whose
+    # weights shard over the "expert" mesh axis. The router aux
+    # (load-balancing) loss is added to the LM loss with moe_aux_coeff.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01
 
     @property
     def kv_heads(self) -> int:

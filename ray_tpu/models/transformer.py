@@ -84,12 +84,23 @@ class Transformer:
             "attn_norm": jnp.ones((l, d), pdt),
             "wo": norm_init((nh * hd) ** -0.5, keys[4], (l, nh, hd, d)),
             "mlp_norm": jnp.ones((l, d), pdt),
-            "w_gateup": jnp.stack(
+        }
+        if cfg.moe_experts:
+            # routed expert FFN (ops/moe.py): per-layer router + stacked
+            # expert weights, expert dim sharded over the "expert" axis
+            e = cfg.moe_experts
+            layers["w_router"] = norm_init(
+                0.02, keys[5], (l, d, e)).astype(jnp.float32)
+            layers["w_moe_up"] = norm_init(
+                d ** -0.5, keys[6], (l, e, d, f))
+            layers["w_moe_down"] = norm_init(
+                f ** -0.5, keys[7], (l, e, f, d))
+        else:
+            layers["w_gateup"] = jnp.stack(
                 [norm_init(d ** -0.5, keys[5], (l, d, f)),
                  norm_init(d ** -0.5, keys[6], (l, d, f))],
-                axis=2),  # (l, d, 2, f)
-            "w_down": norm_init(f ** -0.5, keys[7], (l, f, d)),
-        }
+                axis=2)  # (l, d, 2, f)
+            layers["w_down"] = norm_init(f ** -0.5, keys[7], (l, f, d))
         if nkv == nh:
             layers["wqkv"] = jnp.stack(
                 [norm_init(d ** -0.5, keys[1], (l, d, nh, hd)),
@@ -119,9 +130,14 @@ class Transformer:
             "attn_norm": ("layers", "norm"),
             "wo": ("layers", "heads", "head_dim", "embed"),
             "mlp_norm": ("layers", "norm"),
-            "w_gateup": ("layers", "embed", None, "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
         }
+        if cfg.moe_experts:
+            layers["w_router"] = ("layers", "embed", None)
+            layers["w_moe_up"] = ("layers", "expert", "embed", "mlp")
+            layers["w_moe_down"] = ("layers", "expert", "mlp", "embed")
+        else:
+            layers["w_gateup"] = ("layers", "embed", None, "mlp")
+            layers["w_down"] = ("layers", "mlp", "embed")
         if cfg.kv_heads == cfg.n_heads:
             layers["wqkv"] = ("layers", "embed", None, "heads", "head_dim")
         else:
@@ -141,11 +157,13 @@ class Transformer:
     @staticmethod
     def hidden(params, tokens, cfg: TransformerConfig, *,
                mesh=None, rules: Optional[ShardingRules] = None,
-               positions=None):
+               positions=None, with_aux: bool = False):
         """tokens [B, T] int32 -> final-norm hidden states [B, T, d]
         (compute dtype) — apply() stopping before the lm head, so the
         loss can chunk head+softmax over T (the f32 [B,T,vocab] logits
         and their grad are the biggest HBM tenant at GPT-2 scale).
+        with_aux=True returns (hidden, aux_loss) where aux_loss is the
+        summed MoE load-balancing loss (0 for dense FFN configs).
 
         When `mesh` is provided and cfg.attention_impl is ring/ulysses, the
         attention op runs inside shard_map over the "seq" axis; everything
@@ -177,9 +195,47 @@ class Transformer:
         x = jnp.take(emb, tokens, axis=0).astype(cdt)
         x = constrain(x, ("batch", "seq", "act_embed"))
 
+        cos, sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        layer = Transformer._make_layer_fn(cfg, mesh, rules, cos, sin)
+
+        if cfg.remat:
+            if cfg.remat_policy == "dots":
+                pol = jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.checkpoint_dots,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "attn_out"))
+                layer = jax.checkpoint(layer, policy=pol)
+            else:
+                layer = jax.checkpoint(layer)
+
+        def scan_body(carry, lp):
+            x, aux_tot = carry
+            x, aux = layer(x, lp)
+            return (x, aux_tot + aux), None
+
+        (x, aux_total), _ = lax.scan(
+            scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"],
+            unroll=cfg.scan_unroll)
+
+        out = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        if with_aux:
+            return out, aux_total
+        return out
+
+    @staticmethod
+    def _make_layer_fn(cfg: TransformerConfig, mesh,
+                       rules: ShardingRules, cos, sin):
+        """Build layer(x, lp) -> (x, moe_aux) — the per-layer body shared
+        by hidden()'s scan and the pipeline stage executor
+        (parallel/pipeline.py make_pipeline_fn)."""
+        import jax
+        import jax.numpy as jnp
+
+        cdt = jnp.dtype(cfg.dtype)
+        constrain = functools.partial(
+            with_logical_constraint, mesh=mesh, rules=rules)
         attn_fn = Transformer._make_attention(cfg, mesh, rules)
         scale = cfg.head_dim ** -0.5
-        cos, sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
         def layer(x, lp):
             h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
@@ -211,48 +267,157 @@ class Transformer:
             x = x + constrain(o, ("batch", "seq", "act_embed"))
 
             h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+            if cfg.moe_experts:
+                from ray_tpu.ops.moe import moe_ffn
+                bsz, tsz, dsz = h.shape
+                y, aux = moe_ffn(
+                    {"w_router": lp["w_router"],
+                     "w_up": lp["w_moe_up"].astype(cdt),
+                     "w_down": lp["w_moe_down"].astype(cdt)},
+                    h.reshape(bsz * tsz, dsz),
+                    num_selected=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    rules=rules)
+                down = y.reshape(bsz, tsz, dsz).astype(cdt)
+                x = x + constrain(down, ("batch", "seq", "act_embed"))
+                return x, aux
             gu = jnp.einsum("btd,dgf->btgf", h, lp["w_gateup"].astype(cdt))
             ff = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
             ff = constrain(ff, ("batch", "seq", "act_mlp"))
             down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(cdt))
             x = x + constrain(down, ("batch", "seq", "act_embed"))
-            return x
+            return x, jnp.zeros((), jnp.float32)
 
-        if cfg.remat:
-            if cfg.remat_policy == "dots":
-                pol = jax.checkpoint_policies.save_from_both_policies(
-                    jax.checkpoint_policies.checkpoint_dots,
-                    jax.checkpoint_policies.save_only_these_names(
-                        "attn_out"))
-                layer = jax.checkpoint(layer, policy=pol)
-            else:
-                layer = jax.checkpoint(layer)
+        return layer
 
-        def scan_body(x, lp):
-            return layer(x, lp), None
+    @staticmethod
+    def _head_logits(params, x, cfg: TransformerConfig, *,
+                     mesh=None, rules: Optional[ShardingRules] = None):
+        """hidden states [B, T, d] -> f32 logits [B, T, vocab] — the one
+        lm-head projection shared by apply() and loss()."""
+        import jax.numpy as jnp
 
-        x, _ = lax.scan(scan_body, x, params["layers"],
-                        unroll=cfg.scan_unroll)
-
-        return _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("btd,dv->btv", x, head.astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        return with_logical_constraint(
+            logits, ("batch", "seq", "act_vocab"), mesh=mesh, rules=rules)
 
     @staticmethod
     def apply(params, tokens, cfg: TransformerConfig, *,
               mesh=None, rules: Optional[ShardingRules] = None,
               positions=None):
         """tokens [B, T] int32 -> logits [B, T, vocab] (f32 accum)."""
+        rules = rules or ShardingRules()
+        x = Transformer.hidden(params, tokens, cfg, mesh=mesh, rules=rules,
+                               positions=positions)
+        return Transformer._head_logits(params, x, cfg, mesh=mesh,
+                                        rules=rules)
+
+    @staticmethod
+    def pipeline_loss(params, batch, cfg: TransformerConfig, *,
+                      mesh, n_stages: int, n_micro: int,
+                      rules: Optional[ShardingRules] = None):
+        """Next-token loss with the layer stack executed as a microbatched
+        ppermute pipeline over the "pipe" mesh axis
+        (parallel/pipeline.py make_pipeline_fn) — the alternative
+        execution of the same stacked layer params hidden() scans.
+
+        Embedding runs outside the pipeline (vocab/fsdp-sharded GSPMD);
+        each stage applies n_layers/n_stages layers; the last stage's
+        loss_fn does final-norm + lm-head + CE per microbatch. Requires
+        batch divisible by n_micro, n_layers divisible by n_stages, and a
+        stage-local attention impl (dense/flash — seq stays unsharded
+        inside a stage)."""
+        import jax
         import jax.numpy as jnp
+        from jax import lax
+
+        from ray_tpu.parallel.pipeline import make_pipeline_fn
 
         rules = rules or ShardingRules()
         cdt = jnp.dtype(cfg.dtype)
-        x = Transformer.hidden(params, tokens, cfg, mesh=mesh, rules=rules,
-                               positions=positions)
-        head = (params["embed"].T if cfg.tie_embeddings
-                else params["lm_head"])
-        logits = jnp.einsum("btd,dv->btv", x, head.astype(cdt),
-                            preferred_element_type=jnp.float32)
-        return with_logical_constraint(
-            logits, ("batch", "seq", "act_vocab"), mesh=mesh, rules=rules)
+        if "targets" in batch:
+            tokens, targets = batch["tokens"], batch["targets"]
+        else:
+            tokens, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+        b, t = tokens.shape
+        if b % n_micro or cfg.n_layers % n_stages:
+            raise ValueError(
+                f"batch {b} % n_micro {n_micro} or n_layers "
+                f"{cfg.n_layers} % n_stages {n_stages} != 0")
+        if cfg.attention_impl in ("ring", "ulysses"):
+            raise ValueError("pipeline stages need stage-local attention "
+                             "(dense/flash), not ring/ulysses")
+        if cfg.moe_experts:
+            raise ValueError(
+                "pipeline_loss does not thread the MoE aux "
+                "(load-balancing) loss out of the pipeline yet; train "
+                "MoE configs via Transformer.loss (expert axis), or set "
+                "moe_experts=0 for the pipe axis")
+        mb = b // n_micro
+
+        # Embed outside the pipeline, then split into microbatches.
+        emb = with_logical_constraint(
+            params["embed"], ("vocab", "act_embed"), mesh=mesh, rules=rules)
+        x = jnp.take(emb, tokens, axis=0).astype(cdt)   # [B, T, d]
+        x_micro = x.reshape(n_micro, mb, t, x.shape[-1])
+        y_micro = targets.reshape(n_micro, mb, t)
+
+        per_stage = cfg.n_layers // n_stages
+
+        def stage_fn(stage_params, x):
+            # rope tables rebuilt from static positions inside the stage:
+            # shard-local constants, not closure-captured traced arrays
+            # (shard_map rejects auto-sharded implicit captures)
+            positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+            cos, sin = _rope_tables(positions, cfg.head_dim,
+                                    cfg.rope_theta)
+            # mesh=None inside the stage: the pipeline shard_map already
+            # owns axis mapping; constraints no-op under manual meshes.
+            layer = Transformer._make_layer_fn(cfg, None, rules, cos, sin)
+            if cfg.remat:
+                # same per-layer rematerialization contract as hidden()
+                if cfg.remat_policy == "dots":
+                    pol = jax.checkpoint_policies.save_from_both_policies(
+                        jax.checkpoint_policies.checkpoint_dots,
+                        jax.checkpoint_policies.save_only_these_names(
+                            "attn_out"))
+                    layer = jax.checkpoint(layer, policy=pol)
+                else:
+                    layer = jax.checkpoint(layer)
+
+            def body(x, lp):
+                x, _aux = layer(x, lp)
+                return x, None
+            x, _ = lax.scan(body, x, stage_params)
+            return x
+
+        def mb_loss(out, y, extras):
+            h = _rmsnorm(out, extras["final_norm"], cfg.norm_eps)
+            logits = jnp.einsum("btd,dv->btv", h,
+                                extras["head"].astype(h.dtype),
+                                preferred_element_type=jnp.float32)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, y[..., None], axis=-1)[..., 0]
+            return jnp.mean(logz - gold)
+
+        run = make_pipeline_fn(stage_fn, n_stages, n_micro, mesh,
+                               loss_fn=mb_loss)
+        # [l, ...] stacked layers -> [n_stages, l/n_stages, ...]: the
+        # leading stage dim aligns with the "pipe" shards of the "layers"
+        # axis, so this reshape is shard-local.
+        staged = jax.tree.map(
+            lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]),
+            params["layers"])
+        extras = {
+            "final_norm": params["final_norm"],
+            "head": (params["embed"].T if cfg.tie_embeddings
+                     else params["lm_head"]),
+        }
+        return run(staged, x_micro, y_micro, extras)
 
     @staticmethod
     def _make_attention(cfg: TransformerConfig, mesh, rules: ShardingRules):
@@ -352,22 +517,28 @@ class Transformer:
         b, t = tokens.shape
         chunk = cfg.loss_chunk
         if not (chunk and t > chunk and t % chunk == 0):
-            logits = Transformer.apply(params, tokens, cfg, mesh=mesh,
-                                       rules=rules)
+            rules = rules or ShardingRules()
+            x, aux = Transformer.hidden(params, tokens, cfg, mesh=mesh,
+                                        rules=rules, with_aux=True)
+            logits = Transformer._head_logits(params, x, cfg, mesh=mesh,
+                                              rules=rules)
             logits = logits.astype(jnp.float32)
             logz = jax.nn.logsumexp(logits, axis=-1)
             gold = jnp.take_along_axis(
                 logits, targets[..., None], axis=-1)[..., 0]
             nll = logz - gold
+            aux_term = cfg.moe_aux_coeff * aux if cfg.moe_experts else 0.0
             if mask is not None:
-                return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-            return jnp.mean(nll)
+                return jnp.sum(nll * mask) / jnp.maximum(
+                    jnp.sum(mask), 1.0) + aux_term
+            return jnp.mean(nll) + aux_term
 
         # Chunked head + cross-entropy: scan T in loss_chunk slices so only
         # one [B, chunk, vocab] f32 logits block (and its grad, via
         # jax.checkpoint recompute) lives in HBM at a time.
         rules = rules or ShardingRules()
-        x = Transformer.hidden(params, tokens, cfg, mesh=mesh, rules=rules)
+        x, aux = Transformer.hidden(params, tokens, cfg, mesh=mesh,
+                                    rules=rules, with_aux=True)
         cdt = x.dtype
         # contract against embed directly ("vd" orientation) rather than
         # materializing a [d, vocab] transpose each step
@@ -395,7 +566,10 @@ class Transformer:
                 return tot + jnp.sum(chunk_nll(*xt)), None
             total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts),
                                 unroll=cfg.scan_unroll > 1)
-            return total / (b * t)
+            loss_val = total / (b * t)
+            if cfg.moe_experts:
+                loss_val = loss_val + cfg.moe_aux_coeff * aux
+            return loss_val
         ms = jnp.swapaxes(
             mask.reshape(b, n, chunk), 0, 1).astype(jnp.float32)
 
@@ -404,4 +578,7 @@ class Transformer:
             return tot + jnp.sum(chunk_nll(x_c, t_c) * m_c), None
         total, _ = lax.scan(body_m, jnp.zeros((), jnp.float32),
                             (xs, ts, ms), unroll=cfg.scan_unroll > 1)
-        return total / jnp.maximum(jnp.sum(mask), 1.0)
+        loss_val = total / jnp.maximum(jnp.sum(mask), 1.0)
+        if cfg.moe_experts:
+            loss_val = loss_val + cfg.moe_aux_coeff * aux
+        return loss_val

@@ -16,6 +16,7 @@ tick, so utilization is n_micro / T — the standard pipeline bubble.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 from ray_tpu.parallel.mesh import AXIS_PIPE
@@ -49,13 +50,21 @@ def make_pipeline_fn(stage_fn: Callable[[Any, Any], Any],
 
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-    def per_stage(params, x_micro, y_micro):
+    def per_stage(params, x_micro, y_micro, extras):
         # params: this stage's pytree (leading stage dim stripped by
         # shard_map's P(AXIS_PIPE, ...) spec → local leaves [1, ...]).
         params = jax.tree.map(lambda a: a[0], params)
         stage = jax.lax.axis_index(AXIS_PIPE)
         n_ticks = n_micro + n_stages - 1
-        mb_shape = x_micro.shape[1:]
+
+        def apply_loss(out, y):
+            # Traced arrays must enter the shard_map explicitly (closure
+            # capture would broadcast with an auto-mesh sharding, which
+            # manual-mode rejects); `extras` is that explicit door for
+            # loss params (final norm / lm head / ...).
+            if extras is not None:
+                return loss_fn(out, y, extras)
+            return loss_fn(out, y)
 
         def tick(t, carry):
             buf, losses = carry
@@ -69,11 +78,10 @@ def make_pipeline_fn(stage_fn: Callable[[Any, Any], Any],
             valid = jnp.logical_and(stage == n_stages - 1,
                                     jnp.logical_and(m >= 0, m < n_micro))
             y = y_micro[jnp.clip(m, 0, n_micro - 1)]
-            losses = losses + jnp.where(valid, loss_fn(out, y), 0.0)
+            losses = losses + jnp.where(valid, apply_loss(out, y), 0.0)
             nxt = jax.lax.ppermute(out, AXIS_PIPE, fwd_perm)
             return (nxt, losses)
 
-        del mb_shape
         # carry shape/dtype via eval_shape — an actual x*0.0 application
         # would cost one extra stage computation per invocation (XLA can't
         # fold float x*0 because of NaN/Inf semantics)
@@ -85,14 +93,22 @@ def make_pipeline_fn(stage_fn: Callable[[Any, Any], Any],
         total = jax.lax.psum(losses, AXIS_PIPE) / n_micro
         return total[None]
 
-    pipelined = shard_map(
-        per_stage, mesh=mesh,
-        in_specs=(P(AXIS_PIPE), P(), P()),
-        out_specs=P(AXIS_PIPE),
-        **_relax_kwargs)
-
-    def run(params_stacked, x_micro, y_micro):
-        out = pipelined(params_stacked, x_micro, y_micro)
+    def run(params_stacked, x_micro, y_micro, extras=None):
+        """extras: optional replicated pytree handed to
+        loss_fn(out, y, extras) — pass loss-side parameters here, never
+        via closure (see apply_loss)."""
+        if extras is None:
+            # bind extras=None statically so the shard_map sees 3 inputs
+            fn = functools.partial(per_stage, extras=None)
+            in_specs = (P(AXIS_PIPE), P(), P())
+            args = (params_stacked, x_micro, y_micro)
+        else:
+            fn = per_stage
+            in_specs = (P(AXIS_PIPE), P(), P(), P())
+            args = (params_stacked, x_micro, y_micro, extras)
+        pipelined = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=P(AXIS_PIPE), **_relax_kwargs)
+        out = pipelined(*args)
         return out.mean()  # identical replicated per-stage values
 
     return run

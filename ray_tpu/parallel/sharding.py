@@ -171,6 +171,12 @@ def with_logical_constraint(x: Any, logical_spec: LogicalSpec,
             return x
         if env_mesh is None or not env_mesh.shape:
             return x
+        # Inside shard_map every mapped axis is Manual: per-shard code
+        # owns its layout and GSPMD constraints are meaningless (and
+        # reject manual-mesh shardings) — no-op there.
+        types = getattr(env_mesh, "axis_types", None)
+        if types is not None and all("Manual" in str(t) for t in types):
+            return x
         sharding = logical_sharding(logical_spec, env_mesh, rules,
                                     shape=shape)
     else:

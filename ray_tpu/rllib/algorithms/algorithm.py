@@ -38,7 +38,8 @@ class EnvRunnerSet:
             self._local = SingleAgentEnvRunner(
                 config.env, module, config.env_config,
                 num_envs=config.num_envs_per_env_runner,
-                seed=config.seed, worker_index=0, gamma=config.gamma)
+                seed=config.seed, worker_index=0, gamma=config.gamma,
+                policy_mapping_fn=config.policy_mapping_fn)
         else:
             import ray_tpu
             runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
@@ -47,7 +48,8 @@ class EnvRunnerSet:
                     config.env, module, config.env_config,
                     num_envs=config.num_envs_per_env_runner,
                     seed=config.seed, worker_index=i + 1,
-                    gamma=config.gamma)
+                    gamma=config.gamma,
+                    policy_mapping_fn=config.policy_mapping_fn)
                 for i in range(config.num_env_runners)
             ]
 
@@ -111,6 +113,7 @@ class Algorithm:
     _run_one_training_iteration :3020)."""
 
     learner_cls = None  # set by subclass
+    ma_learner_cls = None  # multi-agent variant (PPO sets it)
     needs_env_runners = True  # ES overrides: no rollout workers
 
     def __init__(self, config: AlgorithmConfig):
@@ -122,10 +125,39 @@ class Algorithm:
         self.action_space = probe.action_space
         probe.close()
 
-        self.module = config._custom_module or self.default_module(
-            self.observation_space, self.action_space)
+        if config.policies:
+            # distinct per-agent policies (reference marl_module.py:40)
+            from ray_tpu.rllib.core.marl_module import MultiAgentRLModule
+            if config.policy_mapping_fn is None:
+                raise ValueError(
+                    "multi_agent(policies=...) needs a policy_mapping_fn")
+            if self.ma_learner_cls is None:
+                raise ValueError(
+                    f"{type(self).__name__} has no multi-agent learner")
+            if config.num_learners > 0:
+                raise ValueError(
+                    "multi_agent(policies=...) currently supports the "
+                    "local learner only (num_learners=0); the mesh-gang "
+                    "learner path shards single-module batches")
+            agents = getattr(probe, "agents", None)
+            if agents:
+                mapped = {config.policy_mapping_fn(a) for a in agents}
+                unused = set(config.policies) - mapped
+                if unused:
+                    raise ValueError(
+                        f"policies {sorted(unused)} are never produced "
+                        f"by policy_mapping_fn for agents {agents}")
+            self.module = MultiAgentRLModule({
+                mid: (mod or self.default_module(
+                    self.observation_space, self.action_space))
+                for mid, mod in config.policies.items()})
+            learner_cls = self.ma_learner_cls
+        else:
+            self.module = config._custom_module or self.default_module(
+                self.observation_space, self.action_space)
+            learner_cls = self.learner_cls
         self.learner_group = LearnerGroup(
-            lambda: self.learner_cls(self.module, self.config),
+            lambda: learner_cls(self.module, self.config),
             num_learners=config.num_learners, seed=config.seed)
         if self.needs_env_runners:
             self.env_runners = EnvRunnerSet(config, self.module)

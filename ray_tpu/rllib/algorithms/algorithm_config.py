@@ -41,6 +41,10 @@ class AlgorithmConfig:
         # offline data (reference .offline_data(input_=..., output=...))
         self.input_: Optional[str] = None
         self.output: Optional[str] = None
+        # multi-agent (reference .multi_agent(policies=...,
+        # policy_mapping_fn=...); None => single-policy)
+        self.policies = None
+        self.policy_mapping_fn = None
         # misc
         self.seed: int = 0
         self.metrics_num_episodes_for_smoothing: int = 100
@@ -86,6 +90,19 @@ class AlgorithmConfig:
             self._custom_module = module
         if model_hiddens is not None:
             self.model_hiddens = tuple(model_hiddens)
+        return self
+
+    def multi_agent(self, policies=None, policy_mapping_fn=None
+                    ) -> "AlgorithmConfig":
+        """Distinct per-agent policies (reference
+        algorithm_config.py .multi_agent). `policies`: dict
+        {module_id: RLModule-or-None} (None => default module built from
+        the env's spaces); `policy_mapping_fn(agent_id) -> module_id`
+        routes each fixed-roster agent to its module."""
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def offline_data(self, input_: Optional[str] = None,

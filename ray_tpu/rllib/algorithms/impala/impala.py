@@ -91,12 +91,27 @@ class ImpalaLearner(Learner):
 
     def update(self, batch, minibatch_size=None, num_iters=1, seed=0):
         """Sequence batches update in one full-batch step (the reference
-        ImpalaLearner also consumes whole trajectories per update)."""
+        ImpalaLearner also consumes whole trajectories per update).
+
+        Stats lag one update: forcing the fresh stats would block the
+        host on the device once per scalar (expensive when dispatch goes
+        over a tunnel), so the host copy is started asynchronously and
+        the PREVIOUS update's (already-landed) stats are returned."""
+        import jax
+
         assert self._update_fn is not None, "call build() first"
         with self._state_lock:
             self._params, self._opt_state, stats = self._update_fn(
                 self._params, self._opt_state, batch, self.extra_inputs())
-        return {k: float(v) for k, v in stats.items()}
+        for v in stats.values():
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+        self._stage_weights_async()
+        prev = getattr(self, "_pending_stats", None)
+        self._pending_stats = stats
+        if prev is None:
+            prev = stats
+        return {k: float(v) for k, v in jax.device_get(prev).items()}
 
     def data_axis_for(self, key: str) -> int:
         # time-major [T, B] sequences: the env/batch axis is 1; the
@@ -147,6 +162,8 @@ class Impala(Algorithm):
         self._learner_stats: Dict[str, float] = {}
         self._learner_error: Optional[BaseException] = None
         self._steps_trained = 0
+        self._updates_done = 0
+        self._feed = None
         self._last_reported_trained = 0
         self._weights_version = 0
         self._synced_version = 0
@@ -162,19 +179,35 @@ class Impala(Algorithm):
         self._learner_thread.start()
 
     def _learner_loop(self) -> None:
+        import time as _time
+
+        # Local learner: double-buffered host→HBM prefetch so transfer k+1
+        # overlaps update k (SURVEY §7.3 EnvRunner→Learner throughput).
+        # Gang learners receive host batches over RPC instead.
+        if self.learner_group._local is not None:
+            from ray_tpu.rllib.utils.device_feed import DeviceFeed
+            self._feed = DeviceFeed(self._train_queue,
+                                    stop_event=self._stop_event)
         while not self._stop_event.is_set():
             try:
-                batch, steps = self._train_queue.get(timeout=0.2)
+                if self._feed is not None:
+                    batch, steps = self._feed.get(timeout=0.2)
+                else:
+                    batch, steps = self._train_queue.get(timeout=0.2)
             except queue.Empty:
                 continue
             try:
+                t0 = _time.perf_counter()
                 stats = self.learner_group.update(batch)
+                if self._feed is not None:
+                    self._feed.add_busy(_time.perf_counter() - t0)
             except BaseException as e:  # noqa: BLE001
                 self._learner_error = e
                 return
             with self._stats_lock:
                 self._learner_stats = stats
                 self._steps_trained += steps
+                self._updates_done += 1
                 self._weights_version += 1
 
     def _assemble_train_batch(self) -> Optional[tuple]:
@@ -274,14 +307,18 @@ class Impala(Algorithm):
             self._touched_ids.clear()
         if self._iteration % 10 == 9:
             self._mgr.probe_unhealthy_actors(timeout_seconds=2.0)
-        return {
+        result = {
             "learner": stats,
             "num_env_steps_trained": trained_delta,
             "num_env_steps_trained_total": trained_total,
+            "num_updates_total": self._updates_done,
             "num_env_steps_enqueued": enqueued,
             "learner_queue_depth": self._train_queue.qsize(),
             "num_healthy_env_runners": self._mgr.num_healthy_actors(),
         }
+        if self._feed is not None:
+            result["device_feed"] = self._feed.stats()
+        return result
 
     def _training_step_sync(self) -> Dict[str, Any]:
         """Degenerate num_env_runners=0 mode: local sampling, but still

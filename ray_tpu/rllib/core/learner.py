@@ -67,7 +67,31 @@ class Learner:
             stats["grad_norm"] = optax.global_norm(grads)
             return params, opt_state, stats
 
+        def sweep(params, opt_state, batch, idx_mat, extra):
+            # The WHOLE minibatch-SGD sweep (num_epochs x minibatches) as
+            # one lax.scan program: one XLA dispatch per Learner.update
+            # instead of one per minibatch — dispatch latency (notably
+            # over a TPU tunnel) would otherwise dominate small updates.
+            # idx_mat: [steps, minibatch] row indices into batch.
+            def body(carry, idx):
+                p, o = carry
+                if isinstance(idx, dict):
+                    # multi-agent: per-module index vectors into
+                    # per-module sub-batches (static shapes per module)
+                    mb = {mid: jax.tree.map(lambda v: v[idx[mid]],
+                                            batch[mid])
+                          for mid in idx}
+                else:
+                    mb = jax.tree.map(lambda v: v[idx], batch)
+                p, o, st = update(p, o, mb, extra)
+                return (p, o), st
+
+            (params, opt_state), stats_seq = jax.lax.scan(
+                body, (params, opt_state), idx_mat)
+            return params, opt_state, stats_seq
+
         self._update_fn = jax.jit(update, donate_argnums=(0, 1))
+        self._sweep_fn = jax.jit(sweep, donate_argnums=(0, 1))
 
     # ---- distributed (mesh gang) build ------------------------------
     def data_axis_for(self, key: str) -> int:
@@ -201,6 +225,16 @@ class Learner:
         """Scalars threaded into the jitted loss (kl coeff etc.)."""
         return {}
 
+    def _stage_weights_async(self) -> None:
+        """Start async device→host copies of the params so a later
+        get_weights (weight broadcast to samplers) finds the data already
+        landed instead of paying one blocking round trip per leaf —
+        measured 0.6-0.75 s/call over the TPU tunnel without staging."""
+        import jax
+        for leaf in jax.tree.leaves(self._params):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+
     # ---- stats ------------------------------------------------------
     @staticmethod
     def _accumulate(stats: Dict[str, Any], st: Dict[str, Any]) -> None:
@@ -225,26 +259,47 @@ class Learner:
                seed: int = 0) -> Dict[str, float]:
         """Minibatch SGD over the batch (reference Learner.update /
         TorchLearner._update loop)."""
+        import jax
+
         assert self._update_fn is not None, "call build() first"
         n = len(batch["obs"])
         minibatch_size = minibatch_size or n
         rng = np.random.default_rng(seed)
-        stats: Dict[str, Any] = {}
-        count = 0
+        # Row-index matrix for the scanned sweep: num_iters epochs of
+        # shuffled minibatches, ragged tails dropped (stable jit shapes).
+        rows = []
         for _ in range(num_iters):
             perm = rng.permutation(n)
-            for start in range(0, n, minibatch_size):
-                idx = perm[start:start + minibatch_size]
-                if len(idx) < minibatch_size and count > 0:
-                    continue  # drop ragged tail (keeps jit shapes stable)
-                mb = {k: v[idx] for k, v in batch.items()}
-                with self._state_lock:
-                    self._params, self._opt_state, st = self._update_fn(
-                        self._params, self._opt_state, mb,
-                        self.extra_inputs())
-                count += 1
-                self._accumulate(stats, st)
-        return self._finalize(stats, max(count, 1))
+            for start in range(0, n - minibatch_size + 1, minibatch_size):
+                rows.append(perm[start:start + minibatch_size])
+        if not rows:  # batch smaller than one minibatch: single step
+            rows = [rng.permutation(n)]
+        idx_mat = np.stack(rows).astype(np.int32)
+        # One explicit host→device transfer of the whole batch up front
+        # (dispatching jit calls with raw numpy batches can re-transfer
+        # per-array, synchronously, on some backends), then ONE jitted
+        # lax.scan dispatch for the whole minibatch-SGD sweep.
+        dev_batch = jax.device_put(batch)
+        with self._state_lock:
+            self._params, self._opt_state, stats_seq = self._sweep_fn(
+                self._params, self._opt_state, dev_batch, idx_mat,
+                self.extra_inputs())
+        return self._sweep_stats(jax.device_get(stats_seq))
+
+    @staticmethod
+    def _sweep_stats(stats_seq: Dict[str, Any]) -> Dict[str, Any]:
+        """Stacked scan stats -> reported stats: scalars average over
+        minibatches; array-valued stats (e.g. per-sample TD errors) keep
+        the last minibatch's values — the _accumulate/_finalize
+        contract."""
+        out: Dict[str, Any] = {}
+        for k, v in stats_seq.items():
+            arr = np.asarray(v)
+            if arr.ndim <= 1:
+                out[k] = float(np.mean(arr))
+            else:
+                out[k] = arr[-1]
+        return out
 
     # ---- weights ----------------------------------------------------
     def get_weights(self):
@@ -281,3 +336,47 @@ class Learner:
                 self._params = state["params"]
                 self._opt_state = state["opt_state"]
             self.curr_kl_coeff = state.get("kl_coeff", self.curr_kl_coeff)
+
+
+class MultiAgentLearnerMixin:
+    """update() over a MultiAgentBatch {module_id: columns}.
+
+    reference parity: Learner.update on a MultiAgentBatch
+    (rllib/policy/sample_batch.py MultiAgentBatch; per-module losses in
+    core/learner/learner.py compute_loss_for_module). Here one jitted
+    lax.scan sweep steps every module together: per-module minibatch
+    index vectors gather from per-module sub-batches (static shapes,
+    since lane→module routing is fixed), the summed loss yields
+    independent per-module gradients, and one optimizer updates the
+    union params pytree."""
+
+    def update(self, batch, minibatch_size=None, num_iters=1, seed=0):
+        import jax
+
+        assert self._sweep_fn is not None, "call build() first"
+        rng = np.random.default_rng(seed)
+        n_m = {mid: len(b["obs"]) for mid, b in batch.items()}
+        total = sum(n_m.values())
+        minibatch_size = minibatch_size or total
+        # Per-module minibatch sizes proportional to module rows; every
+        # module steps the same number of scan iterations.
+        mb_m = {mid: max(1, min(n, round(minibatch_size * n / total)))
+                for mid, n in n_m.items()}
+        steps_per_epoch = max(1, min(n // mb_m[mid]
+                                     for mid, n in n_m.items()))
+        rows: Dict[str, list] = {mid: [] for mid in n_m}
+        for _ in range(num_iters):
+            perms = {mid: rng.permutation(n) for mid, n in n_m.items()}
+            for s in range(steps_per_epoch):
+                for mid in n_m:
+                    start = s * mb_m[mid]
+                    rows[mid].append(
+                        perms[mid][start:start + mb_m[mid]])
+        idx_mat = {mid: np.stack(r).astype(np.int32)
+                   for mid, r in rows.items()}
+        dev_batch = jax.device_put(batch)
+        with self._state_lock:
+            self._params, self._opt_state, stats_seq = self._sweep_fn(
+                self._params, self._opt_state, dev_batch, idx_mat,
+                self.extra_inputs())
+        return self._sweep_stats(jax.device_get(stats_seq))

@@ -1,0 +1,43 @@
+"""MultiAgentRLModule: independently-parameterized policies in one tree.
+
+reference parity: rllib/core/rl_module/marl_module.py:40
+(MultiAgentRLModule — a container of RLModules keyed by module_id,
+routed by AlgorithmConfig.policy_mapping_fn) and
+rllib/policy/sample_batch.py MultiAgentBatch (per-module sub-batches).
+
+TPU-first shape: the multi-agent params are ONE pytree
+{module_id: module_params}, so a single jitted update computes every
+module's loss, sums them (independent gradients — the per-module losses
+the reference computes module-by-module), and applies one optimizer over
+the union tree. Per-module sub-batches have static shapes because the
+lane→module assignment is fixed by the roster + mapping fn, so XLA
+never sees data-dependent partitioning.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.core.rl_module import RLModule
+
+
+class MultiAgentRLModule:
+    """Container of per-policy RLModules keyed by module_id."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        if not modules:
+            raise ValueError("MultiAgentRLModule needs at least one module")
+        self.modules = dict(modules)
+
+    @property
+    def module_ids(self):
+        return sorted(self.modules)
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self.modules[module_id]
+
+    def init_params(self, key) -> Dict[str, Any]:
+        import jax
+        keys = jax.random.split(key, len(self.modules))
+        return {mid: self.modules[mid].init_params(k)
+                for mid, k in zip(self.module_ids, keys)}

@@ -28,10 +28,24 @@ class SingleAgentEnvRunner:
     def __init__(self, env_name: str, module: RLModule,
                  env_config: Optional[Dict[str, Any]] = None,
                  num_envs: int = 1, seed: Optional[int] = None,
-                 worker_index: int = 0, gamma: float = 0.99):
+                 worker_index: int = 0, gamma: float = 0.99,
+                 policy_mapping_fn=None):
         import jax
-        # runners always act on CPU regardless of driver platform
-        jax.config.update("jax_platforms", "cpu")
+        # Runners act on CPU regardless of the driver platform. Actor
+        # runners (worker_index > 0) run in their own worker process and
+        # pin the whole process to CPU so they never claim the TPU. The
+        # driver-local runner (worker_index == 0) must NOT re-pin the
+        # process — the Learner in the same process may be jitting to the
+        # real chip (BASELINE north-star config #1) — so it routes its
+        # forwards to the host CPU device via jax.default_device instead.
+        if worker_index > 0:
+            jax.config.update("jax_platforms", "cpu")
+            self._cpu_device = None
+        else:
+            try:
+                self._cpu_device = jax.devices("cpu")[0]
+            except RuntimeError:
+                self._cpu_device = None
 
         from ray_tpu.rllib.env.multi_agent import (MultiAgentEnv,
                                                    MultiAgentVectorAdapter)
@@ -49,8 +63,12 @@ class SingleAgentEnvRunner:
         self.module = module
         self.worker_index = worker_index
         self.gamma = gamma
-        self._key = jax.random.PRNGKey(
-            (seed if seed is not None else 0) * 10007 + worker_index)
+        # The PRNG key must live on the CPU: a TPU-committed key would
+        # drag every jitted forward (committed inputs win over
+        # jax.default_device) onto the chip, one dispatch per env step.
+        with self._on_cpu():
+            self._key = jax.random.PRNGKey(
+                (seed if seed is not None else 0) * 10007 + worker_index)
         self.params = None
 
         # Exploration state (epsilon etc.) threads into the jitted
@@ -58,11 +76,51 @@ class SingleAgentEnvRunner:
         # don't retrace (reference: exploration objects own this state,
         # rllib/utils/exploration/epsilon_greedy.py).
         self._explore_inputs: Dict[str, np.ndarray] = {}
-        self._explore = jax.jit(
-            lambda p, obs, k, extra: module.forward_exploration(
-                p, {"obs": obs, **extra}, k))
-        self._value_only = jax.jit(
-            lambda p, obs: module.forward_train(p, {"obs": obs})["vf_preds"])
+        from ray_tpu.rllib.core.marl_module import MultiAgentRLModule
+        self._ma = isinstance(module, MultiAgentRLModule)
+        if self._ma:
+            # Per-agent policies (reference marl_module.py:40 +
+            # policy_mapping_fn): every (env, agent) lane is routed to a
+            # fixed module; per-step inference is one jitted forward per
+            # module over that module's lanes, scattered back.
+            if not isinstance(probe, MultiAgentEnv):
+                raise ValueError(
+                    "multi_agent policies need a MultiAgentEnv")
+            if policy_mapping_fn is None:
+                raise ValueError(
+                    "MultiAgentRLModule needs a policy_mapping_fn")
+            lane_agents = [a for agents in self.env.agents_per_env
+                           for a in agents]
+            self._lane_module_ids = [policy_mapping_fn(a)
+                                     for a in lane_agents]
+            unknown = set(self._lane_module_ids) - set(module.modules)
+            if unknown:
+                raise ValueError(
+                    f"policy_mapping_fn produced unknown module ids "
+                    f"{sorted(unknown)}")
+            self._module_order = sorted(set(self._lane_module_ids))
+            self._lanes_by_module = {
+                mid: np.array([i for i, m in
+                               enumerate(self._lane_module_ids)
+                               if m == mid], np.int64)
+                for mid in self._module_order}
+            self._explore_m = {}
+            self._value_m = {}
+            for mid in self._module_order:
+                mod = module.modules[mid]
+                self._explore_m[mid] = jax.jit(
+                    lambda p, obs, k, extra, _m=mod:
+                    _m.forward_exploration(p, {"obs": obs, **extra}, k))
+                self._value_m[mid] = jax.jit(
+                    lambda p, obs, _m=mod:
+                    _m.forward_train(p, {"obs": obs})["vf_preds"])
+        else:
+            self._explore = jax.jit(
+                lambda p, obs, k, extra: module.forward_exploration(
+                    p, {"obs": obs, **extra}, k))
+            self._value_only = jax.jit(
+                lambda p, obs: module.forward_train(
+                    p, {"obs": obs})["vf_preds"])
 
         base_seed = None if seed is None else seed + worker_index * 1000
         self._obs, _ = self.env.reset(base_seed)
@@ -70,6 +128,65 @@ class SingleAgentEnvRunner:
         self._ep_ret = np.zeros(self.env.num_envs, np.float64)
         self._ep_len = np.zeros(self.env.num_envs, np.int64)
         self._completed: List[Dict[str, float]] = []
+
+    def _on_cpu(self):
+        """Context placing jitted forwards on the host CPU device (no-op
+        for actor runners, whose whole process is already pinned)."""
+        import contextlib
+
+        import jax
+        if self._cpu_device is None:
+            return contextlib.nullcontext()
+        return jax.default_device(self._cpu_device)
+
+    def _forward_explore(self, obs, key):
+        """Batched stochastic forward -> (actions, logp, vf_preds) as
+        numpy rows aligned with the vector lanes. Multi-agent modules
+        run one jitted forward per module over its lanes and scatter."""
+        import jax
+
+        with self._on_cpu():
+            if not self._ma:
+                out = self._explore(self.params, obs, key,
+                                    self._explore_inputs)
+                return (np.asarray(out["actions"]),
+                        np.asarray(out["action_logp"]),
+                        np.asarray(out["vf_preds"]))
+            n = obs.shape[0]
+            keys = jax.random.split(key, len(self._module_order))
+            actions = None
+            logp = np.zeros(n, np.float32)
+            vf = np.zeros(n, np.float32)
+            for k, mid in zip(keys, self._module_order):
+                rows = self._lanes_by_module[mid]
+                out = self._explore_m[mid](self.params[mid], obs[rows],
+                                           k, self._explore_inputs)
+                a = np.asarray(out["actions"])
+                if actions is None:
+                    actions = np.zeros((n,) + a.shape[1:], a.dtype)
+                actions[rows] = a
+                logp[rows] = np.asarray(out["action_logp"])
+                vf[rows] = np.asarray(out["vf_preds"])
+            return actions, logp, vf
+
+    def _forward_value(self, obs, lanes=None):
+        """V(obs) rows; `lanes` maps each row to its vector lane (for
+        module routing when rows are a subset, e.g. truncation
+        bootstraps). Defaults to row i == lane i."""
+        with self._on_cpu():
+            if not self._ma:
+                return np.asarray(self._value_only(self.params, obs))
+            if lanes is None:
+                lanes = np.arange(obs.shape[0])
+            vf = np.zeros(obs.shape[0], np.float32)
+            mods = [self._lane_module_ids[int(ln)] for ln in lanes]
+            for mid in self._module_order:
+                rows = np.array([i for i, m in enumerate(mods)
+                                 if m == mid], np.int64)
+                if rows.size:
+                    vf[rows] = np.asarray(
+                        self._value_m[mid](self.params[mid], obs[rows]))
+            return vf
 
     def ping(self) -> str:
         """Health probe for FaultTolerantActorManager."""
@@ -105,10 +222,9 @@ class SingleAgentEnvRunner:
         finals_idx: List[Tuple[int, int]] = []
         finals_val: List[np.ndarray] = []
         for step_t in range(steps):
-            self._key, sub = jax.random.split(self._key)
-            out = self._explore(self.params, self._obs, sub,
-                                self._explore_inputs)
-            actions = np.asarray(out["actions"])
+            with self._on_cpu():
+                self._key, sub = jax.random.split(self._key)
+            actions, logp, vf = self._forward_explore(self._obs, sub)
             obs_next, rewards, terms, truncs, _, final_obs = \
                 self.env.step(actions)
             raw_rewards = rewards.copy()
@@ -124,7 +240,7 @@ class SingleAgentEnvRunner:
                                    & ~np.asarray(terms))[0]
             if trunc_idx.size:
                 f_obs = np.stack([final_obs[i] for i in trunc_idx])
-                v_fin = np.asarray(self._value_only(self.params, f_obs))
+                v_fin = self._forward_value(f_obs, lanes=trunc_idx)
                 rewards = rewards.copy()
                 rewards[trunc_idx] += self.gamma * v_fin
             cols["obs"].append(self._obs)
@@ -133,8 +249,8 @@ class SingleAgentEnvRunner:
             cols["raw_rewards"].append(raw_rewards)
             cols["terminateds"].append(np.asarray(terms))
             cols["truncateds"].append(np.asarray(truncs))
-            cols["action_logp"].append(np.asarray(out["action_logp"]))
-            cols["vf_preds"].append(np.asarray(out["vf_preds"]))
+            cols["action_logp"].append(logp)
+            cols["vf_preds"].append(vf)
 
             self._ep_ret += rewards
             self._ep_len += 1
@@ -142,7 +258,8 @@ class SingleAgentEnvRunner:
             for i in np.nonzero(done)[0]:
                 self._completed.append({
                     "episode_return": float(self._ep_ret[i]),
-                    "episode_len": int(self._ep_len[i])})
+                    "episode_len": int(self._ep_len[i]),
+                    "lane": int(i)})
                 self._ep_ret[i] = 0.0
                 self._ep_len[i] = 0
             self._obs = obs_next
@@ -152,8 +269,7 @@ class SingleAgentEnvRunner:
         # was done, this is the autoreset obs — GAE masks it with
         # (1 - done); truncation bootstrap was already folded into the
         # reward above.
-        batch["bootstrap_value"] = np.asarray(
-            self._value_only(self.params, self._obs))
+        batch["bootstrap_value"] = self._forward_value(self._obs)
         # Obs after the final step: with obs[t+1], gives next_obs for
         # replay-based algorithms (done rows mask the autoreset obs).
         batch["last_obs"] = np.asarray(self._obs).copy()
@@ -163,6 +279,13 @@ class SingleAgentEnvRunner:
             np.stack(finals_val) if finals_val
             else np.zeros((0, *batch["last_obs"].shape[1:]),
                           batch["last_obs"].dtype))
+        if self._ma:
+            # lane -> module index (into module_order), for per-module
+            # batch splitting on the learner side
+            batch["lane_module"] = np.array(
+                [self._module_order.index(m)
+                 for m in self._lane_module_ids], np.int32)
+            batch["module_order"] = list(self._module_order)
         metrics = self._completed
         self._completed = []
         batch["episode_metrics"] = metrics

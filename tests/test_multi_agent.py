@@ -129,3 +129,97 @@ class TestSharedPolicyTraining:
         algo.stop()
         assert best >= 150.0, \
             f"shared-policy multi-agent PPO failed: {best}"
+
+
+class TestPerAgentPolicies:
+    """reference marl_module.py:40 MultiAgentRLModule +
+    algorithm_config .multi_agent(policies=..., policy_mapping_fn=...):
+    two independently-parameterized policies trained against one env."""
+
+    def test_runner_routes_lanes_and_splits_modules(self):
+        from ray_tpu.rllib import PPOConfig
+        register_env("ma_cartpole_pp", make_multi_agent("CartPole-v1"))
+        algo = (PPOConfig()
+                .environment("ma_cartpole_pp",
+                             env_config={"num_agents": 2})
+                .env_runners(num_env_runners=0,
+                             num_envs_per_env_runner=2,
+                             rollout_fragment_length=16)
+                .training(train_batch_size=64, minibatch_size=32,
+                          num_epochs=2)
+                .multi_agent(
+                    policies={"pol_a": None, "pol_b": None},
+                    policy_mapping_fn=lambda aid:
+                        "pol_a" if aid == "agent_0" else "pol_b")
+                .debugging(seed=3)
+                .build())
+        from ray_tpu.rllib.core.marl_module import MultiAgentRLModule
+        assert isinstance(algo.module, MultiAgentRLModule)
+        w0 = algo.learner_group.get_weights()
+        assert set(w0) == {"pol_a", "pol_b"}
+        result = algo.train()
+        # per-module stats reported, and both param trees moved
+        assert "pol_a/policy_loss" in result["learner"]
+        assert "pol_b/policy_loss" in result["learner"]
+        w1 = algo.learner_group.get_weights()
+        for mid in ("pol_a", "pol_b"):
+            moved = any(
+                np.abs(np.asarray(a) - np.asarray(b)).max() > 0
+                for a, b in zip(
+                    _leaves(w0[mid]), _leaves(w1[mid])))
+            assert moved, f"{mid} params did not update"
+        # runner lane routing: 2 envs x 2 agents; agent_0 lanes -> pol_a
+        runner = algo.env_runners._local
+        assert runner._lane_module_ids == [
+            "pol_a", "pol_b", "pol_a", "pol_b"]
+        algo.stop()
+
+    @pytest.mark.slow
+    def test_two_policies_both_learn(self):
+        from ray_tpu.rllib import PPOConfig
+        register_env("ma_cartpole_pp2", make_multi_agent("CartPole-v1"))
+        algo = (PPOConfig()
+                .environment("ma_cartpole_pp2",
+                             env_config={"num_agents": 2})
+                .env_runners(num_env_runners=0,
+                             num_envs_per_env_runner=4,
+                             rollout_fragment_length=128)
+                .training(lr=1e-3, train_batch_size=1024,
+                          minibatch_size=256, num_epochs=10,
+                          entropy_coeff=0.01, gamma=0.99,
+                          vf_clip_param=10000.0)
+                .multi_agent(
+                    policies={"pol_a": None, "pol_b": None},
+                    policy_mapping_fn=lambda aid:
+                        "pol_a" if aid == "agent_0" else "pol_b")
+                .debugging(seed=7)
+                .build())
+        # track per-module returns via per-lane episode metrics
+        runner = algo.env_runners._local
+        lane_mod = list(runner._lane_module_ids)
+        best = {"pol_a": 0.0, "pol_b": 0.0}
+        orig_sample = runner.sample
+
+        def sample_spy(n):
+            frag = orig_sample(n)
+            per = {"pol_a": [], "pol_b": []}
+            for m in frag["episode_metrics"]:
+                per[lane_mod[m["lane"]]].append(m["episode_return"])
+            for mid, vals in per.items():
+                if len(vals) >= 2:
+                    best[mid] = max(best[mid], float(np.mean(vals)))
+            return frag
+
+        runner.sample = sample_spy
+        for _ in range(60):
+            algo.train()
+            if min(best.values()) >= 150.0:
+                break
+        algo.stop()
+        assert best["pol_a"] >= 150.0 and best["pol_b"] >= 150.0, \
+            f"per-module learning failed: {best}"
+
+
+def _leaves(tree):
+    import jax
+    return jax.tree.leaves(tree)
