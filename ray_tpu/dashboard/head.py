@@ -23,71 +23,88 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict
 from urllib.parse import parse_qs, urlparse
 
-_INDEX_HTML = """<!doctype html>
+_PAGE_TEMPLATE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
 <meta http-equiv="refresh" content="5">
 <style>
- body { font-family: monospace; margin: 2em; }
- table { border-collapse: collapse; margin: 1em 0; }
- td, th { border: 1px solid #999; padding: 2px 8px; text-align: left; }
- h2 { margin-bottom: 0; }
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; margin: 1em 0; }}
+ td, th {{ border: 1px solid #999; padding: 2px 8px; text-align: left; }}
+ h2 {{ margin-bottom: 0; }}
+ nav a {{ margin-right: 1em; }}
 </style></head>
 <body><h1>ray_tpu dashboard</h1>
-<div id="content">loading…</div>
-<script>
-function esc(v) {
-  return String(v).replace(/[&<>"']/g,
-      c => '&#' + c.charCodeAt(0) + ';');
-}
-async function load() {
-  const [cluster, summary, actors, workers, events] = await Promise.all([
-    fetch('/api/cluster').then(r => r.json()),
-    fetch('/api/summary').then(r => r.json()),
-    fetch('/api/actors').then(r => r.json()),
-    fetch('/api/workers').then(r => r.json()),
-    fetch('/api/events').then(r => r.json())]);
-  let html = '<h2>cluster</h2><table>';
-  for (const [k, v] of Object.entries(cluster.resources_total)) {
-    html += `<tr><td>${esc(k)}</td>`
-          + `<td>${esc(cluster.resources_available[k] ?? 0)}`
-          + ` / ${esc(v)} available</td></tr>`;
-  }
-  html += '</table><h2>nodes</h2><table>'
-        + '<tr><th>id</th><th>state</th><th>head</th></tr>';
-  for (const n of cluster.nodes) {
-    html += `<tr><td>${esc(n.node_id.slice(0,12))}</td>`
-          + `<td>${esc(n.state)}</td><td>${esc(n.is_head)}</td></tr>`;
-  }
-  html += `</table><h2>tasks</h2><table>`;
-  for (const [k, v] of Object.entries(summary)) {
-    html += `<tr><td>${esc(k)}</td><td>${esc(v)}</td></tr>`;
-  }
-  html += '</table><h2>actors</h2><table>'
-        + '<tr><th>id</th><th>class</th><th>state</th></tr>';
-  for (const a of actors.slice(0, 50)) {
-    html += `<tr><td>${esc(a.actor_id.slice(0,12))}</td>`
-          + `<td>${esc(a.class_name)}</td><td>${esc(a.state)}</td></tr>`;
-  }
-  html += '</table><h2>workers</h2><table>'
-        + '<tr><th>id</th><th>pid</th><th>busy on</th>'
-        + '<th>stack</th></tr>';
-  for (const w of workers.slice(0, 50)) {
-    html += `<tr><td>${esc(w.worker_id.slice(0,12))}</td>`
-          + `<td>${esc(w.pid)}</td>`
-          + `<td>${esc(w.current_task ?? '-')}</td>`
-          + `<td><a href="/api/profile/stack?worker_id=${esc(w.worker_id)}">`
-          + `dump</a></td></tr>`;
-  }
-  html += '</table><h2>recent events</h2><table>';
-  for (const e of events.slice(-20).reverse()) {
-    html += `<tr><td>${esc(e.event_type ?? e.type ?? '?')}</td>`
-          + `<td>${esc(e.message ?? '')}</td></tr>`;
-  }
-  html += '</table>';
-  document.getElementById('content').innerHTML = html;
-}
-load();
-</script></body></html>"""
+<nav><a href="/api/timeline">download chrome timeline</a>
+<a href="/metrics">prometheus metrics</a>
+<a href="/api/profile/stack">stack dumps</a></nav>
+{content}
+</body></html>"""
+
+
+def _render_overview(head: "DashboardHead") -> str:
+    """Server-rendered overview (reference dashboard/client — here a
+    no-build-step page: meta-refresh + tables from the same JSON routes
+    the API serves, so it works without JS and tests can assert on it)."""
+    from html import escape
+
+    def esc(v: Any) -> str:
+        return escape(str(v))
+
+    def table(title: str, header, rows) -> str:
+        out = [f"<h2>{esc(title)}</h2><table>"]
+        if header:
+            out.append("<tr>" + "".join(
+                f"<th>{esc(h)}</th>" for h in header) + "</tr>")
+        for row in rows:
+            out.append("<tr>" + "".join(
+                f"<td>{c}</td>" for c in row) + "</tr>")
+        out.append("</table>")
+        return "".join(out)
+
+    def safe(route: str, default):
+        try:
+            return head.route(route, {})
+        except Exception:  # noqa: BLE001 — one broken section must not
+            return default  # blank the whole page
+
+    cluster = safe("/api/cluster", {"nodes": [], "resources_total": {},
+                                    "resources_available": {}})
+    summary = safe("/api/summary", {})
+    actors = safe("/api/actors", [])
+    workers = safe("/api/workers", [])
+    events = safe("/api/events", [])
+    jobs = safe("/api/jobs", [])
+
+    parts = [
+        table("cluster", None, [
+            (esc(k), f"{esc(cluster['resources_available'].get(k, 0))} / "
+                     f"{esc(v)} available")
+            for k, v in cluster["resources_total"].items()]),
+        table("nodes", ("id", "state", "head"), [
+            (esc(n["node_id"][:12]), esc(n["state"]), esc(n["is_head"]))
+            for n in cluster["nodes"]]),
+        table("tasks", None, [(esc(k), esc(v))
+                              for k, v in summary.items()]),
+        table("actors", ("id", "class", "state"), [
+            (esc(a["actor_id"][:12]), esc(a["class_name"]),
+             esc(a["state"])) for a in actors[:50]]),
+        table("workers", ("id", "pid", "busy on", "stack"), [
+            (esc(w["worker_id"][:12]), esc(w["pid"]),
+             esc(w.get("current_task") or "-"),
+             f'<a href="/api/profile/stack?worker_id='
+             f'{esc(w["worker_id"])}">dump</a>')
+            for w in workers[:50]]),
+        table("jobs", ("id", "status", "entrypoint"), [
+            (esc(j.get("job_id", j.get("submission_id", "?"))),
+             esc(j.get("status", "?")),
+             esc(str(j.get("entrypoint", ""))[:80]))
+            for j in (jobs if isinstance(jobs, list) else [])[:50]]),
+        table("recent events", ("type", "message"), [
+            (esc(e.get("event_type") or e.get("type") or "?"),
+             esc(e.get("message", "")))
+            for e in list(events)[-20:][::-1]]),
+    ]
+    return "".join(parts)
 
 
 class _NoRoute(Exception):
@@ -130,9 +147,24 @@ class DashboardHead:
                         self.wfile.write(body)
                         return
                     if route == "/":
-                        body = _INDEX_HTML.encode()
+                        body = _PAGE_TEMPLATE.format(
+                            content=_render_overview(head)).encode()
                         self.send_response(200)
                         self.send_header("Content-Type", "text/html")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    if route == "/api/timeline":
+                        import ray_tpu
+                        body = json.dumps(ray_tpu.timeline(),
+                                          default=str).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header(
+                            "Content-Disposition",
+                            'attachment; filename="timeline.json"')
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)

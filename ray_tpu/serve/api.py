@@ -28,8 +28,12 @@ def _get_or_create_controller():
         pass
     cls = ray_tpu.remote(ServeController)
     try:
+        # "control" group hosts blocked listen_for_change long-polls;
+        # deploy/delete/get_routing_info stay responsive on the default
+        # group however many listeners are armed.
         return cls.options(name=CONTROLLER_NAME, namespace=_NAMESPACE,
-                           num_cpus=0.1).remote()
+                           num_cpus=0.1, max_concurrency=8,
+                           concurrency_groups={"control": 24}).remote()
     except ValueError:
         # raced another creator; the name is now taken
         return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
@@ -134,6 +138,7 @@ class DeploymentHandle:
         # controller's own handler thread (deployment composition passes
         # handles through deploy()'s init args).
         self._last_refresh = 0.0
+        self._listener_started = False
 
     def _refresh(self, force: bool = False) -> None:
         now = time.time()
@@ -150,17 +155,42 @@ class DeploymentHandle:
             info = ray_tpu.get(
                 self._controller.get_routing_info.remote(
                     self.deployment_name), timeout=30)
-            replicas = info["replicas"]
-            with self._lock:
-                self._replicas = replicas
-                self._max_queries = info.get("max_concurrent_queries", 0)
-                live = {r._actor_id.hex() for r in replicas}
-                self._in_flight = {k: v for k, v in self._in_flight.items()
-                                   if k in live}
-                self._model_cache = {
-                    k: v for k, v in self._model_cache.items()
-                    if k in live}
+            self._apply_routing_info(info)
             self._last_refresh = time.time()
+            self._ensure_listener()
+
+    def _apply_routing_info(self, info: Dict[str, Any]) -> None:
+        replicas = info["replicas"]
+        with self._lock:
+            # snapshot ordering guard: a slow poll response racing the
+            # push listener must not roll the replica set back
+            version = info.get("snapshot_id", 0)
+            if version < getattr(self, "_routing_version", 0):
+                return
+            self._routing_version = version
+            self._replicas = replicas
+            self._max_queries = info.get("max_concurrent_queries", 0)
+            live = {r._actor_id.hex() for r in replicas}
+            self._in_flight = {k: v for k, v in self._in_flight.items()
+                               if k in live}
+            self._model_cache = {
+                k: v for k, v in self._model_cache.items()
+                if k in live}
+
+    # ---- long-poll push (reference long_poll.py:30 LongPollClient) --
+    def _ensure_listener(self) -> None:
+        """Start the push listener: a daemon thread parked in the
+        controller's listen_for_change, applying routing updates the
+        moment they happen instead of at the next REFRESH_PERIOD poll.
+        Holds only a weakref so an abandoned handle's thread exits."""
+        if self._listener_started:
+            return
+        self._listener_started = True
+        import weakref
+        ref = weakref.ref(self)
+        threading.Thread(target=_listen_loop, args=(ref,), daemon=True,
+                         name=f"serve-listen-{self.deployment_name}"
+                         ).start()
 
     def __reduce__(self):
         # picklable so deployments can compose: a replica holding a
@@ -285,6 +315,49 @@ class DeploymentHandle:
             return _StreamingResponse(ref)
         cw.add_done_callback(ref, _done)
         return ref
+
+
+def _listen_loop(handle_ref) -> None:
+    """Long-poll loop for one DeploymentHandle (held by weakref): block
+    in the controller until the deployment's snapshot advances, apply
+    the pushed routing info, re-arm. Exits when the handle is collected
+    or the cluster goes away repeatedly."""
+    version = 0
+    failures = 0
+    while True:
+        handle = handle_ref()
+        if handle is None:
+            return
+        controller = handle._controller
+        name = handle.deployment_name
+        del handle  # don't pin the handle while parked in the long poll
+        try:
+            # server-side park (10s) stays well under the client timeout
+            # (40s) so a call queued behind a full 'control' group still
+            # returns in time instead of feeding the failure counter
+            out = ray_tpu.get(
+                controller.listen_for_change.remote({name: version}, 10.0),
+                timeout=40)
+            failures = 0
+        except Exception:  # noqa: BLE001 — controller gone/busy
+            failures += 1
+            if failures >= 5:
+                # give up, but let a later _refresh re-arm a fresh
+                # listener (e.g. after a controller restart)
+                handle = handle_ref()
+                if handle is not None:
+                    handle._listener_started = False
+                return
+            time.sleep(1.0)
+            continue
+        if not out:
+            continue  # timeout: re-arm
+        handle = handle_ref()
+        if handle is None:
+            return
+        version, info = out[name]
+        handle._apply_routing_info(info)
+        handle._last_refresh = time.time()
 
 
 class _HandleOptions:

@@ -240,8 +240,46 @@ class ServeController:
         self._deployments: Dict[str, _DeploymentState] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # long-poll state (reference serve/_private/long_poll.py:30
+        # LongPollHost): per-deployment snapshot ids; listeners block on
+        # the condition until a watched id advances.
+        self._lp_cond = threading.Condition()
+        self._snapshots: Dict[str, int] = {}
         threading.Thread(target=self._reconcile_loop, daemon=True,
                          name="serve-reconcile").start()
+
+    # ---- long-poll push ---------------------------------------------
+
+    def _bump_snapshot(self, name: str) -> None:
+        with self._lp_cond:
+            self._snapshots[name] = self._snapshots.get(name, 0) + 1
+            self._lp_cond.notify_all()
+
+    @_control_group
+    def listen_for_change(self, keys: Dict[str, int],
+                          timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Block until any watched deployment's snapshot id advances past
+        the caller's, then return {name: (new_id, routing_info)}; {} on
+        timeout (the caller re-arms). This is the push channel handles
+        use instead of polling get_routing_info (reference
+        long_poll.py:30 LongPollHost.listen_for_change). Runs on the
+        'control' concurrency group so armed listeners never starve
+        deploy/delete calls."""
+        deadline = time.time() + min(timeout_s, 60.0)
+        while True:
+            with self._lp_cond:
+                changed = {k: self._snapshots.get(k, 0) for k in keys
+                           if self._snapshots.get(k, 0) > keys[k]}
+                if not changed:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return {}
+                    self._lp_cond.wait(remaining)
+                    continue
+            # build result outside the condition (get_routing_info takes
+            # the state lock; never nest it under _lp_cond)
+            return {k: (v, self.get_routing_info(k))
+                    for k, v in changed.items()}
 
     # ---- API --------------------------------------------------------
 
@@ -266,6 +304,7 @@ class ServeController:
                 self._stop_replicas(old.replicas)
                 old.replicas = []
         self._reconcile_one(state)
+        self._bump_snapshot(name)
 
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
@@ -274,13 +313,19 @@ class ServeController:
 
     def get_routing_info(self, name: str) -> Dict[str, Any]:
         """Replica set + limits the router needs (reference: the long
-        poll updates handles receive from the controller)."""
+        poll updates handles receive from the controller). Carries the
+        deployment's snapshot_id so handles can discard stale responses
+        (a slow poll must not overwrite a newer pushed set)."""
+        with self._lp_cond:
+            snap = self._snapshots.get(name, 0)
         with self._lock:
             state = self._deployments.get(name)
             if state is None:
-                return {"replicas": [], "max_concurrent_queries": 0}
+                return {"replicas": [], "max_concurrent_queries": 0,
+                        "snapshot_id": snap}
             return {"replicas": list(state.replicas),
-                    "max_concurrent_queries": state.max_concurrent_queries}
+                    "max_concurrent_queries": state.max_concurrent_queries,
+                    "snapshot_id": snap}
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -296,6 +341,7 @@ class ServeController:
             with state.op_lock:  # wait out any in-flight reconcile
                 self._stop_replicas(state.replicas)
                 state.replicas = []
+            self._bump_snapshot(name)
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -356,11 +402,16 @@ class ServeController:
                 if state.deleted:
                     pending_stop = alive
                     state.replicas = []
+                    changed = False
                 else:
                     pending_stop = []
+                    changed = [id(r) for r in state.replicas] != \
+                        [id(r) for r in alive]
                     state.replicas = alive
         if pending_stop:  # deleted while we were reconciling
             self._stop_replicas(pending_stop)
+        if changed:  # replica set moved: push to long-poll listeners
+            self._bump_snapshot(state.name)
 
     def _autoscale_one(self, state: _DeploymentState) -> None:
         import ray_tpu

@@ -107,3 +107,38 @@ def test_metrics_configs_written(ray_start, tmp_path):
     assert dash["panels"] and dash["title"]
     prom = open(paths["prometheus"]).read()
     assert "scrape_configs" in prom and "/metrics" in prom
+
+
+def test_overview_page_renders_live_actor(ray_start):
+    """VERDICT r4 #7: the web UI page (server-rendered, no build step)
+    shows cluster/nodes/actors/jobs tables, an event feed, and a
+    timeline download link — and lists a live actor by class name."""
+    @ray_tpu.remote
+    class PageProbeActor:
+        def ping(self):
+            return "pong"
+
+    a = PageProbeActor.options(num_cpus=0.1).remote()
+    ray_tpu.get(a.ping.remote())
+    dash = start_dashboard(port=0)
+    port = ray_tpu.get(dash.ready.remote())
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=30) as r:
+            page = r.read().decode()
+        assert "<h2>cluster</h2>" in page
+        assert "<h2>nodes</h2>" in page
+        assert "<h2>actors</h2>" in page
+        assert "<h2>jobs</h2>" in page
+        assert "<h2>recent events</h2>" in page
+        assert "/api/timeline" in page          # download link
+        assert "PageProbeActor" in page         # the live actor row
+        # timeline endpoint actually serves a chrome-trace download
+        req = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/timeline", timeout=30)
+        assert "attachment" in req.headers.get("Content-Disposition", "")
+        events = json.loads(req.read())
+        assert isinstance(events, list)
+    finally:
+        ray_tpu.get(dash.stop.remote(), timeout=30)
+        ray_tpu.kill(dash)

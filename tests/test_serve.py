@@ -301,3 +301,54 @@ def test_multiplexed_models_lru_and_affinity(serve_session):
         ray_tpu.get(handle.options(
             multiplexed_model_id=mid).remote("x"), timeout=120)
     serve.delete("MultiModel")
+
+
+def test_long_poll_pushes_routing_updates(serve_session):
+    """reference serve/_private/long_poll.py:30: handles receive routing
+    updates push-style. With the poll interval effectively disabled, a
+    redeploy must still reach a live handle via the long-poll channel."""
+
+    @serve.deployment(name="lp_dep")
+    def v1():
+        return "v1"
+
+    handle = serve.run(v1)
+    assert ray_tpu.get(handle.remote()) == "v1"
+    # disable the poll fallback: only the push channel can update now
+    handle.REFRESH_PERIOD_S = 600.0
+    old_ids = {r._actor_id.hex() for r in handle._replicas}
+
+    @serve.deployment(name="lp_dep")
+    def v2():
+        return "v2"
+
+    serve.run(v2)  # replaces every replica (new code version)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with handle._lock:
+            new_ids = {r._actor_id.hex() for r in handle._replicas}
+        if new_ids and new_ids != old_ids:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(
+            "push update never reached the handle (old replica set "
+            "still cached with polling disabled)")
+    assert ray_tpu.get(handle.remote()) == "v2"
+
+
+def test_long_poll_listener_does_not_block_control_calls(serve_session):
+    """Armed listeners park in the controller's 'control' concurrency
+    group; deploy/list on the default group must stay responsive."""
+
+    @serve.deployment(name="lp_dep2")
+    def f():
+        return 1
+
+    handle = serve.run(f)
+    assert ray_tpu.get(handle.remote()) == 1  # listener armed
+    t0 = time.time()
+    controller = handle._controller
+    out = ray_tpu.get(controller.list_deployments.remote(), timeout=10)
+    assert "lp_dep2" in out
+    assert time.time() - t0 < 5.0

@@ -140,3 +140,57 @@ def test_accelerate_trainer_runs_loop(tmp_path):
     losses = [m["loss"] for m in result.metrics_history
               if "loss" in m]
     assert len(losses) == 3 and losses[-1] < losses[0]
+
+
+def test_accelerate_config_file_propagates_to_workers(tmp_path):
+    """reference accelerate_trainer.py:44-110: the driver-side config
+    file (plus a nested deepspeed json) ships by value, materializes on
+    each worker with ACCELERATE_CONFIG_FILE pointing at it, and the
+    gang-owned topology keys are stripped."""
+    import json
+
+    from ray_tpu.train import AccelerateTrainer
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+
+    ds_file = tmp_path / "ds.json"
+    ds_file.write_text(json.dumps({"zero_optimization": {"stage": 2}}))
+    cfg_file = tmp_path / "accel.yaml"
+    cfg_file.write_text(
+        "compute_environment: LOCAL_MACHINE\n"
+        "distributed_type: MULTI_CPU\n"
+        "mixed_precision: 'no'\n"
+        "num_machines: 99\n"          # topology: must be stripped
+        "num_processes: 99\n"         # topology: must be stripped
+        "main_process_ip: 1.2.3.4\n"  # topology: must be stripped
+        f"deepspeed_config:\n  deepspeed_config_file: {ds_file}\n")
+
+    def loop():
+        import json as _json
+        import os as _os
+
+        import yaml as _yaml
+
+        import ray_tpu.train as train
+
+        path = _os.environ.get("ACCELERATE_CONFIG_FILE", "")
+        assert path and _os.path.exists(path), path
+        cfg = _yaml.safe_load(open(path))
+        assert cfg["distributed_type"] == "MULTI_CPU"
+        assert "num_machines" not in cfg
+        assert "num_processes" not in cfg
+        assert "main_process_ip" not in cfg
+        ds_path = cfg["deepspeed_config"]["deepspeed_config_file"]
+        assert ds_path != str(ds_file)  # materialized locally, not the
+        ds = _json.load(open(ds_path))  # driver-side path
+        assert ds["zero_optimization"]["stage"] == 2
+        train.report({"ok": 1})
+
+    result = AccelerateTrainer(
+        loop,
+        accelerate_config=str(cfg_file),
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None, result.error
+    assert any(m.get("ok") == 1 for m in result.metrics_history)

@@ -1,0 +1,90 @@
+"""Serve throughput microbench: handle path and HTTP proxy path.
+
+reference parity: the reference ships proxy/handle throughput release
+tests (serve release suite); this measures requests/sec through (a) a
+DeploymentHandle with queue-aware P2C routing and (b) the HTTP ingress
+actor, on a trivial deployment.
+
+    python tools/bench_serve.py [--seconds 15] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=15.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+    @serve.deployment(name="bench_echo", num_replicas=2)
+    def echo(x=0):
+        return x
+
+    handle = serve.run(echo)
+    assert ray_tpu.get(handle.remote(1)) == 1  # warm replicas + listener
+
+    # ---- handle path: keep a pipeline of in-flight calls ------------
+    window = 32
+    refs = [handle.remote(i) for i in range(window)]
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.seconds:
+        done, refs = ray_tpu.wait(refs, num_returns=1, timeout=10)
+        ray_tpu.get(done)
+        n += len(done)
+        refs.append(handle.remote(n))
+    handle_rps = n / (time.perf_counter() - t0)
+
+    # ---- HTTP proxy path --------------------------------------------
+    proxy = serve.start_http(port=8123)
+    n_http = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.seconds:
+        req = urllib.request.Request(
+            "http://127.0.0.1:8123/bench_echo",
+            data=json.dumps({"x": n_http}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+        n_http += 1
+    http_rps = n_http / (time.perf_counter() - t0)
+
+    result = {
+        "suite": "serve_throughput",
+        "handle_requests_per_sec": round(handle_rps, 1),
+        "http_proxy_requests_per_sec": round(http_rps, 1),
+        "replicas": 2,
+        "note": "1-CPU-core host; serial HTTP client, pipelined handle "
+                "client (window 32)",
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    serve.shutdown()
+    try:
+        ray_tpu.kill(proxy)
+    except Exception:  # noqa: BLE001
+        pass
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
