@@ -12,6 +12,7 @@ per-caller seq reordering buffer in _ActorExecutor.
 
 from __future__ import annotations
 
+import collections
 import inspect
 import logging
 import os
@@ -49,6 +50,7 @@ class _TaskEntry:
     return_ids: List[ObjectID]
     lease_node: Optional[Tuple[str, int]] = None
     node_id_hex: Optional[str] = None  # node the lease was granted on
+    sched_key: Optional[bytes] = None  # scheduling-key for lease reuse
     done: bool = False
     # streaming generator returns: children reported incrementally,
     # KEYED by return index (reference StreamingObjectRefGenerator,
@@ -56,6 +58,23 @@ class _TaskEntry:
     # idempotent instead of appending duplicates
     dynamic_arrived: Dict[int, ObjectID] = field(default_factory=dict)
     dynamic_event: threading.Event = field(default_factory=threading.Event)
+
+
+@dataclass
+class _SchedKeyState:
+    """Owner-side per-scheduling-key submission state (reference
+    direct_task_transport.cc SchedulingKey entries): tasks of one shape
+    share a queue, at most one lease request is in flight per key, and
+    leased workers are reused back-to-back while the queue has work —
+    one push RPC per task instead of a lease round trip per task."""
+
+    queue: "collections.deque" = field(
+        default_factory=collections.deque)
+    request_in_flight: bool = False
+    # lease_id -> (worker_address, nm_address, node_id_hex)
+    leases: Dict[str, Tuple] = field(default_factory=dict)
+    # lease_id -> tasks pushed but not yet completed (pipeline depth)
+    lease_inflight: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -124,6 +143,10 @@ class CoreWorker:
         self._borrow_release_queue: "queue.Queue" = queue.Queue()
         self.tasks: Dict[str, _TaskEntry] = {}
         self.actors: Dict[str, _ActorState] = {}
+        self._sched_keys: Dict[bytes, _SchedKeyState] = {}
+        # lease_id -> task_hex currently pushed on that lease (worker
+        # death reports resolve through this under lease reuse)
+        self._lease_running: Dict[str, str] = {}
         # actor id hex -> submitted-but-unfinished calls from THIS
         # process (max_pending_calls backpressure is per caller, like
         # the reference's submit-queue bound)
@@ -208,8 +231,7 @@ class CoreWorker:
     def _attach_trace(self, spec: TaskSpec) -> None:
         """Child tasks inherit the caller's trace; a driver-side submit
         outside any trace starts a fresh one."""
-        import uuid
-        spec.trace_id = self.current_trace_id() or uuid.uuid4().hex[:16]
+        spec.trace_id = self.current_trace_id() or os.urandom(8).hex()
         parent = getattr(self._tls, "task_id", None)
         if parent is not None:
             spec.parent_task_id = parent.hex()
@@ -477,7 +499,8 @@ class CoreWorker:
         # Re-pin args for the re-execution; if an arg object was itself
         # evicted, the executing worker's get() triggers recursive recovery.
         self._pin_args(entry.spec.arg_object_refs)
-        threading.Thread(target=self._request_lease, args=(entry.spec,),
+        threading.Thread(target=self._enqueue_for_lease,
+                         args=(entry.spec.task_id.hex(), entry),
                          daemon=True, name="lineage-recover").start()
         return True
 
@@ -675,13 +698,14 @@ class CoreWorker:
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
                       for i in range(spec.num_returns)]
+        entry = _TaskEntry(spec=spec, retries_left=spec.max_retries,
+                           return_ids=return_ids,
+                           sched_key=self._sched_key(spec))
         with self._lock:
             for oid in return_ids:
                 self.objects[oid.hex()] = (PENDING,)
                 self.object_events[oid.hex()] = threading.Event()
-            self.tasks[spec.task_id.hex()] = _TaskEntry(
-                spec=spec, retries_left=spec.max_retries,
-                return_ids=return_ids)
+            self.tasks[spec.task_id.hex()] = entry
         self._attach_trace(spec)
         self.task_events.record(
             spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
@@ -691,8 +715,32 @@ class CoreWorker:
         spec.locality_hints, spec.arg_locations = \
             self._locality_info(spec.arg_object_refs)
         self._pin_args(spec.arg_object_refs)
-        self._request_lease(spec)
+        self._enqueue_for_lease(spec.task_id.hex(), entry)
         return [ObjectRef(oid, self.address) for oid in return_ids]
+
+    @staticmethod
+    def _sched_key(spec: TaskSpec):
+        """Scheduling-key for owner-side lease reuse (reference
+        direct_task_transport SchedulingKey): tasks may share a leased
+        worker iff everything the lease depends on matches — resource
+        shape, runtime env, scheduling strategy/PG slot, and the
+        function (keeps max_calls accounting per-function simple)."""
+        return spec.scheduling_key()
+
+    def _enqueue_for_lease(self, task_hex: str, entry: _TaskEntry,
+                           nm=None) -> None:
+        """Queue a task under its scheduling key; at most one lease
+        request per key is in flight (the grant/done paths keep draining
+        the queue over leased workers and re-request while backlogged)."""
+        key = entry.sched_key
+        with self._lock:
+            ks = self._sched_keys.setdefault(key, _SchedKeyState())
+            ks.queue.append(task_hex)
+            need_request = not ks.request_in_flight
+            if need_request:
+                ks.request_in_flight = True
+        if need_request:
+            self._request_lease_for_key(key, nm=nm)
 
     def _locality_info(self, arg_ids: List[ObjectID]):
         """(node id hex -> resident arg bytes, oid -> (store, size)) from
@@ -735,40 +783,122 @@ class CoreWorker:
             entry = self.tasks.get(task_id.hex())
         if entry is None or entry.done:
             return
+        # The old queued request is gone at the NM: re-request the key at
+        # the redirect target (request_in_flight stays held by us).
         threading.Thread(
-            target=self._request_lease,
-            args=(entry.spec, self._pool.get(tuple(nm_address))),
+            target=self._request_lease_for_key,
+            args=(entry.sched_key,),
+            kwargs={"nm": self._pool.get(tuple(nm_address))},
             daemon=True, name="lease-respill").start()
 
-    def _request_lease(self, spec: TaskSpec, nm=None) -> None:
-        """Lease a worker; follow spillback redirects (reference
-        direct_task_transport.cc:349,505)."""
-        if nm is None:
-            nm = self._nm
-        for attempt in range(16):
+    def _key_head(self, key: bytes):
+        """(task_hex, entry) of the first live queued task of the key,
+        without popping; clears request_in_flight and returns None when
+        the queue has no live work."""
+        with self._lock:
+            ks = self._sched_keys.get(key)
+            if ks is None:
+                return None
+            while ks.queue:
+                h = ks.queue[0]
+                entry = self.tasks.get(h)
+                if entry is not None and not entry.done:
+                    return h, entry
+                ks.queue.popleft()
+            ks.request_in_flight = False
+            return None
+
+    def _pop_key_task(self, key: bytes):
+        """Pop the next live queued task of the key ((hex, entry) or
+        None)."""
+        with self._lock:
+            ks = self._sched_keys.get(key)
+            while ks is not None and ks.queue:
+                h = ks.queue.popleft()
+                entry = self.tasks.get(h)
+                if entry is not None and not entry.done:
+                    return h, entry
+            return None
+
+    def _request_lease_for_key(self, key: bytes, nm=None) -> None:
+        """Lease a worker for the key's queue head; follow spillback
+        redirects (reference direct_task_transport.cc:349,505). Called
+        with request_in_flight already claimed by the caller. Iterates
+        (not recurses) over queue heads so a long run of infeasible
+        tasks fails them one by one without growing the stack."""
+        while True:
+            head = self._key_head(key)
+            if head is None:
+                return
+            task_hex, entry = head
+            spec = entry.spec
+            attempt = 0
+            conn_failures = 0
+            nm_cur = nm if nm is not None else self._nm
+            nm = None  # a respill redirect only applies to the first head
+            verdict = None
+            while attempt < 16:
+                with self._lock:
+                    # Recorded BEFORE the request so the async grant
+                    # callback (which may arrive first) can find where to
+                    # return it.
+                    entry.lease_node = nm_cur.address
+                try:
+                    kind, payload = nm_cur.call(
+                        "nm_request_lease", spec=spec,
+                        reply_to=self.address, spill_count=attempt)
+                except Exception as e:  # noqa: BLE001
+                    # Connection-level failures are NOT task failures:
+                    # a spill target died (stale cluster view) or the
+                    # local NM hiccuped. Back off and restart from the
+                    # local NM — its view drops the dead node once the
+                    # GCS health check fires — without burning the
+                    # task's retry budget (reference lease clients
+                    # retry RPC errors; max_retries is for execution
+                    # failures).
+                    conn_failures += 1
+                    if conn_failures <= 50:
+                        time.sleep(0.2)
+                        nm_cur = self._nm
+                        attempt = 0
+                        continue
+                    with self._lock:
+                        ks = self._sched_keys.get(key)
+                        if ks is not None:
+                            ks.request_in_flight = False
+                    self._fail_task(task_hex, "SCHEDULING_FAILED",
+                                    f"lease request failed: {e}",
+                                    retry=True)
+                    return
+                if kind == "queued":
+                    return  # grant arrives async; request stays in flight
+                if kind == "infeasible":
+                    verdict = str(payload)
+                    break
+                nm_cur = self._pool.get(tuple(payload))  # spillback
+                attempt += 1
+            if verdict is None:
+                verdict = "too many spillbacks"
             with self._lock:
-                entry = self.tasks.get(spec.task_id.hex())
-                if entry is not None:
-                    # Recorded BEFORE the request so the async grant callback
-                    # (which may arrive first) can find where to return it.
-                    entry.lease_node = nm.address
-            try:
-                kind, payload = nm.call("nm_request_lease", spec=spec,
-                                        reply_to=self.address,
-                                        spill_count=attempt)
-            except Exception as e:  # noqa: BLE001
-                self._fail_task(spec.task_id.hex(), "SCHEDULING_FAILED",
-                                f"lease request failed: {e}", retry=True)
+                ks = self._sched_keys.get(key)
+                if ks is not None:
+                    try:
+                        ks.queue.remove(task_hex)
+                    except ValueError:
+                        pass
+            self._fail_task(task_hex, "SCHEDULING_FAILED", verdict,
+                            retry=False)
+            # loop: the rest of the queue gets its own verdict
+
+    def _kick_key(self, key: bytes) -> None:
+        """Ensure a lease request is in flight while the key has queued
+        work."""
+        with self._lock:
+            ks = self._sched_keys.get(key)
+            if ks is None or ks.request_in_flight or not ks.queue:
                 return
-            if kind == "queued":
-                return
-            if kind == "infeasible":
-                self._fail_task(spec.task_id.hex(), "SCHEDULING_FAILED",
-                                str(payload), retry=False)
-                return
-            nm = self._pool.get(tuple(payload))  # spillback
-        self._fail_task(spec.task_id.hex(), "SCHEDULING_FAILED",
-                        "too many spillbacks", retry=False)
+            ks.request_in_flight = True
+        self._request_lease_for_key(key)
 
     def _on_lease_granted(self, lease_id: str, task_id: TaskID,
                           worker_address: Tuple[str, int],
@@ -776,27 +906,118 @@ class CoreWorker:
                           nm_address: Optional[Tuple[str, int]] = None
                           ) -> None:
         with self._lock:
-            entry = self.tasks.get(task_id.hex())
-            if entry is not None:
-                entry.node_id_hex = node_id
-                if nm_address is not None:
-                    entry.lease_node = tuple(nm_address)
-        if entry is None or entry.done:
-            # Stale grant (task already finished/cancelled/retried): hand
-            # the lease back without touching task state — recording
-            # SCHEDULED here could clobber a terminal FAILED still sitting
-            # in the local event buffer's pending merge.
-            self._return_lease(lease_id, entry, nm_address=nm_address)
+            named = self.tasks.get(task_id.hex())
+        key = named.sched_key if named is not None else None
+        if key is None:
+            # Unknown task (e.g. owner restarted): just hand it back.
+            self._return_lease(lease_id, named, nm_address=nm_address)
             return
-        self.task_events.record(task_id.hex(), state="SCHEDULED",
-                                node_id=node_id)
-        try:
-            self._pool.get(tuple(worker_address)).call(
-                "w_push_task", spec=entry.spec, lease_id=lease_id)
-        except Exception as e:  # noqa: BLE001
-            self._return_lease(lease_id, entry)
-            self._fail_task(entry.spec.task_id.hex(), "WORKER_DIED",
-                            f"push to leased worker failed: {e}", retry=True)
+        with self._lock:
+            ks = self._sched_keys.setdefault(key, _SchedKeyState())
+            ks.request_in_flight = False
+            ks.leases[lease_id] = (tuple(worker_address),
+                                   tuple(nm_address) if nm_address
+                                   else None, node_id)
+        # The grant names the task whose spec rode the request, but any
+        # queued task of the same key may run on it (reference
+        # OnWorkerIdle drains the SchedulingKey queue).
+        self._push_on_lease(key, lease_id)
+        # Keep one request in flight while backlog remains — on a THREAD:
+        # this handler runs inside the NM's blocking cw_lease_granted
+        # call, and a synchronous nm.call back from here can three-way
+        # deadlock on the shared per-address RpcClient locks (owner
+        # handler waits NM, NM's next grant waits the client lock our
+        # caller holds).
+        with self._lock:
+            ks2 = self._sched_keys.get(key)
+            backlog = ks2 is not None and bool(ks2.queue)
+        if backlog:
+            threading.Thread(target=self._kick_key, args=(key,),
+                             daemon=True, name="lease-kick").start()
+
+    # Tasks pushed-but-incomplete per lease: 2 = the worker always has
+    # the next task queued locally when it finishes one, so the owner's
+    # done→push round trip leaves the worker's critical path (the
+    # reference worker submit queues give the same pipelining). The
+    # worker executes normal tasks on ONE thread, so depth never
+    # over-commits the lease's resources.
+    LEASE_PIPELINE_DEPTH = 2
+
+    def _push_on_lease(self, key: bytes, lease_id: str,
+                       fallback_entry: Optional[_TaskEntry] = None
+                       ) -> None:
+        """Keep the leased worker's local queue primed (up to
+        LEASE_PIPELINE_DEPTH in-flight tasks); return the lease when the
+        key's queue is drained and nothing is in flight."""
+        while True:
+            with self._lock:
+                ks = self._sched_keys.get(key)
+                info = ks.leases.get(lease_id) if ks is not None else None
+                inflight = ks.lease_inflight.get(lease_id, 0) \
+                    if ks is not None else 0
+            if info is None:
+                if inflight == 0:
+                    # lease not tracked (already dropped): return via the
+                    # last task's lease_node so a remote NM gets it back
+                    self._return_lease(lease_id, fallback_entry)
+                return
+            worker_address, nm_addr, node_id = info
+            if inflight >= self.LEASE_PIPELINE_DEPTH:
+                return
+            nxt = self._pop_key_task(key)
+            if nxt is None:
+                if inflight == 0:
+                    with self._lock:
+                        ks.leases.pop(lease_id, None)
+                        ks.lease_inflight.pop(lease_id, None)
+                    self._return_lease(lease_id, None, nm_address=nm_addr)
+                return
+            task_hex, entry = nxt
+            if getattr(entry.spec, "max_calls", 0) and inflight >= 1:
+                # no pipelining under max_calls recycling: the worker
+                # may exit right after the current task, losing a
+                # pre-queued one to the death-report path needlessly
+                with self._lock:
+                    ks.queue.appendleft(task_hex)
+                return
+            with self._lock:
+                if lease_id not in ks.leases:
+                    # the lease was consumed by a racing death report
+                    # between our info read and now: pushing would land
+                    # in a dead worker's buffer with NO second death
+                    # report to fail the task — requeue instead
+                    ks.queue.appendleft(task_hex)
+                    return
+                entry.node_id_hex = node_id
+                if nm_addr is not None:
+                    entry.lease_node = nm_addr
+                ks.lease_inflight[lease_id] = inflight + 1
+                self._lease_running.setdefault(lease_id, set()).add(
+                    task_hex)
+            self.task_events.record(task_hex, state="SCHEDULED",
+                                    node_id=node_id)
+            try:
+                # one-way (reference PushTask is async): a push buffered
+                # into a dying worker is failed by the NM's worker-death
+                # report (the task is in _lease_running BEFORE the send,
+                # so a report arriving any time after sees it); send
+                # failures fail over right here
+                self._pool.get(tuple(worker_address)).send_oneway(
+                    "w_push_task", spec=entry.spec, lease_id=lease_id)
+            except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    ks.leases.pop(lease_id, None)
+                    ks.lease_inflight.pop(lease_id, None)
+                    on_lease = self._lease_running.get(lease_id)
+                    if on_lease is not None:
+                        on_lease.discard(task_hex)
+                        if not on_lease:
+                            self._lease_running.pop(lease_id, None)
+                self._return_lease(lease_id, entry)
+                self._fail_task(task_hex, "WORKER_DIED",
+                                f"push to leased worker failed: {e}",
+                                retry=True)
+                return
 
     def _return_lease(self, lease_id: str, entry: Optional[_TaskEntry],
                       nm_address: Optional[Tuple[str, int]] = None,
@@ -808,8 +1029,9 @@ class CoreWorker:
         else:
             nm_addr = self.nm_address
         try:
-            self._pool.get(nm_addr).call("nm_return_worker",
-                                         lease_id=lease_id, reuse=reuse)
+            self._pool.get(nm_addr).send_oneway("nm_return_worker",
+                                                lease_id=lease_id,
+                                                reuse=reuse)
         except Exception:  # noqa: BLE001
             pass
 
@@ -852,22 +1074,19 @@ class CoreWorker:
                         ev.set()
         if retrying:
             if lease_id is not None:
-                self._return_lease(lease_id, entry,
-                                   reuse=not worker_exiting)
+                self._settle_lease_slot(entry, lease_id, worker_exiting)
             logger.warning(
                 "retrying task %s after application error, %d retries "
                 "left", entry.spec.function_name, entry.retries_left)
-            threading.Thread(target=self._request_lease,
-                             args=(entry.spec,), daemon=True).start()
+            threading.Thread(target=self._enqueue_for_lease,
+                             args=(entry.spec.task_id.hex(), entry),
+                             daemon=True).start()
             return
         if duplicate:
             # Late/duplicate completion (e.g. after cancel or retry): the
-            # first writer won; just hand back any lease that rode in —
-            # still honoring worker_exiting so a dying worker can't slip
-            # back into the idle pool through this branch.
+            # first writer won; settle the lease slot that rode in.
             if lease_id is not None:
-                self._return_lease(lease_id, entry,
-                                   reuse=not worker_exiting)
+                self._settle_lease_slot(entry, lease_id, worker_exiting)
             return
         for oid, loc in zip(entry.return_ids, results):
             with self._lock:
@@ -882,10 +1101,43 @@ class CoreWorker:
         entry.dynamic_event.set()  # wake streaming iterators: task over
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
         if lease_id is not None:
-            # worker_exiting (max_calls recycling): retire the worker from
-            # the pool atomically with the lease return, so the node
-            # manager can't re-lease a process that's about to exit
-            self._return_lease(lease_id, entry, reuse=not worker_exiting)
+            self._settle_lease_slot(entry, lease_id, worker_exiting)
+
+    def _settle_lease_slot(self, entry: Optional[_TaskEntry],
+                           lease_id: str, worker_exiting: bool) -> None:
+        """One pushed task finished (or was superseded): free its
+        pipeline slot, then either retire the lease (worker_exiting:
+        max_calls recycling — the NM must not re-lease a process that's
+        about to exit) or keep the leased worker primed / return it
+        (reference direct_task_transport.cc:125 lease reuse)."""
+        key = entry.sched_key if entry is not None else None
+        task_hex = entry.spec.task_id.hex() if entry is not None else None
+        with self._lock:
+            on_lease = self._lease_running.get(lease_id)
+            if on_lease is not None and task_hex is not None:
+                on_lease.discard(task_hex)
+                if not on_lease:
+                    self._lease_running.pop(lease_id, None)
+            ks = self._sched_keys.get(key) if key is not None else None
+            if ks is not None and lease_id in ks.lease_inflight:
+                ks.lease_inflight[lease_id] = max(
+                    0, ks.lease_inflight[lease_id] - 1)
+        if worker_exiting:
+            self._drop_lease(key, lease_id)
+            self._return_lease(lease_id, entry, reuse=False)
+            return
+        if key is None:
+            self._return_lease(lease_id, entry)
+            return
+        self._push_on_lease(key, lease_id, fallback_entry=entry)
+
+    def _drop_lease(self, key: Optional[bytes], lease_id: str) -> None:
+        """Forget a held lease (it is being returned/retired)."""
+        with self._lock:
+            ks = self._sched_keys.get(key) if key is not None else None
+            if ks is not None:
+                ks.leases.pop(lease_id, None)
+                ks.lease_inflight.pop(lease_id, None)
 
     def _on_dynamic_child(self, task_id: TaskID, child: ObjectID,
                           loc: Tuple) -> None:
@@ -905,8 +1157,23 @@ class CoreWorker:
         self._fire_done_callbacks([child.hex()])
 
     def _on_task_failed(self, task_id: TaskID, error_type: str,
-                        message: str) -> None:
-        self._fail_task(task_id.hex(), error_type, message, retry=True)
+                        message: str,
+                        lease_id: Optional[str] = None) -> None:
+        fail_hexes = [task_id.hex()]
+        if lease_id is not None:
+            # With lease reuse + pipelining, the tasks in flight on the
+            # lease at failure time (running + queued in the dead
+            # worker) may differ from the task the lease was granted
+            # for — the lease→running map has the truth.
+            with self._lock:
+                running = self._lease_running.pop(lease_id, None)
+            if running:
+                fail_hexes = sorted(running)
+            entry = self.tasks.get(fail_hexes[0])
+            if entry is not None and entry.sched_key is not None:
+                self._drop_lease(entry.sched_key, lease_id)
+        for tid_hex in fail_hexes:
+            self._fail_task(tid_hex, error_type, message, retry=True)
 
     def _fail_task(self, task_hex: str, error_type: str, message: str,
                    retry: bool) -> None:
@@ -924,7 +1191,8 @@ class CoreWorker:
             logger.warning("retrying task %s (%s: %s), %d retries left",
                            entry.spec.function_name, error_type, message,
                            entry.retries_left)
-            threading.Thread(target=self._request_lease, args=(entry.spec,),
+            threading.Thread(target=self._enqueue_for_lease,
+                             args=(entry.spec.task_id.hex(), entry),
                              daemon=True).start()
             return
         if error_type == "WORKER_DIED":
@@ -1068,7 +1336,11 @@ class CoreWorker:
         try:
             if addr is None:
                 raise rpc_lib.ConnectionLost("actor address unknown")
-            self._pool.get(addr).call("w_push_task", spec=spec)
+            # one-way push (reference PushTask is async with an error
+            # callback): send failures raise and re-resolve below; a
+            # push lost in a dying actor's buffer is failed by the
+            # death/incarnation bookkeeping (state.pushed) instead
+            self._pool.get(addr).send_oneway("w_push_task", spec=spec)
             with self._lock:
                 state = self.actors[spec.actor_id.hex()]
                 state.pushed[spec.task_id.hex()] = state.incarnation
@@ -1280,15 +1552,29 @@ class CoreWorker:
             return
         dead_hex = info.node_id.hex()
         dead_nm = tuple(info.address) if info.address else None
+        kick_keys = set()
         with self._lock:
             lost = [e for e in self.tasks.values()
                     if not e.done and e.spec.actor_id is None
                     and (e.node_id_hex == dead_hex
                          or (e.lease_node is not None
                              and e.lease_node == dead_nm))]
+            # A lease request "queued" at the dead NM never gets its
+            # grant: clear the in-flight flag so the key's queue can
+            # re-request at a live NM instead of stalling forever.
+            for e in lost:
+                ks = self._sched_keys.get(e.sched_key)
+                if ks is not None and ks.request_in_flight and \
+                        e.lease_node == dead_nm:
+                    ks.request_in_flight = False
+                    if ks.queue:
+                        kick_keys.add(e.sched_key)
         for e in lost:
             self._fail_task(e.spec.task_id.hex(), "WORKER_DIED",
                             f"node {dead_hex[:12]} died", retry=True)
+        for key in kick_keys:
+            threading.Thread(target=self._kick_key, args=(key,),
+                             daemon=True, name="node-death-kick").start()
 
     def _on_actor_event(self, message: Any) -> None:
         try:
@@ -1650,9 +1936,9 @@ class _Executor:
                     return
                 child, loc = item
                 try:
-                    owner.call("cw_dynamic_child",
-                               task_id=spec.task_id,
-                               child=child, loc=loc)
+                    owner.send_oneway("cw_dynamic_child",
+                                      task_id=spec.task_id,
+                                      child=child, loc=loc)
                 except Exception:  # noqa: BLE001
                     return  # batch report covers the rest
 
@@ -1696,7 +1982,22 @@ class _Executor:
                      worker_exiting: bool = False) -> None:
         lease_id = getattr(spec, "_lease_id", None)
         try:
-            self.cw._pool.get(spec.owner_address).call(
+            if worker_exiting:
+                # BLOCKING when this process is about to exit (max_calls
+                # recycling): the owner must record the result before
+                # the NM's worker-death report can race in, else a task
+                # that succeeded gets retried (side effects twice)
+                self.cw._pool.get(spec.owner_address).call(
+                    "cw_task_done", task_id=spec.task_id,
+                    results=results, lease_id=lease_id,
+                    dynamic_children=dynamic_children,
+                    worker_exiting=True)
+                return
+            # one-way: the worker moves on to its next task without
+            # waiting out the owner's bookkeeping round trip (send
+            # failures still raise; a dead owner is the only loss case
+            # and its results are moot)
+            self.cw._pool.get(spec.owner_address).send_oneway(
                 "cw_task_done", task_id=spec.task_id, results=results,
                 lease_id=lease_id, dynamic_children=dynamic_children,
                 worker_exiting=worker_exiting)

@@ -15,13 +15,14 @@ from typing import Optional
 
 class BaseID:
     SIZE = 16
-    __slots__ = ("_bin",)
+    __slots__ = ("_bin", "_hex")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
             raise ValueError(
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}")
         self._bin = binary
+        self._hex: Optional[str] = None
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -42,7 +43,12 @@ class BaseID:
         return self._bin
 
     def hex(self) -> str:
-        return self._bin.hex()
+        # memoized: ids are hashed into dict keys on every control-plane
+        # hop, ~20x per task submission
+        h = self._hex
+        if h is None:
+            h = self._hex = self._bin.hex()
+        return h
 
     def __hash__(self) -> int:
         return hash((type(self).__name__, self._bin))

@@ -517,9 +517,13 @@ class NodeManager:
                 pass
         if running is not None and not handle.is_actor:
             try:
+                # lease_id rides along: with owner-side lease reuse the
+                # task RUNNING at death may differ from the task the
+                # lease was granted for — the owner maps lease->running.
                 self._pool.get(running.owner_address).call(
                     "cw_task_failed", task_id=running.task_id,
-                    error_type="WORKER_DIED", message=reason)
+                    error_type="WORKER_DIED", message=reason,
+                    lease_id=lease_id)
             except Exception:  # noqa: BLE001
                 pass
         self._dispatch()
@@ -647,6 +651,13 @@ class NodeManager:
         with self._lock:
             remaining: List[_PendingLease] = []
             want_spawn: Dict[str, int] = {}
+            # Per-pass failure memo: once a resource shape fails to
+            # acquire, every later identical shape in this pass fails
+            # too (resources only shrink within the loop) — keeps a
+            # dispatch pass O(shapes) instead of O(pending) subset
+            # checks when tens of thousands of same-shape leases queue
+            # (SURVEY §6 single-node envelope: 1M queued tasks).
+            failed_shapes: set = set()
             for pl in self.pending:
                 # hard label constraints must hold on THIS node before a
                 # queued lease may dispatch locally (the cluster-level
@@ -659,10 +670,15 @@ class NodeManager:
                     continue
                 if pl.acquired is None:
                     required = self._effective_resources(pl.spec)
+                    shape = tuple(sorted(required.to_dict().items()))
+                    if shape in failed_shapes:
+                        remaining.append(pl)
+                        continue
                     if required.is_subset_of(self.available):
                         self.available.subtract(required)
                         pl.acquired = required
                     else:
+                        failed_shapes.add(shape)
                         remaining.append(pl)
                         continue
                 key = self._runtime_env_key(pl.spec)

@@ -11,6 +11,7 @@ same framing for the pickled (status, payload) reply.
 
 from __future__ import annotations
 
+import logging
 import pickle
 import socket
 import socketserver
@@ -109,7 +110,11 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             while True:
                 req = _recv_frame(sock)
-                method, kwargs = pickle.loads(req)
+                item = pickle.loads(req)
+                if len(item) == 3:
+                    method, kwargs, oneway = item
+                else:
+                    (method, kwargs), oneway = item, False
                 _chaos_delay()
                 try:
                     handler = server.handlers[method]
@@ -129,6 +134,14 @@ class _Handler(socketserver.BaseRequestHandler):
                         except Exception:  # noqa: BLE001 - unpicklable exc
                             blob = None
                         reply = ("err", (blob, traceback.format_exc()))
+                if oneway:
+                    # fire-and-forget frame: no reply; surface handler
+                    # errors in the server log (callers detect failures
+                    # out-of-band — death pubsub, connection loss)
+                    if reply[0] == "err":
+                        logging.getLogger(__name__).warning(
+                            "oneway rpc %s failed: %s", method, reply[1])
+                    continue
                 _send_frame(sock, pickle.dumps(reply, protocol=5))
         except (ConnectionLost, ConnectionResetError, BrokenPipeError, OSError):
             return
@@ -263,6 +276,30 @@ class RpcClient:
                 result = tb
             raise RpcError(f"remote error from {self.address}.{method}:\n{result}")
         return result
+
+    def send_oneway(self, method: str, **kwargs: Any) -> None:
+        """Fire-and-forget: the server runs the handler without replying,
+        so the caller never blocks on a round trip. Send failures raise
+        (full-frame resend on a fresh connection is safe — a partial
+        frame on a dead socket was never dispatched); handler errors are
+        logged server-side only. Use for pushes whose failure is
+        detected out-of-band (actor-death pubsub, worker connection
+        loss), never for requests whose reply carries state."""
+        payload = pickle.dumps((method, kwargs, True), protocol=5)
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send_frame(self._sock, payload)
+                    return
+                except (ConnectionLost, ConnectionResetError,
+                        BrokenPipeError, OSError):
+                    self.close_locked()
+                    if attempt == 1:
+                        raise ConnectionLost(
+                            f"oneway rpc to {self.address} failed: "
+                            f"{method}")
 
     def close_locked(self) -> None:
         if self._sock is not None:

@@ -25,17 +25,28 @@ def loads_function(blob: bytes) -> Any:
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
-    """CloudPickler with pickle5 out-of-band buffers.
+    """pickle5 with out-of-band buffers; cloudpickle where it matters.
 
-    Always cloudpickle, never plain pickle: plain pickle serializes
-    driver-script (__main__) functions *by reference* without error, and
-    the reference breaks only at deserialization time inside a worker
-    whose __main__ is worker_main. CloudPickler pickles unimportable
-    objects (closures, __main__ functions, lambdas) by value and
-    everything else by reference, and for plain data is the same C
-    pickler underneath.
+    Plain pickle serializes driver-script (__main__) functions *by
+    reference* without error, and the reference breaks only at
+    deserialization time inside a worker whose __main__ is worker_main;
+    CloudPickler pickles unimportable objects (closures, __main__
+    functions, lambdas) by value. But CloudPickler construction costs
+    ~25µs per call — real money on the task-submission hot path where
+    args are almost always plain data. So: plain C pickler first, and
+    fall back to cloudpickle when it fails OR when the blob contains a
+    by-reference __main__ marker (a string arg merely containing
+    "__main__" just pays the cloudpickle price — safe, not wrong).
     """
     buffers: List[pickle.PickleBuffer] = []
+    try:
+        blob = pickle.dumps(value, protocol=5,
+                            buffer_callback=buffers.append)
+        if b"__main__" not in blob:
+            return b"P" + blob, buffers
+    except Exception:  # noqa: BLE001 — unpicklable by plain pickle
+        pass
+    buffers = []
     f = io.BytesIO()
     cloudpickle.CloudPickler(
         f, protocol=5, buffer_callback=buffers.append).dump(value)

@@ -10,7 +10,7 @@ epsilon here, quantized to 1e-4 like the reference's FixedPoint).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -170,6 +170,7 @@ class TaskSpec:
     # Misc
     name: str = ""
     namespace: str = ""
+
     detached: bool = False
     submitted_at: float = field(default_factory=time.time)
 
@@ -178,12 +179,52 @@ class TaskSpec:
 
     def scheduling_key(self) -> Tuple:
         """Tasks with the same key can reuse a leased worker (reference:
-        direct_task_transport lease reuse, SchedulingKey)."""
+        direct_task_transport lease reuse, SchedulingKey). repr() of the
+        strategy (not just its type): NodeAffinity(node A) must not
+        reuse a lease held for NodeAffinity(node B)."""
         return (self.function_key, tuple(sorted(self.resources.items())),
-                type(self.scheduling_strategy).__name__,
+                repr(self.scheduling_strategy),
                 self.placement_group_id.hex() if self.placement_group_id else "",
                 self.placement_group_bundle_index,
-                repr(sorted((self.runtime_env or {}).get("env_vars", {}).items())))
+                # FULL runtime env, canonicalized (dict insertion order
+                # must not split keys): working_dir / py_modules / pip
+                # change what a worker has materialized, and a reused
+                # lease pins the worker
+                _canonical(self.runtime_env) if self.runtime_env else "")
+
+    # Compact pickling: specs cross a process boundary on every task
+    # push; the default dataclass reduce ships all 30 field-name strings
+    # per spec. A positional tuple roughly halves encode+decode cost on
+    # the control-plane hot path (reference keeps specs in protobuf for
+    # the same reason). Ad-hoc attributes (e.g. the worker-side
+    # _lease_id) ride in the extras dict.
+    def __getstate__(self):
+        d = self.__dict__
+        if len(d) == len(_SPEC_FIELDS):  # common case: no ad-hoc attrs
+            extras = None
+        else:
+            extras = {k: v for k, v in d.items()
+                      if k not in _SPEC_FIELD_SET} or None
+        return ([d[f] for f in _SPEC_FIELDS], extras)
+
+    def __setstate__(self, state):
+        vals, extras = state
+        self.__dict__.update(zip(_SPEC_FIELDS, vals))
+        if extras:
+            self.__dict__.update(extras)
+
+
+_SPEC_FIELDS = tuple(f.name for f in fields(TaskSpec))
+_SPEC_FIELD_SET = frozenset(_SPEC_FIELDS)
+
+
+def _canonical(v: Any):
+    """Order-insensitive hashable form of nested dict/list config."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _canonical(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_canonical(x) for x in v)
+    return v
 
 
 class WorkerExitType(Enum):

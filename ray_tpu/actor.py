@@ -99,9 +99,13 @@ class ActorHandle:
             raise AttributeError(
                 f"actor {self._class_name} has no method '{name}'")
         opts = self._method_options.get(name, {})
-        return ActorMethod(self, name,
-                           opts.get("num_returns", 1),
-                           opts.get("concurrency_group", ""))
+        method = ActorMethod(self, name,
+                             opts.get("num_returns", 1),
+                             opts.get("concurrency_group", ""))
+        # cache on the instance: __getattr__ only fires on misses, so
+        # `handle.m.remote()` in a hot loop builds the method once
+        self.__dict__[name] = method
+        return method
 
     def _submit(self, method_name: str, args: tuple, kwargs: dict,
                 num_returns: int, concurrency_group: str = "") -> Any:

@@ -39,7 +39,9 @@ class EnvRunnerSet:
                 config.env, module, config.env_config,
                 num_envs=config.num_envs_per_env_runner,
                 seed=config.seed, worker_index=0, gamma=config.gamma,
-                policy_mapping_fn=config.policy_mapping_fn)
+                policy_mapping_fn=config.policy_mapping_fn,
+                env_connectors=config.env_connectors,
+                action_connectors=config.action_connectors)
         else:
             import ray_tpu
             runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
@@ -49,7 +51,9 @@ class EnvRunnerSet:
                     num_envs=config.num_envs_per_env_runner,
                     seed=config.seed, worker_index=i + 1,
                     gamma=config.gamma,
-                    policy_mapping_fn=config.policy_mapping_fn)
+                    policy_mapping_fn=config.policy_mapping_fn,
+                    env_connectors=config.env_connectors,
+                    action_connectors=config.action_connectors)
                 for i in range(config.num_env_runners)
             ]
 
@@ -123,6 +127,12 @@ class Algorithm:
         probe = make_env(config.env, config.env_config)
         self.observation_space = probe.observation_space
         self.action_space = probe.action_space
+        if config.env_connectors:
+            # the module acts on the PIPELINE's output space
+            from ray_tpu.rllib.connectors import ConnectorPipeline
+            self.observation_space = ConnectorPipeline(
+                config.env_connectors).observation_space(
+                    self.observation_space)
         probe.close()
 
         if config.policies:

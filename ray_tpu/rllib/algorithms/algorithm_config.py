@@ -23,6 +23,12 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
+        # connector pipelines (reference connectors/): vectorized
+        # obs/reward transforms + action transforms at the runner
+        # boundary; instances are templates — each runner gets its own
+        # (pickled) copy of the stateful ones
+        self.env_connectors: list = []
+        self.action_connectors: list = []
         # training
         self.lr: float = 5e-5
         self.gamma: float = 0.99
@@ -61,7 +67,9 @@ class AlgorithmConfig:
 
     def env_runners(self, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None
+                    rollout_fragment_length: Optional[int] = None,
+                    env_connectors: Optional[list] = None,
+                    action_connectors: Optional[list] = None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -69,6 +77,10 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_connectors is not None:
+            self.env_connectors = list(env_connectors)
+        if action_connectors is not None:
+            self.action_connectors = list(action_connectors)
         return self
 
     def training(self, **kwargs: Any) -> "AlgorithmConfig":

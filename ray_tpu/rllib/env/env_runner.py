@@ -29,7 +29,9 @@ class SingleAgentEnvRunner:
                  env_config: Optional[Dict[str, Any]] = None,
                  num_envs: int = 1, seed: Optional[int] = None,
                  worker_index: int = 0, gamma: float = 0.99,
-                 policy_mapping_fn=None):
+                 policy_mapping_fn=None,
+                 env_connectors: Optional[list] = None,
+                 action_connectors: Optional[list] = None):
         import jax
         # Runners act on CPU regardless of the driver platform. Actor
         # runners (worker_index > 0) run in their own worker process and
@@ -122,8 +124,17 @@ class SingleAgentEnvRunner:
                 lambda p, obs: module.forward_train(
                     p, {"obs": obs})["vf_preds"])
 
+        # connector pipelines (reference connectors/): vectorized
+        # obs/reward + action transforms between the env and the module
+        from ray_tpu.rllib.connectors import ConnectorPipeline
+        self._env_pipeline = ConnectorPipeline(env_connectors) \
+            if env_connectors else None
+        self._action_connectors = list(action_connectors or [])
+
         base_seed = None if seed is None else seed + worker_index * 1000
         self._obs, _ = self.env.reset(base_seed)
+        if self._env_pipeline is not None:
+            self._obs = self._env_pipeline.on_reset(self._obs)
         # per-env running episode returns/lengths for metrics
         self._ep_ret = np.zeros(self.env.num_envs, np.float64)
         self._ep_len = np.zeros(self.env.num_envs, np.int64)
@@ -225,9 +236,15 @@ class SingleAgentEnvRunner:
             with self._on_cpu():
                 self._key, sub = jax.random.split(self._key)
             actions, logp, vf = self._forward_explore(self._obs, sub)
+            env_actions = actions
+            for ac in self._action_connectors:
+                env_actions = ac(env_actions)
             obs_next, rewards, terms, truncs, _, final_obs = \
-                self.env.step(actions)
+                self.env.step(env_actions)
             raw_rewards = rewards.copy()
+            if self._env_pipeline is not None:
+                obs_next, rewards, final_obs = self._env_pipeline.on_step(
+                    obs_next, rewards, terms, truncs, final_obs)
             for i in np.nonzero(np.asarray(terms) | np.asarray(truncs))[0]:
                 if final_obs[i] is not None:
                     finals_idx.append((step_t, int(i)))

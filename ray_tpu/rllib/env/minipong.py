@@ -131,3 +131,7 @@ def make_minipong(config: Optional[Dict[str, Any]] = None) -> Env:
 
 
 register_env("MiniPong-v0", make_minipong)
+# raw frames, no preprocessing: the connector-pipeline entry point
+# (rllib/connectors deepmind_connectors supplies the DeepMind transforms)
+register_env("MiniPongRaw-v0",
+             lambda config=None: MiniPongRaw(config))

@@ -80,6 +80,14 @@ def resize_image(img: np.ndarray, height: int, width: int) -> np.ndarray:
     return (top * (1 - wy) + bot * wy).astype(img.dtype)
 
 
+def rgb_to_gray(obs: np.ndarray) -> np.ndarray:
+    """ITU-R 601 luma [..., 3] -> [...] uint8, integer math (shared by
+    WarpFrame and the connector pipeline so both stay bit-identical)."""
+    return ((77 * obs[..., 0].astype(np.uint16)
+             + 150 * obs[..., 1].astype(np.uint16)
+             + 29 * obs[..., 2].astype(np.uint16)) >> 8).astype(np.uint8)
+
+
 class WarpFrame(Wrapper):
     """Grayscale + resize to [dim, dim, 1] uint8 (reference WarpFrame:
     84x84 grayscale, the Nature-DQN observation)."""
@@ -91,11 +99,7 @@ class WarpFrame(Wrapper):
 
     def _warp(self, obs: np.ndarray) -> np.ndarray:
         if obs.ndim == 3 and obs.shape[-1] == 3:
-            # ITU-R 601 luma, uint16 math to avoid float per frame
-            obs = ((77 * obs[..., 0].astype(np.uint16)
-                    + 150 * obs[..., 1].astype(np.uint16)
-                    + 29 * obs[..., 2].astype(np.uint16)) >> 8
-                   ).astype(np.uint8)
+            obs = rgb_to_gray(obs)
         elif obs.ndim == 3 and obs.shape[-1] == 1:
             obs = obs[..., 0]
         out = resize_image(obs, self.dim, self.dim)
