@@ -51,6 +51,9 @@ class _TaskEntry:
     lease_node: Optional[Tuple[str, int]] = None
     node_id_hex: Optional[str] = None  # node the lease was granted on
     sched_key: Optional[bytes] = None  # scheduling-key for lease reuse
+    # True while this task's hex sits in its key's queue: retry paths
+    # must not append a second copy (double execution)
+    in_key_queue: bool = False
     done: bool = False
     # streaming generator returns: children reported incrementally,
     # KEYED by return index (reference StreamingObjectRefGenerator,
@@ -144,9 +147,10 @@ class CoreWorker:
         self.tasks: Dict[str, _TaskEntry] = {}
         self.actors: Dict[str, _ActorState] = {}
         self._sched_keys: Dict[bytes, _SchedKeyState] = {}
-        # lease_id -> task_hex currently pushed on that lease (worker
-        # death reports resolve through this under lease reuse)
-        self._lease_running: Dict[str, str] = {}
+        # lease_id -> set of task hexes pushed-but-incomplete on that
+        # lease (worker death reports fail exactly these under lease
+        # reuse + pipelining)
+        self._lease_running: Dict[str, set] = {}
         # actor id hex -> submitted-but-unfinished calls from THIS
         # process (max_pending_calls backpressure is per caller, like
         # the reference's submit-queue bound)
@@ -735,7 +739,12 @@ class CoreWorker:
         key = entry.sched_key
         with self._lock:
             ks = self._sched_keys.setdefault(key, _SchedKeyState())
-            ks.queue.append(task_hex)
+            if not entry.in_key_queue:
+                # retry of a task still queued (e.g. node-death fail of
+                # a queued lease head) must not enqueue a second copy —
+                # the duplicate would execute concurrently
+                ks.queue.append(task_hex)
+                entry.in_key_queue = True
             need_request = not ks.request_in_flight
             if need_request:
                 ks.request_in_flight = True
@@ -805,19 +814,9 @@ class CoreWorker:
                 if entry is not None and not entry.done:
                     return h, entry
                 ks.queue.popleft()
+                if entry is not None:
+                    entry.in_key_queue = False
             ks.request_in_flight = False
-            return None
-
-    def _pop_key_task(self, key: bytes):
-        """Pop the next live queued task of the key ((hex, entry) or
-        None)."""
-        with self._lock:
-            ks = self._sched_keys.get(key)
-            while ks is not None and ks.queue:
-                h = ks.queue.popleft()
-                entry = self.tasks.get(h)
-                if entry is not None and not entry.done:
-                    return h, entry
             return None
 
     def _request_lease_for_key(self, key: bytes, nm=None) -> None:
@@ -884,6 +883,7 @@ class CoreWorker:
                 if ks is not None:
                     try:
                         ks.queue.remove(task_hex)
+                        entry.in_key_queue = False
                     except ValueError:
                         pass
             self._fail_task(task_hex, "SCHEDULING_FAILED", verdict,
@@ -948,60 +948,83 @@ class CoreWorker:
                        ) -> None:
         """Keep the leased worker's local queue primed (up to
         LEASE_PIPELINE_DEPTH in-flight tasks); return the lease when the
-        key's queue is drained and nothing is in flight."""
+        key's queue is drained and nothing is in flight.
+
+        All lease-state reads and writes for one push happen under ONE
+        lock acquisition: a split check/increment would race concurrent
+        decrements from _settle_lease_slot (lost update → the drained
+        lease is never returned) and concurrent pushers (over-depth)."""
         while True:
             with self._lock:
                 ks = self._sched_keys.get(key)
                 info = ks.leases.get(lease_id) if ks is not None else None
                 inflight = ks.lease_inflight.get(lease_id, 0) \
                     if ks is not None else 0
-            if info is None:
-                if inflight == 0:
-                    # lease not tracked (already dropped): return via the
-                    # last task's lease_node so a remote NM gets it back
-                    self._return_lease(lease_id, fallback_entry)
+                if info is None:
+                    task = None
+                    action = "return_untracked" if inflight == 0 else \
+                        "noop"
+                elif inflight >= self.LEASE_PIPELINE_DEPTH:
+                    task = None
+                    action = "noop"
+                else:
+                    worker_address, nm_addr, node_id = info
+                    # pop the next live queued task (inline: same lock)
+                    task = None
+                    while ks.queue:
+                        h = ks.queue.popleft()
+                        e2 = self.tasks.get(h)
+                        if e2 is not None:
+                            e2.in_key_queue = False
+                        if e2 is not None and not e2.done:
+                            task = (h, e2)
+                            break
+                    if task is None:
+                        if inflight == 0:
+                            ks.leases.pop(lease_id, None)
+                            ks.lease_inflight.pop(lease_id, None)
+                            action = "return_drained"
+                        else:
+                            action = "noop"
+                    elif getattr(task[1].spec, "max_calls", 0) \
+                            and inflight >= 1:
+                        # no pipelining under max_calls recycling: the
+                        # worker may exit right after the current task,
+                        # losing a pre-queued one to the death-report
+                        # path needlessly
+                        ks.queue.appendleft(task[0])
+                        task[1].in_key_queue = True
+                        task = None
+                        action = "noop"
+                    else:
+                        task_hex, entry = task
+                        entry.node_id_hex = node_id
+                        if nm_addr is not None:
+                            entry.lease_node = nm_addr
+                        ks.lease_inflight[lease_id] = inflight + 1
+                        self._lease_running.setdefault(
+                            lease_id, set()).add(task_hex)
+                        action = "push"
+            if action == "return_untracked":
+                # lease not tracked (already dropped): return via the
+                # last task's lease_node so a remote NM gets it back
+                self._return_lease(lease_id, fallback_entry)
                 return
-            worker_address, nm_addr, node_id = info
-            if inflight >= self.LEASE_PIPELINE_DEPTH:
+            if action == "return_drained":
+                self._return_lease(lease_id, None, nm_address=nm_addr)
                 return
-            nxt = self._pop_key_task(key)
-            if nxt is None:
-                if inflight == 0:
-                    with self._lock:
-                        ks.leases.pop(lease_id, None)
-                        ks.lease_inflight.pop(lease_id, None)
-                    self._return_lease(lease_id, None, nm_address=nm_addr)
+            if action != "push":
                 return
-            task_hex, entry = nxt
-            if getattr(entry.spec, "max_calls", 0) and inflight >= 1:
-                # no pipelining under max_calls recycling: the worker
-                # may exit right after the current task, losing a
-                # pre-queued one to the death-report path needlessly
-                with self._lock:
-                    ks.queue.appendleft(task_hex)
-                return
-            with self._lock:
-                if lease_id not in ks.leases:
-                    # the lease was consumed by a racing death report
-                    # between our info read and now: pushing would land
-                    # in a dead worker's buffer with NO second death
-                    # report to fail the task — requeue instead
-                    ks.queue.appendleft(task_hex)
-                    return
-                entry.node_id_hex = node_id
-                if nm_addr is not None:
-                    entry.lease_node = nm_addr
-                ks.lease_inflight[lease_id] = inflight + 1
-                self._lease_running.setdefault(lease_id, set()).add(
-                    task_hex)
+            task_hex, entry = task
             self.task_events.record(task_hex, state="SCHEDULED",
                                     node_id=node_id)
             try:
                 # one-way (reference PushTask is async): a push buffered
                 # into a dying worker is failed by the NM's worker-death
-                # report (the task is in _lease_running BEFORE the send,
-                # so a report arriving any time after sees it); send
-                # failures fail over right here
+                # report (the task enters _lease_running under the same
+                # lock that verified the lease is live, so a report
+                # arriving any time after sees it); send failures fail
+                # over right here
                 self._pool.get(tuple(worker_address)).send_oneway(
                     "w_push_task", spec=entry.spec, lease_id=lease_id)
             except Exception as e:  # noqa: BLE001

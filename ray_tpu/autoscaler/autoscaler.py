@@ -105,28 +105,53 @@ class FakeMultiNodeProvider(NodeProvider):
         return list(self._nodes.values())
 
 
-class GKETPUNodeProvider(NodeProvider):
-    """GKE TPU node-pool provider sketch: one "node" = one TPU pod
-    slice (node pool with `tpu-topology`), the platform this framework
-    targets. Follows the reference provider contract
-    (node_provider.py) + the TPU accelerator manager's pod-slice
-    resource naming (accelerators/tpu.py: `TPU-<type>-head` on worker 0
-    of a slice) so gang-scheduled slice actors land on freshly-launched
-    slices.
+class SliceBackend:
+    """Host-materialization hook for GKETPUNodeProvider (the seam the
+    reference gets from batching_node_provider.py:54 — the provider
+    asks the platform for hosts; how they appear is pluggable/testable).
+    create_hosts returns one dict per host: at least
+    {"host_id": ..., "node_id_hex": ... or None, "resources": {...}}."""
 
-    The gcloud calls are behind `_run` so tests can stub them; without
-    a reachable cluster every operation raises with a clear message
-    rather than pretending to scale.
-    """
+    def create_hosts(self, pool: str,
+                     host_resources: List[Dict[str, float]]
+                     ) -> List[Dict[str, Any]]:
+        raise NotImplementedError
 
-    def __init__(self, cluster: str, zone: str,
-                 accelerator_type: str = "v5p-8",
-                 node_pool_prefix: str = "ray-tpu"):
+    def delete_hosts(self, pool: str) -> None:
+        raise NotImplementedError
+
+
+class FakeSliceBackend(SliceBackend):
+    """Instant in-memory slice hosts (the FakeMultiNode pattern): each
+    host records the resource shape it registered with, so autoscaler
+    tests can drive PG demand -> slice scale-up without GKE."""
+
+    def __init__(self):
+        self.hosts_by_pool: Dict[str, List[Dict[str, Any]]] = {}
+
+    def create_hosts(self, pool, host_resources):
+        hosts = [{"host_id": f"{pool}-host{i}",
+                  "node_id_hex": uuid.uuid4().hex,
+                  "resources": dict(res)}
+                 for i, res in enumerate(host_resources)]
+        self.hosts_by_pool[pool] = hosts
+        return hosts
+
+    def delete_hosts(self, pool):
+        self.hosts_by_pool.pop(pool, None)
+
+
+class GKESliceBackend(SliceBackend):
+    """gcloud node-pool backend: one pool = one TPU slice; GKE boots
+    the hosts, which join the cluster out of band (their node ids
+    appear in the GCS once `ray start` runs on them)."""
+
+    def __init__(self, cluster: str, zone: str, machine_type: str,
+                 topology_for):
         self.cluster = cluster
         self.zone = zone
-        self.accelerator_type = accelerator_type
-        self.node_pool_prefix = node_pool_prefix
-        self._nodes: Dict[str, ProviderNode] = {}
+        self.machine_type = machine_type
+        self._topology_for = topology_for
 
     def _run(self, args: List[str]) -> str:
         proc = subprocess.run(["gcloud", *args], capture_output=True,
@@ -137,30 +162,108 @@ class GKETPUNodeProvider(NodeProvider):
                 f"{proc.stderr[-500:]}")
         return proc.stdout
 
-    def create_node(self, resources: Dict[str, float]) -> ProviderNode:
-        pool = f"{self.node_pool_prefix}-{uuid.uuid4().hex[:6]}"
-        chips = int(resources.get("TPU", 4))
+    def create_hosts(self, pool, host_resources):
+        chips = int(sum(r.get("TPU", 0) for r in host_resources))
         self._run([
             "container", "node-pools", "create", pool,
             f"--cluster={self.cluster}", f"--zone={self.zone}",
-            "--num-nodes=1", "--machine-type=ct5p-hightpu-4t",
+            f"--num-nodes={len(host_resources)}",
+            f"--machine-type={self.machine_type}",
             f"--tpu-topology={self._topology_for(chips)}",
         ])
-        node = ProviderNode(provider_id=pool, handle={"pool": pool})
+        return [{"host_id": f"{pool}-host{i}", "node_id_hex": None,
+                 "resources": dict(res)}
+                for i, res in enumerate(host_resources)]
+
+    def delete_hosts(self, pool):
+        self._run([
+            "container", "node-pools", "delete", pool,
+            f"--cluster={self.cluster}", f"--zone={self.zone}",
+            "--quiet"])
+
+
+class GKETPUNodeProvider(NodeProvider):
+    """GKE TPU node-pool provider: one provider "node" = one TPU pod
+    SLICE (a node pool with `tpu-topology`), materialized as one host
+    per TPU VM. Follows the reference provider contract
+    (node_provider.py) + the TPU accelerator manager's pod-slice
+    resource naming (accelerators/tpu.py:335-398): every host carries
+    {"TPU": <chips/host>, "<pool>": 1}, and host 0 additionally
+    carries {"TPU-<type>-head": 1} so a gang's head actor (the jax
+    coordinator) lands exactly once per slice.
+
+    `backend` is the host-materialization seam: GKESliceBackend runs
+    gcloud (production); FakeSliceBackend materializes instant hosts
+    (the fake-multinode test ladder, reference
+    batching_node_provider.py:54 pattern).
+    """
+
+    CHIPS_PER_HOST = 4  # v5p TPU-VM hosts
+
+    def __init__(self, cluster: str = "", zone: str = "",
+                 accelerator_type: str = "v5p-8",
+                 node_pool_prefix: str = "ray-tpu",
+                 backend: Optional[SliceBackend] = None):
+        self.cluster = cluster
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.node_pool_prefix = node_pool_prefix
+        self.backend = backend or GKESliceBackend(
+            cluster, zone, "ct5p-hightpu-4t", self._topology_for)
+        self._nodes: Dict[str, ProviderNode] = {}
+
+    @property
+    def slice_chips(self) -> int:
+        # accelerator_type "v5p-16" -> 16 chip-cores -> 8 chips... the
+        # accelerator manager's convention (accelerators/tpu.py): the
+        # suffix is the core count, chips = cores / 2 for v5p; for the
+        # provider we treat the suffix as the CHIP count directly, as
+        # the fake-chip ladder does.
+        try:
+            return int(self.accelerator_type.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return self.CHIPS_PER_HOST
+
+    def _host_resources(self, pool: str) -> List[Dict[str, float]]:
+        n_hosts = max(1, self.slice_chips // self.CHIPS_PER_HOST)
+        out = []
+        for i in range(n_hosts):
+            res: Dict[str, float] = {
+                "TPU": float(min(self.CHIPS_PER_HOST, self.slice_chips)),
+                pool: 1.0,
+            }
+            if i == 0:
+                res[f"TPU-{self.accelerator_type}-head"] = 1.0
+            out.append(res)
+        return out
+
+    def create_node(self, resources: Dict[str, float]) -> ProviderNode:
+        pool = f"{self.node_pool_prefix}-{uuid.uuid4().hex[:6]}"
+        hosts = self.backend.create_hosts(pool,
+                                          self._host_resources(pool))
+        node = ProviderNode(
+            provider_id=pool,
+            node_id_hex=hosts[0].get("node_id_hex"),
+            handle={"pool": pool, "hosts": hosts})
         self._nodes[pool] = node
         return node
 
     @staticmethod
     def _topology_for(chips: int) -> str:
-        # v5p topologies: 4 chips per host; 2x2x1 = one host
+        # v5p topologies: 4 chips per host; topology and --num-nodes
+        # derive from the same chip count — reject sizes we can't spell
+        # rather than emitting an inconsistent pool spec
         hosts = max(1, chips // 4)
-        return {1: "2x2x1", 2: "2x2x2", 4: "2x2x4"}.get(hosts, "2x2x1")
+        topo = {1: "2x2x1", 2: "2x2x2", 4: "2x2x4", 8: "2x4x4",
+                16: "4x4x4"}.get(hosts)
+        if topo is None:
+            raise ValueError(
+                f"unsupported v5p slice size: {chips} chips "
+                f"({hosts} hosts); supported hosts: 1,2,4,8,16")
+        return topo
 
     def terminate_node(self, node: ProviderNode) -> None:
-        self._run([
-            "container", "node-pools", "delete", node.provider_id,
-            f"--cluster={self.cluster}", f"--zone={self.zone}",
-            "--quiet"])
+        self.backend.delete_hosts(node.provider_id)
         self._nodes.pop(node.provider_id, None)
 
     def non_terminated_nodes(self) -> List[ProviderNode]:

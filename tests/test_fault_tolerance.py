@@ -136,7 +136,9 @@ def test_actor_manager_probe_restores_restarted_actor(ray_start):
     os.kill(pid, signal.SIGKILL)
     mgr.set_actor_state(0, False)  # as if a call failed during the window
     assert mgr.num_healthy_actors() == 0
-    deadline = time.time() + 60
+    # 120s deadline: the restart's creation push can sit behind a full
+    # worker-spawn queue on a loaded 1-core CI box (r4 verdict flake)
+    deadline = time.time() + 120
     restored = []
     while not restored and time.time() < deadline:
         restored = mgr.probe_unhealthy_actors(timeout_seconds=5)
