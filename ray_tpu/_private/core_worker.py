@@ -73,7 +73,11 @@ class _SchedKeyState:
 
     queue: "collections.deque" = field(
         default_factory=collections.deque)
-    request_in_flight: bool = False
+    # outstanding lease requests (reference pipelines lease requests
+    # against backlog — one request per queued task up to a cap — so a
+    # burst fans out over workers instead of serializing onto the first
+    # lease)
+    requests_in_flight: int = 0
     # lease_id -> (worker_address, nm_address, node_id_hex)
     leases: Dict[str, Tuple] = field(default_factory=dict)
     # lease_id -> tasks pushed but not yet completed (pipeline depth)
@@ -144,6 +148,14 @@ class CoreWorker:
         # One long-lived drainer for borrow releases instead of a thread
         # per dropped ref (releases are fire-and-forget, order irrelevant).
         self._borrow_release_queue: "queue.Queue" = queue.Queue()
+        # enclosing-result oid hex -> [(owner_addr, nested oid hex)]
+        # eager borrows on refs embedded in task results (see
+        # _register_nested_borrows)
+        self._nested_borrows: Dict[str, List[Tuple]] = {}
+        # (deadline, local hexes, remote (addr, hex)) transit pins on
+        # refs embedded in results this EXECUTOR shipped (see
+        # pin_refs_with_ttl); expired by the borrow-release loop
+        self._ttl_pins: List[Tuple] = []
         self.tasks: Dict[str, _TaskEntry] = {}
         self.actors: Dict[str, _ActorState] = {}
         self._sched_keys: Dict[bytes, _SchedKeyState] = {}
@@ -305,6 +317,49 @@ class CoreWorker:
             except Exception:  # noqa: BLE001
                 pass
         self.objects[oid_hex] = (FREED,)
+        # release eager borrows on refs nested inside this result (see
+        # _register_nested_borrows): remote owners via the async release
+        # queue; locally-owned nested objects unpin (and may free) here
+        nested = self._nested_borrows.pop(oid_hex, None)
+        if nested:
+            for owner_addr, ref_hex in nested:
+                if owner_addr == self.address:
+                    n = self.arg_pins.get(ref_hex, 0) - 1
+                    if n <= 0:
+                        self.arg_pins.pop(ref_hex, None)
+                        if self.local_refs.get(ref_hex, 0) == 0:
+                            self._maybe_free_locked(ref_hex)
+                    else:
+                        self.arg_pins[ref_hex] = n
+                else:
+                    self._borrow_release_queue.put((owner_addr, ref_hex))
+
+    def _register_nested_borrows(self, outer_hex: str,
+                                 nested_refs: List[Tuple]) -> None:
+        """Eagerly borrow refs embedded in a task result, keyed to the
+        enclosing result object: kept exactly as long as the result
+        itself, independent of when (or whether) this process
+        deserializes it. Deserialization's own add_local_ref stacks a
+        second, independently-released count on the same owner pins."""
+        recorded = []
+        for oid, owner_addr in nested_refs:
+            addr = tuple(owner_addr)
+            if addr == self.address:
+                with self._lock:
+                    self.arg_pins[oid.hex()] = \
+                        self.arg_pins.get(oid.hex(), 0) + 1
+            else:
+                try:
+                    self._pool.get(addr).call(
+                        "cw_add_ref", oid_hex=oid.hex(),
+                        borrower=self.address)
+                except Exception:  # noqa: BLE001 — owner gone; the get
+                    continue      # will surface the loss
+            recorded.append((addr, oid.hex()))
+        if recorded:
+            with self._lock:
+                self._nested_borrows.setdefault(outer_hex,
+                                                []).extend(recorded)
 
     def add_done_callback(self, ref: ObjectRef, cb: Any) -> None:
         """Invoke cb() once when the owned object is no longer pending.
@@ -338,6 +393,10 @@ class CoreWorker:
     def _borrow_release_loop(self) -> None:
         while not self._shutdown:
             try:
+                self._expire_ttl_pins()
+            except Exception:  # noqa: BLE001
+                logger.exception("ttl pin expiry failed")
+            try:
                 item = self._borrow_release_queue.get(timeout=10.0)
             except queue.Empty:
                 # Idle: sweep for borrowers that died without releasing.
@@ -355,6 +414,54 @@ class CoreWorker:
                                                 borrower=self.address)
             except Exception:  # noqa: BLE001 - owner gone; nothing to free
                 pass
+
+    def pin_refs_with_ttl(self, refs: List[Any],
+                          ttl_s: float = 30.0) -> None:
+        """Keep objects alive across a result/report hand-off window:
+        pin now (locally for objects we own, one-way borrower-pin at the
+        remote owner otherwise), release after ttl_s — by then the
+        consumer has registered its eager nested borrow. Expiry rides
+        the borrow-release loop (≤10s granularity) rather than one
+        timer thread per result."""
+        local: List[str] = []
+        remote_keys: List[Tuple] = []
+        for ref in refs:
+            if self._is_own(ref):
+                local.append(ref.hex())
+            else:
+                remote_keys.append((tuple(ref.owner_address), ref.hex()))
+        with self._lock:
+            for h in local:
+                self.arg_pins[h] = self.arg_pins.get(h, 0) + 1
+        for addr, h in remote_keys:
+            try:
+                self._pool.get(addr).send_oneway(
+                    "cw_add_ref", oid_hex=h, borrower=self.address)
+            except Exception:  # noqa: BLE001 — owner gone
+                pass
+        with self._lock:
+            self._ttl_pins.append(
+                (time.time() + ttl_s, local, remote_keys))
+
+    def _expire_ttl_pins(self) -> None:
+        now = time.time()
+        with self._lock:
+            due = [p for p in self._ttl_pins if p[0] <= now]
+            if not due:
+                return
+            self._ttl_pins = [p for p in self._ttl_pins if p[0] > now]
+            for _, local, _ in due:
+                for h in local:
+                    n = self.arg_pins.get(h, 0) - 1
+                    if n <= 0:
+                        self.arg_pins.pop(h, None)
+                        if self.local_refs.get(h, 0) == 0:
+                            self._maybe_free_locked(h)
+                    else:
+                        self.arg_pins[h] = n
+        for _, _, remote_keys in due:
+            for addr, h in remote_keys:
+                self._borrow_release_queue.put((addr, h))
 
     def _pin_args(self, refs: List[ObjectID]) -> None:
         with self._lock:
@@ -745,11 +852,36 @@ class CoreWorker:
                 # the duplicate would execute concurrently
                 ks.queue.append(task_hex)
                 entry.in_key_queue = True
-            need_request = not ks.request_in_flight
-            if need_request:
-                ks.request_in_flight = True
-        if need_request:
+        self._maybe_request_leases(key, nm=nm)
+
+    # Cap on outstanding lease requests per scheduling key (reference
+    # direct_task_transport max_pending_lease_requests): enough to fan a
+    # burst out over several workers, bounded so one key can't flood the
+    # NM queue.
+    MAX_PENDING_LEASE_REQUESTS = 4
+
+    def _maybe_request_leases(self, key, nm=None) -> None:
+        """Issue lease requests until outstanding requests cover the
+        backlog (one per queued task, capped): parallelism comes from
+        multiple leases, latency from per-lease pipelining."""
+        while True:
+            with self._lock:
+                ks = self._sched_keys.get(key)
+                if ks is None:
+                    return
+                desired = min(len(ks.queue),
+                              self.MAX_PENDING_LEASE_REQUESTS)
+                if ks.requests_in_flight >= desired:
+                    return
+                ks.requests_in_flight += 1
             self._request_lease_for_key(key, nm=nm)
+            nm = None
+
+    def _release_request_slot(self, key) -> None:
+        with self._lock:
+            ks = self._sched_keys.get(key)
+            if ks is not None and ks.requests_in_flight > 0:
+                ks.requests_in_flight -= 1
 
     def _locality_info(self, arg_ids: List[ObjectID]):
         """(node id hex -> resident arg bytes, oid -> (store, size)) from
@@ -802,8 +934,8 @@ class CoreWorker:
 
     def _key_head(self, key: bytes):
         """(task_hex, entry) of the first live queued task of the key,
-        without popping; clears request_in_flight and returns None when
-        the queue has no live work."""
+        without popping; releases the caller's request slot and returns
+        None when the queue has no live work."""
         with self._lock:
             ks = self._sched_keys.get(key)
             if ks is None:
@@ -816,15 +948,18 @@ class CoreWorker:
                 ks.queue.popleft()
                 if entry is not None:
                     entry.in_key_queue = False
-            ks.request_in_flight = False
+            if ks.requests_in_flight > 0:
+                ks.requests_in_flight -= 1
             return None
 
     def _request_lease_for_key(self, key: bytes, nm=None) -> None:
         """Lease a worker for the key's queue head; follow spillback
         redirects (reference direct_task_transport.cc:349,505). Called
-        with request_in_flight already claimed by the caller. Iterates
-        (not recurses) over queue heads so a long run of infeasible
-        tasks fails them one by one without growing the stack."""
+        with ONE request slot already claimed by the caller; every exit
+        either leaves the request queued at an NM (the grant releases
+        the slot) or releases it here. Iterates (not recurses) over
+        queue heads so a long run of infeasible tasks fails them one by
+        one without growing the stack."""
         while True:
             head = self._key_head(key)
             if head is None:
@@ -861,10 +996,7 @@ class CoreWorker:
                         nm_cur = self._nm
                         attempt = 0
                         continue
-                    with self._lock:
-                        ks = self._sched_keys.get(key)
-                        if ks is not None:
-                            ks.request_in_flight = False
+                    self._release_request_slot(key)
                     self._fail_task(task_hex, "SCHEDULING_FAILED",
                                     f"lease request failed: {e}",
                                     retry=True)
@@ -891,14 +1023,8 @@ class CoreWorker:
             # loop: the rest of the queue gets its own verdict
 
     def _kick_key(self, key: bytes) -> None:
-        """Ensure a lease request is in flight while the key has queued
-        work."""
-        with self._lock:
-            ks = self._sched_keys.get(key)
-            if ks is None or ks.request_in_flight or not ks.queue:
-                return
-            ks.request_in_flight = True
-        self._request_lease_for_key(key)
+        """Ensure lease requests cover the key's queued work."""
+        self._maybe_request_leases(key)
 
     def _on_lease_granted(self, lease_id: str, task_id: TaskID,
                           worker_address: Tuple[str, int],
@@ -914,7 +1040,8 @@ class CoreWorker:
             return
         with self._lock:
             ks = self._sched_keys.setdefault(key, _SchedKeyState())
-            ks.request_in_flight = False
+            if ks.requests_in_flight > 0:
+                ks.requests_in_flight -= 1
             ks.leases[lease_id] = (tuple(worker_address),
                                    tuple(nm_address) if nm_address
                                    else None, node_id)
@@ -1061,7 +1188,8 @@ class CoreWorker:
     def _on_task_done(self, task_id: TaskID, results: List[Tuple],
                       lease_id: Optional[str] = None,
                       dynamic_children: Optional[List[Tuple]] = None,
-                      worker_exiting: bool = False) -> None:
+                      worker_exiting: bool = False,
+                      nested_refs: Optional[List[Tuple]] = None) -> None:
         h = task_id.hex()
         with self._lock:
             entry = self.tasks.get(h)
@@ -1111,6 +1239,14 @@ class CoreWorker:
             if lease_id is not None:
                 self._settle_lease_slot(entry, lease_id, worker_exiting)
             return
+        if nested_refs and entry.return_ids:
+            # ObjectRefs embedded in the result: register borrows with
+            # their owners NOW (the producing worker's pins are about to
+            # lapse); released when the ENCLOSING return object frees
+            # (reference ReferenceCounter contained-ref accounting).
+            for oid, per in zip(entry.return_ids, nested_refs):
+                if per:
+                    self._register_nested_borrows(oid.hex(), per)
         for oid, loc in zip(entry.return_ids, results):
             with self._lock:
                 # keep location unless already freed
@@ -1582,14 +1718,15 @@ class CoreWorker:
                     and (e.node_id_hex == dead_hex
                          or (e.lease_node is not None
                              and e.lease_node == dead_nm))]
-            # A lease request "queued" at the dead NM never gets its
-            # grant: clear the in-flight flag so the key's queue can
-            # re-request at a live NM instead of stalling forever.
+            # Lease requests "queued" at the dead NM never get their
+            # grants: reset the slot count so the key's queue can
+            # re-request at a live NM instead of stalling forever
+            # (over-counting self-heals — surplus grants with an empty
+            # queue hand their lease straight back).
             for e in lost:
                 ks = self._sched_keys.get(e.sched_key)
-                if ks is not None and ks.request_in_flight and \
-                        e.lease_node == dead_nm:
-                    ks.request_in_flight = False
+                if ks is not None and e.lease_node == dead_nm:
+                    ks.requests_in_flight = 0
                     if ks.queue:
                         kick_keys.add(e.sched_key)
         for e in lost:
@@ -1917,14 +2054,39 @@ class _Executor:
                         spec.function_name, traceback.format_exc(), e),
                     worker_exiting=will_exit)
                 return
+            from ray_tpu._private.object_ref import collect_serialized_refs
+            all_collected: List[Any] = []
+            per_return: List[Optional[List[Tuple]]] = []
             for i, v in enumerate(values):
                 oid = ObjectID.for_task_return(spec.task_id, i + 1)
-                results.append(cw.store_blob(oid.hex(), ser.pack(v)))
+                collected: List[Any] = []
+                with collect_serialized_refs(collected):
+                    blob = ser.pack(v)
+                results.append(cw.store_blob(oid.hex(), blob))
+                # PER RETURN: borrows must key to the return value that
+                # actually embeds the ref (freeing return 0 must not
+                # release refs held only by return 1)
+                per_return.append(
+                    [(r.id, tuple(r.owner_address)
+                      if r.owner_address else cw.address)
+                     for r in collected] or None)
+                all_collected.extend(collected)
+            nested = None
+            if all_collected:
+                # ObjectRefs embedded in RESULTS: their descriptors ride
+                # the done report so the task's owner registers borrows
+                # EAGERLY (released when it frees the enclosing result)
+                # — reference ReferenceCounter "contained refs". A short
+                # TTL pin bridges the report's transit, since our python
+                # refs die right after this frame.
+                nested = per_return
+                cw.pin_refs_with_ttl(all_collected, ttl_s=30.0)
             # recycling decision rides the report so the owner retires
             # this worker's lease (reuse=False) atomically — a
             # post-report exit would race new leases onto a dying process
             will_exit = decide_exit()
-            self._report_done(spec, results, worker_exiting=will_exit)
+            self._report_done(spec, results, worker_exiting=will_exit,
+                              nested_refs=nested)
         finally:
             cw.task_events.record(spec.task_id.hex(), ts_exec_end=_ev_now())
             cw.set_current_task(None)
@@ -2002,7 +2164,8 @@ class _Executor:
 
     def _report_done(self, spec: TaskSpec, results: List[Tuple],
                      dynamic_children: Optional[List[Tuple]] = None,
-                     worker_exiting: bool = False) -> None:
+                     worker_exiting: bool = False,
+                     nested_refs: Optional[List[Tuple]] = None) -> None:
         lease_id = getattr(spec, "_lease_id", None)
         try:
             if worker_exiting:
@@ -2014,7 +2177,7 @@ class _Executor:
                     "cw_task_done", task_id=spec.task_id,
                     results=results, lease_id=lease_id,
                     dynamic_children=dynamic_children,
-                    worker_exiting=True)
+                    worker_exiting=True, nested_refs=nested_refs)
                 return
             # one-way: the worker moves on to its next task without
             # waiting out the owner's bookkeeping round trip (send
@@ -2023,7 +2186,7 @@ class _Executor:
             self.cw._pool.get(spec.owner_address).send_oneway(
                 "cw_task_done", task_id=spec.task_id, results=results,
                 lease_id=lease_id, dynamic_children=dynamic_children,
-                worker_exiting=worker_exiting)
+                worker_exiting=worker_exiting, nested_refs=nested_refs)
         except Exception:  # noqa: BLE001
             logger.warning("owner %s unreachable for task result",
                            spec.owner_address)

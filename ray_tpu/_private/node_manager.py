@@ -364,20 +364,28 @@ class NodeManager:
         caching): a worker started for one env must not serve tasks whose
         env_vars/working_dir/py_modules differ."""
         renv = spec.runtime_env or {}
-        from ray_tpu._private.runtime_env import pip_spec, pip_uri
+        from ray_tpu._private.runtime_env import (conda_spec, conda_uri,
+                                                  container_spec,
+                                                  pip_spec, pip_uri)
         pspec = pip_spec(renv)
+        cspec = conda_spec(renv)
+        ctr = container_spec(renv)
         return repr((sorted((renv.get("env_vars") or {}).items()),
                      renv.get("working_dir"),
                      tuple(renv.get("py_modules") or ()),
-                     pip_uri(pspec) if pspec else None))
+                     pip_uri(pspec) if pspec else None,
+                     conda_uri(cspec) if cspec else None,
+                     (ctr["image"], tuple(ctr["run_options"]))
+                     if ctr else None))
 
     def _spawn_worker(self, runtime_env_key: str,
                       runtime_env: Optional[Dict[str, Any]]
                       ) -> Optional[_WorkerHandle]:
-        if (runtime_env or {}).get("pip"):
-            # env setup can take minutes (pip install): run the whole
-            # spawn on a setup thread so the dispatch path (and the
-            # lease-request RPC behind it) never blocks on it — the
+        if (runtime_env or {}).get("pip") or \
+                (runtime_env or {}).get("conda"):
+            # env setup can take minutes (pip/conda install): run the
+            # whole spawn on a setup thread so the dispatch path (and
+            # the lease-request RPC behind it) never blocks on it — the
             # reference keeps env setup in an async per-node agent for
             # the same reason (runtime_env_agent).
             threading.Thread(
@@ -432,6 +440,24 @@ class NodeManager:
                 return None
             if site:
                 extra_paths.append(site)
+        python_exe = sys.executable
+        if renv.get("conda"):
+            # conda env (reference runtime_env/conda.py): the worker
+            # runs with the materialized prefix's interpreter
+            try:
+                prefix = self._runtime_env_mgr.setup_conda(renv)
+            except Exception as e:  # noqa: BLE001
+                logger.error("runtime_env conda setup failed for %s: %s",
+                             runtime_env_key, e)
+                self._fail_env_leases(runtime_env_key, str(e))
+                return None
+            if prefix:
+                env["CONDA_PREFIX"] = prefix
+                env["PATH"] = (os.path.join(prefix, "bin") + os.pathsep
+                               + env.get("PATH", ""))
+                cand = os.path.join(prefix, "bin", "python")
+                if os.path.exists(cand):
+                    python_exe = cand
         if extra_paths:
             env["PYTHONPATH"] = os.pathsep.join(
                 extra_paths + [env.get("PYTHONPATH", "")])
@@ -439,9 +465,19 @@ class NodeManager:
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"),
                    "ab")
+        cmd = [python_exe, "-m", "ray_tpu._private.worker_main"]
+        if renv.get("container"):
+            # container env (reference runtime_env/container.py): the
+            # worker command runs inside the image via the wrap hook
+            try:
+                cmd = self._runtime_env_mgr.wrap_container(renv, cmd,
+                                                           env=env)
+            except Exception as e:  # noqa: BLE001
+                logger.error("runtime_env container wrap failed: %s", e)
+                self._fail_env_leases(runtime_env_key, str(e))
+                return None
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, stdout=out, stderr=subprocess.STDOUT,
+            cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
             cwd=(runtime_env or {}).get("working_dir") or None)
         handle = _WorkerHandle(worker_id=worker_id, proc=proc,
                                runtime_env_key=runtime_env_key)

@@ -55,6 +55,111 @@ def pip_uri(spec: Dict[str, Any]) -> str:
     return f"pip-{py}-{hashlib.sha1(blob).hexdigest()[:20]}"
 
 
+# ---------------------------------------------------------------------------
+# conda plugin (reference _private/runtime_env/conda.py): the env
+# materializes ONCE per node into the URI cache; workers of that env run
+# with <prefix>/bin/python and CONDA_PREFIX set. The create command is a
+# module-level hook so chip-/binary-free CI can fake materialization
+# (this box has no conda); production uses `conda env create --prefix`.
+# ---------------------------------------------------------------------------
+
+
+_CONDA_KEYS = {"name", "dependencies", "channels"}
+
+
+def conda_spec(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Normalize the conda field: env NAME (str) or an environment.yml-
+    style dict -> canonical dict. Unknown dict keys fail fast — a typo
+    like {'deps': [...]} must not materialize an empty environment."""
+    conda = (renv or {}).get("conda")
+    if conda is None:
+        return None
+    if isinstance(conda, str):
+        return {"name": conda, "dependencies": None, "channels": None}
+    if isinstance(conda, dict):
+        bad = set(conda) - _CONDA_KEYS
+        if bad:
+            raise ValueError(
+                f"unknown runtime_env conda key(s) {sorted(bad)}; "
+                f"supported: {sorted(_CONDA_KEYS)}")
+        return {"name": conda.get("name"),
+                "dependencies": conda.get("dependencies"),
+                "channels": conda.get("channels")}
+    raise ValueError(
+        f"runtime_env conda must be an env name or dict, got {conda!r}")
+
+
+def conda_uri(spec: Dict[str, Any]) -> str:
+    blob = json.dumps(spec, sort_keys=True).encode()
+    return f"conda-{hashlib.sha1(blob).hexdigest()[:20]}"
+
+
+def _default_conda_create(target: str, spec: Dict[str, Any]) -> None:
+    """Materialize a conda prefix at `target` (production path)."""
+    if spec.get("dependencies") is None and spec.get("name"):
+        cmd = ["conda", "create", "--yes", "--prefix", target,
+               "--clone", spec["name"]]
+    else:
+        env_yaml = os.path.join(os.path.dirname(target),
+                                os.path.basename(target) + ".yml")
+        body = {"dependencies": spec.get("dependencies") or []}
+        if spec.get("channels"):
+            body["channels"] = spec["channels"]
+        with open(env_yaml, "w", encoding="utf-8") as f:
+            json.dump(body, f)
+        cmd = ["conda", "env", "create", "--prefix", target,
+               "--file", env_yaml]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"conda env create failed: {proc.stderr[-2000:]}")
+
+
+# test seam (reference: the runtime-env agent's conda handler is mocked
+# the same way in its unit tests)
+CONDA_CREATE_HOOK = _default_conda_create
+
+
+def container_spec(renv: Optional[Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    """Normalize the container field (reference container.py):
+    {"image": ..., "run_options": [...]}; image is required."""
+    container = (renv or {}).get("container")
+    if container is None:
+        return None
+    if not isinstance(container, dict) or not container.get("image"):
+        raise ValueError(
+            "runtime_env container must be a dict with an 'image' key, "
+            f"got {container!r}")
+    return {"image": str(container["image"]),
+            "run_options": [str(o) for o in
+                            container.get("run_options") or ()]}
+
+
+def _default_container_wrap(cmd: List[str], image: str,
+                            run_options: List[str],
+                            env: Optional[Dict[str, str]] = None
+                            ) -> List[str]:
+    """Wrap a worker command in a container runtime invocation
+    (production path; host networking so the worker's RPC server is
+    reachable, repo mounted for the package, the worker's RAY_TPU_* /
+    PYTHONPATH / env_vars forwarded — Popen's env only reaches the
+    docker CLIENT, not the container)."""
+    import ray_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+    env_flags: List[str] = []
+    for k, v in (env or {}).items():
+        env_flags += ["--env", f"{k}={v}"]
+    return (["docker", "run", "--rm", "--network=host",
+             f"--volume={pkg_root}:{pkg_root}:ro", *env_flags,
+             *run_options, image] + cmd)
+
+
+CONTAINER_WRAP_HOOK = _default_container_wrap
+
+
 class RuntimeEnvManager:
     """Per-node plugin resolver with a content-addressed install cache."""
 
@@ -107,6 +212,64 @@ class RuntimeEnvManager:
                     f"({spec['packages']}): {proc.stderr[-2000:]}")
             self._touch(marker)
             return target
+
+    def setup_conda(self, renv: Optional[Dict[str, Any]]
+                    ) -> Optional[str]:
+        """Ensure the env's conda prefix exists in the cache; returns
+        the prefix path (None if no conda field). Same URI-cache
+        contract as setup_pip: one create per spec per node, `.ready`
+        marker, failure memo. The worker then runs with
+        <prefix>/bin/python when present (module hook materializes —
+        fake in chip-free CI, `conda env create` in production)."""
+        spec = conda_spec(renv)
+        if spec is None:
+            return None
+        uri = conda_uri(spec)
+        target = os.path.join(self.cache_dir, uri)
+        marker = os.path.join(target, ".ready")
+        with self._lock_for(uri):
+            prior = self._failed.get(uri)
+            if prior is not None:
+                raise RuntimeError(
+                    f"runtime_env conda create previously failed for "
+                    f"{spec}: {prior}")
+            if os.path.exists(marker):
+                self._touch(marker)
+                return target
+            os.makedirs(target, exist_ok=True)
+            logger.info("runtime_env conda create (%s)", uri)
+            try:
+                CONDA_CREATE_HOOK(target, spec)
+            except Exception as e:  # noqa: BLE001
+                self._failed[uri] = str(e)[-500:]
+                raise RuntimeError(
+                    f"runtime_env conda create failed ({spec}): {e}")
+            self._touch(marker)
+            return target
+
+    @staticmethod
+    def wrap_container(renv: Optional[Dict[str, Any]],
+                       cmd: List[str],
+                       env: Optional[Dict[str, str]] = None
+                       ) -> List[str]:
+        """Wrap a worker command per the env's container field (no-op
+        without one). `env` is the spawn environment; the wrap forwards
+        the worker-contract subset (RAY_TPU_*, PYTHONPATH) plus the
+        env's declared env_vars into the container."""
+        spec = container_spec(renv)
+        if spec is None:
+            return cmd
+        # forward the worker contract + the env's declared env_vars —
+        # NOT the whole host environment
+        src = env or {}
+        fwd = {k: v for k, v in src.items()
+               if k.startswith("RAY_TPU_") or k in ("PYTHONPATH",
+                                                    "CONDA_PREFIX")}
+        for k in (renv or {}).get("env_vars") or {}:
+            if str(k) in src:
+                fwd[str(k)] = src[str(k)]
+        return CONTAINER_WRAP_HOOK(list(cmd), spec["image"],
+                                   spec["run_options"], fwd)
 
     @staticmethod
     def _touch(marker: str) -> None:

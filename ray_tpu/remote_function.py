@@ -27,27 +27,27 @@ _TASK_OPTIONS = {
 }
 
 
-_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
+_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "py_modules", "pip",
+                     "conda", "container"}
 
 
 def validate_runtime_env(renv):
-    """Reject runtime_env fields this runtime doesn't implement
-    (reference supports conda/container via a per-node agent) —
+    """Reject runtime_env fields this runtime doesn't implement —
     accepting and silently ignoring them would be worse than failing
-    fast. pip IS implemented (cached per-env installs,
-    _private/runtime_env.py; reference _private/runtime_env/pip.py)."""
+    fast — and validate the implemented ones' specs at submission time
+    (reference _private/runtime_env/{pip,conda,container}.py)."""
     if renv is None:
         return None
     bad = set(renv) - _RUNTIME_ENV_KEYS
     if bad:
         raise ValueError(
             f"unsupported runtime_env field(s) {sorted(bad)}; this "
-            f"runtime implements {sorted(_RUNTIME_ENV_KEYS)} "
-            f"(conda/container need containerization, which is not "
-            f"available)")
-    if "pip" in renv:
-        from ray_tpu._private.runtime_env import pip_spec
-        pip_spec(renv)  # raises on malformed specs at submission time
+            f"runtime implements {sorted(_RUNTIME_ENV_KEYS)}")
+    from ray_tpu._private.runtime_env import (conda_spec, container_spec,
+                                              pip_spec)
+    pip_spec(renv)        # each raises on malformed specs at
+    conda_spec(renv)      # submission time, not at worker spawn
+    container_spec(renv)
     return renv
 
 

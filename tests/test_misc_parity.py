@@ -231,9 +231,9 @@ assert sum(ray_tpu.get(inner)) == 499500
 # release the ref: now everything reaps to zero
 del inner
 gc.collect()
-deadline = time.time() + 30
+deadline = time.time() + 90  # generous: reap cycles crawl when the
 while time.time() < deadline and len(state_api.list_workers()) > 0:
-    time.sleep(0.5)
+    time.sleep(0.5)          # full suite loads the 1-core CI box
 assert len(state_api.list_workers()) == 0, state_api.list_workers()
 # pool refills on demand after reaping
 assert ray_tpu.get(f.remote()) == 1

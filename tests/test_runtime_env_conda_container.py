@@ -1,0 +1,147 @@
+"""runtime_env conda + container plugins (fake-backed).
+
+reference parity: python/ray/_private/runtime_env/conda.py:1 (cached
+conda env creation; worker runs with the env's interpreter) and
+container.py:1 (worker wrapped in a container runtime command). This
+environment has no conda/docker binaries, so the create/wrap hooks are
+injected fakes — the honest scope per the r4 verdict: plugin + URI
+cache + spawn-path integration, with the real commands behind the same
+hooks.
+"""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import runtime_env as renv_mod
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_start):
+    """Shared session cluster."""
+
+
+@pytest.fixture()
+def fake_conda(monkeypatch):
+    """Conda create hook that materializes a prefix whose bin/python is
+    this interpreter (so spawned workers actually run)."""
+    import glob
+    import shutil
+    # the URI cache persists across runs (~/.cache): drop stale conda
+    # prefixes so this run's hook materializes fresh ones
+    for d in glob.glob(os.path.expanduser(
+            "~/.cache/ray_tpu/runtime_env/conda-*")):
+        shutil.rmtree(d, ignore_errors=True)
+    created = []
+
+    def create(target, spec):
+        bindir = os.path.join(target, "bin")
+        os.makedirs(bindir, exist_ok=True)
+        link = os.path.join(bindir, "python")
+        if not os.path.exists(link):
+            # exec wrapper, not a symlink: a symlinked interpreter
+            # resolves sys.prefix from the link's location and loses
+            # the venv's site-packages
+            with open(link, "w") as f:
+                f.write(f"#!/bin/sh\nexec {sys.executable} \"$@\"\n")
+            os.chmod(link, 0o755)
+        created.append(dict(spec))
+
+    monkeypatch.setattr(renv_mod, "CONDA_CREATE_HOOK", create)
+    return created
+
+
+@pytest.fixture()
+def fake_container(monkeypatch):
+    """Container wrap hook that records the requested image and returns
+    the command unwrapped (no docker here)."""
+    wrapped = []
+
+    def wrap(cmd, image, run_options, env=None):
+        wrapped.append({"image": image, "run_options": list(run_options),
+                        "cmd": list(cmd), "env": dict(env or {})})
+        return list(cmd)
+
+    monkeypatch.setattr(renv_mod, "CONTAINER_WRAP_HOOK", wrap)
+    return wrapped
+
+
+def test_conda_env_worker_runs_with_env_prefix(fake_conda):
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["pip"]}})
+    def probe():
+        import os
+        return (os.environ.get("CONDA_PREFIX", ""),
+                os.environ.get("PATH", ""))
+
+    prefix, path = ray_tpu.get(probe.remote(), timeout=120)
+    assert prefix and os.path.isdir(prefix)
+    # the worker was launched through <prefix>/bin/python (an exec
+    # wrapper in the fake, the conda interpreter in production) and
+    # the prefix's bin leads its PATH
+    assert path.startswith(os.path.join(prefix, "bin"))
+    assert os.path.exists(os.path.join(prefix, "bin", "python"))
+    assert fake_conda, "create hook never ran"
+
+
+def test_conda_cache_reuses_prefix(fake_conda):
+    # distinct spec from the other test: its pooled worker must not be
+    # reused here (this test counts creates for ITS env)
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["numpy"]}})
+    def probe():
+        import os
+        return os.environ.get("CONDA_PREFIX", "")
+
+    p1 = ray_tpu.get(probe.remote(), timeout=120)
+    p2 = ray_tpu.get(probe.remote(), timeout=120)
+    assert p1 == p2
+    # same spec -> ONE create (URI cache hit; .ready marker)
+    assert len(fake_conda) == 1
+
+
+def test_conda_named_env_and_dict_get_distinct_uris():
+    a = renv_mod.conda_uri(renv_mod.conda_spec(
+        {"conda": {"dependencies": ["numpy"]}}))
+    b = renv_mod.conda_uri(renv_mod.conda_spec(
+        {"conda": {"dependencies": ["scipy"]}}))
+    c = renv_mod.conda_uri(renv_mod.conda_spec({"conda": "my-env"}))
+    assert len({a, b, c}) == 3
+    # dict key order does not split the cache
+    d = renv_mod.conda_uri(renv_mod.conda_spec(
+        {"conda": {"dependencies": ["numpy"], "name": "x"}}))
+    e = renv_mod.conda_uri(renv_mod.conda_spec(
+        {"conda": {"name": "x", "dependencies": ["numpy"]}}))
+    assert d == e
+
+
+def test_container_worker_command_is_wrapped(fake_container):
+    @ray_tpu.remote(runtime_env={"container": {
+        "image": "rayproject/ray-tpu:latest",
+        "run_options": ["--cap-drop=ALL"]}})
+    def probe():
+        return "ran"
+
+    assert ray_tpu.get(probe.remote(), timeout=120) == "ran"
+    assert fake_container
+    assert fake_container[0]["image"] == "rayproject/ray-tpu:latest"
+    assert "--cap-drop=ALL" in fake_container[0]["run_options"]
+    # the wrapped command is the worker main
+    assert any("worker_main" in c for c in fake_container[0]["cmd"])
+    # the worker contract is forwarded into the container (Popen env
+    # only reaches the docker client)
+    fwd = fake_container[0]["env"]
+    assert "RAY_TPU_GCS" in fwd and "PYTHONPATH" in fwd
+
+
+def test_invalid_specs_rejected_at_submission():
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(runtime_env={"container": {}})  # no image
+        def f():
+            pass
+        f.remote()
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(runtime_env={"conda": 42})
+        def g():
+            pass
+        g.remote()

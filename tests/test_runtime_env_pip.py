@@ -102,10 +102,12 @@ def test_worker_imports_pip_env_package(tmp_path):
         ray_tpu.remote(try_import).remote(), timeout=120) is False
 
 
-def test_conda_still_rejected():
+def test_unknown_runtime_env_key_rejected():
     def f():
         return 1
 
+    # conda/container are implemented now (test_runtime_env_conda_
+    # container.py); a genuinely unknown key still fails fast
     with pytest.raises(ValueError, match="unsupported runtime_env"):
         ray_tpu.remote(f).options(
-            runtime_env={"conda": {"deps": []}}).remote()
+            runtime_env={"mpi": {"kind": "openmpi"}}).remote()

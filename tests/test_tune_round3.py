@@ -108,8 +108,10 @@ def _make_quadratic():
             import time
             # slow enough that concurrently-launched trials coexist
             # (instant steps let trial 0 finish before trial 1's
-            # worker process even spawns — no population, no PBT)
-            time.sleep(0.15)
+            # worker process even spawns — no population, no PBT);
+            # 0.3 s/step gives trial 1 a ~5 s spawn window on a box
+            # where a cold worker spawn can take 1-3 s
+            time.sleep(0.3)
             self.score += self.lr * (100.0 - self.score)
             return {"score": self.score}
 
