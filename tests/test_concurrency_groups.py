@@ -154,7 +154,9 @@ def test_unsupported_runtime_env_rejected():
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="unsupported runtime_env"):
+    # conda is IMPLEMENTED now (test_runtime_env_conda_container.py);
+    # malformed specs still fail fast at submission
+    with pytest.raises(ValueError, match="conda must be"):
         f.options(runtime_env={"conda": ["python=3.11"]}).remote()
 
     @ray_tpu.remote
@@ -163,7 +165,7 @@ def test_unsupported_runtime_env_rejected():
             return 1
 
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        A.options(runtime_env={"conda": "env"}).remote()
+        A.options(runtime_env={"docker": {"image": "x"}}).remote()
 
 
 def test_named_lookup_carries_max_pending_calls():
