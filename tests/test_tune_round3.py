@@ -103,15 +103,38 @@ def _make_quadratic():
         def setup(self, config):
             self.lr = config["lr"]
             self.score = 0.0
+            # optional population rendezvous: trials announce
+            # themselves and step() holds until the whole population
+            # is up — deterministic coexistence however slow worker
+            # spawns are under suite load (PBT needs a population)
+            self._rdv = None
+            self._pop = int(config.get("population", 0))
+            if self._pop:
+                import os
+                d = config["rendezvous_dir"]
+                os.makedirs(d, exist_ok=True)
+                # keyed by pid, not by config value: duplicate lr
+                # values must still count as distinct population members
+                open(os.path.join(d, f"up-{os.getpid()}"), "w").close()
+                self._rdv = d
 
         def step(self):
             import time
-            # slow enough that concurrently-launched trials coexist
-            # (instant steps let trial 0 finish before trial 1's
-            # worker process even spawns — no population, no PBT);
-            # 0.3 s/step gives trial 1 a ~5 s spawn window on a box
-            # where a cold worker spawn can take 1-3 s
-            time.sleep(0.3)
+            if self._rdv is not None:
+                # one-shot rendezvous: wait once for the population,
+                # then never re-arm (a missing peer fails fast on the
+                # first step instead of hanging every step)
+                import glob
+                deadline = time.time() + 60
+                while len(glob.glob(os.path.join(
+                        self._rdv, "up-*"))) < self._pop:
+                    if time.time() > deadline:
+                        raise RuntimeError("population never assembled")
+                    time.sleep(0.1)
+                self._rdv = None
+            # pace steps so concurrently-running trials overlap for
+            # schedulers (and phase-cutoff tests) that need wall time
+            time.sleep(0.15)
             self.score += self.lr * (100.0 - self.score)
             return {"score": self.score}
 
@@ -142,7 +165,9 @@ class TestPBTEndToEnd:
             resample_probability=0.0, seed=0)
         tuner = Tuner(
             _make_quadratic(),
-            param_space={"lr": grid_search([0.01, 0.5])},
+            param_space={"lr": grid_search([0.01, 0.5]),
+                         "population": 2,
+                         "rendezvous_dir": str(tmp_path / "rdv")},
             tune_config=TuneConfig(metric="score", mode="max",
                                    scheduler=pbt,
                                    max_concurrent_trials=2),
