@@ -14,10 +14,12 @@ from ray_tpu.serve.api import (Application, Deployment,  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.controller import (get_multiplexed_model_id,  # noqa: F401
                                       multiplexed)
+from ray_tpu.serve.grpc_proxy import grpc_call, start_grpc  # noqa: F401
 
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
-    "run", "get_handle", "delete", "shutdown", "start_http", "batch",
+    "run", "get_handle", "delete", "shutdown", "start_http",
+    "start_grpc", "grpc_call", "batch",
     "multiplexed", "get_multiplexed_model_id",
 ]
 

@@ -1,0 +1,109 @@
+"""gRPC ingress for Serve deployments.
+
+reference parity: serve/_private/proxy.py:556 (gRPCProxy) — the
+reference runs an HTTP proxy AND a gRPC proxy per node; its gRPC proxy
+dispatches user-registered servicer methods to deployment handles. Here
+the service is generic (grpc.GenericRpcHandler — no protoc step): the
+method path selects the deployment (`/ray_tpu.serve/<deployment>`), the
+request payload is a pickled (args, kwargs) tuple, and the response is
+the pickled result; `grpc_call` is the matching client helper. Routing
+reuses DeploymentHandle (queue-aware P2C + long-poll push), exactly as
+the reference's proxies route through handles.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Dict
+
+SERVICE_PREFIX = "/ray_tpu.serve/"
+
+
+class GRPCProxyActor:
+    """Per-node gRPC ingress actor (start with serve.start_grpc)."""
+
+    def __init__(self, port: int = 9000, max_workers: int = 16):
+        from concurrent import futures
+
+        import grpc
+
+        self._handles: Dict[str, Any] = {}
+        self._handles_lock = threading.Lock()
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                if not method.startswith(SERVICE_PREFIX):
+                    return None
+                name = method[len(SERVICE_PREFIX):]
+
+                def unary(request: bytes, context):
+                    try:
+                        return proxy._dispatch(name, request)
+                    except Exception as e:  # noqa: BLE001
+                        context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,   # raw bytes in
+                    response_serializer=None)    # raw bytes out
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", -1),
+                     ("grpc.max_send_message_length", -1)])
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        if self.port == 0:
+            # grpc reports bind failure by returning port 0, not raising
+            raise OSError(f"gRPC proxy could not bind 127.0.0.1:{port}")
+        self._server.start()
+
+    def _dispatch(self, name: str, request: bytes) -> bytes:
+        import ray_tpu
+        from ray_tpu.serve.api import DeploymentHandle
+
+        with self._handles_lock:
+            handle = self._handles.get(name)
+            if handle is None:
+                handle = DeploymentHandle(name)
+                self._handles[name] = handle
+        args, kwargs = pickle.loads(request) if request else ((), {})
+        result = ray_tpu.get(handle.remote(*args, **kwargs), timeout=120)
+        return pickle.dumps(result, protocol=5)
+
+    def ready(self) -> int:
+        return self.port
+
+    def stop(self) -> None:
+        self._server.stop(grace=1.0)
+
+
+def start_grpc(port: int = 9000):
+    """Start the gRPC ingress actor (reference serve start with
+    gRPC options); returns its handle (.ready.remote() -> bound port)."""
+    import ray_tpu
+    cls = ray_tpu.remote(GRPCProxyActor)
+    proxy = cls.options(num_cpus=0.1, max_concurrency=8).remote(port)
+    ray_tpu.get(proxy.ready.remote(), timeout=60)
+    return proxy
+
+
+def grpc_call(address: str, deployment: str, *args: Any,
+              timeout: float = 120.0, **kwargs: Any) -> Any:
+    """Client helper: call `deployment` through a gRPC proxy at
+    `address` ("host:port")."""
+    import grpc
+
+    with grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length", -1),
+                     ("grpc.max_send_message_length", -1)]) as channel:
+        fn = channel.unary_unary(
+            SERVICE_PREFIX + deployment,
+            request_serializer=None,
+            response_deserializer=None)
+        payload = pickle.dumps((args, kwargs), protocol=5)
+        return pickle.loads(fn(payload, timeout=timeout))

@@ -133,8 +133,10 @@ def _make_quadratic():
                     time.sleep(0.1)
                 self._rdv = None
             # pace steps so concurrently-running trials overlap for
-            # schedulers (and phase-cutoff tests) that need wall time
-            time.sleep(0.15)
+            # schedulers (and phase-cutoff tests) that need wall time;
+            # configurable so cutoff tests can guarantee their budget
+            # math (see test_tuner_restore_resumes_unfinished)
+            time.sleep(float(self.config.get("step_sleep", 0.15)))
             self.score += self.lr * (100.0 - self.score)
             return {"score": self.score}
 
@@ -190,7 +192,11 @@ class TestPBTEndToEnd:
         # phase 1: run with a tiny time budget so trials get cut off
         tuner = Tuner(
             _make_quadratic(),
-            param_space={"lr": grid_search([0.3, 0.4])},
+            # 6 x 0.5s = 3s per trial: the 2.0s phase-1 budget below
+            # cannot finish both sequential trials, guaranteeing an
+            # unfinished trial for phase 2's restore to resume
+            param_space={"lr": grid_search([0.3, 0.4]),
+                         "step_sleep": 0.5},
             tune_config=TuneConfig(metric="score", mode="max",
                                    max_concurrent_trials=1),
             run_config=TuneRunConfig(
