@@ -67,6 +67,18 @@ class Learner:
             stats["grad_norm"] = optax.global_norm(grads)
             return params, opt_state, stats
 
+        def update_idx(params, opt_state, batch, idx, extra):
+            # one minibatch with its gather fused into the program (only
+            # the small idx crosses the host boundary per step); idx may
+            # be a per-module dict for multi-agent batches
+            if isinstance(idx, dict):
+                mb = {mid: jax.tree.map(lambda v: v[idx[mid]],
+                                        batch[mid])
+                      for mid in idx}
+            else:
+                mb = jax.tree.map(lambda v: v[idx], batch)
+            return update(params, opt_state, mb, extra)
+
         def sweep(params, opt_state, batch, idx_mat, extra):
             # The WHOLE minibatch-SGD sweep (num_epochs x minibatches) as
             # one lax.scan program: one XLA dispatch per Learner.update
@@ -75,15 +87,7 @@ class Learner:
             # idx_mat: [steps, minibatch] row indices into batch.
             def body(carry, idx):
                 p, o = carry
-                if isinstance(idx, dict):
-                    # multi-agent: per-module index vectors into
-                    # per-module sub-batches (static shapes per module)
-                    mb = {mid: jax.tree.map(lambda v: v[idx[mid]],
-                                            batch[mid])
-                          for mid in idx}
-                else:
-                    mb = jax.tree.map(lambda v: v[idx], batch)
-                p, o, st = update(p, o, mb, extra)
+                p, o, st = update_idx(p, o, batch, idx, extra)
                 return (p, o), st
 
             (params, opt_state), stats_seq = jax.lax.scan(
@@ -92,6 +96,24 @@ class Learner:
 
         self._update_fn = jax.jit(update, donate_argnums=(0, 1))
         self._sweep_fn = jax.jit(sweep, donate_argnums=(0, 1))
+        self._update_idx_fn = jax.jit(update_idx, donate_argnums=(0, 1))
+
+    @staticmethod
+    def _use_scan_sweep() -> bool:
+        """Whether the minibatch-SGD sweep runs as ONE lax.scan program
+        (best where dispatch latency dominates — TPU, notably over a
+        tunnel) or as a python loop of per-minibatch jit calls (XLA:CPU
+        emits convolutions inside while-loop bodies through a slow
+        generic path — measured ~50x slower than the same update
+        outside the loop — so CPU defaults to the loop). Override with
+        RAY_TPU_LEARNER_SWEEP=scan|loop."""
+        import os
+
+        import jax
+        forced = os.environ.get("RAY_TPU_LEARNER_SWEEP", "").lower()
+        if forced in ("scan", "loop"):
+            return forced == "scan"
+        return jax.default_backend() != "cpu"
 
     # ---- distributed (mesh gang) build ------------------------------
     def data_axis_for(self, key: str) -> int:
@@ -277,14 +299,35 @@ class Learner:
         idx_mat = np.stack(rows).astype(np.int32)
         # One explicit host→device transfer of the whole batch up front
         # (dispatching jit calls with raw numpy batches can re-transfer
-        # per-array, synchronously, on some backends), then ONE jitted
-        # lax.scan dispatch for the whole minibatch-SGD sweep.
+        # per-array, synchronously, on some backends).
         dev_batch = jax.device_put(batch)
+        if self._use_scan_sweep():
+            # ONE jitted lax.scan dispatch for the whole sweep
+            with self._state_lock:
+                self._params, self._opt_state, stats_seq = \
+                    self._sweep_fn(self._params, self._opt_state,
+                                   dev_batch, idx_mat,
+                                   self.extra_inputs())
+            return self._sweep_stats(jax.device_get(stats_seq))
+        return self._loop_sweep(dev_batch, list(idx_mat))
+
+    def _loop_sweep(self, dev_batch, step_indices) -> Dict[str, Any]:
+        """Loop-sweep shared by single- and multi-agent update paths:
+        one dispatch per minibatch, stats forced once at the end so the
+        steps still pipeline."""
+        import jax
+
+        pending = []
+        extra = self.extra_inputs()
         with self._state_lock:
-            self._params, self._opt_state, stats_seq = self._sweep_fn(
-                self._params, self._opt_state, dev_batch, idx_mat,
-                self.extra_inputs())
-        return self._sweep_stats(jax.device_get(stats_seq))
+            for idx in step_indices:
+                self._params, self._opt_state, st = self._update_idx_fn(
+                    self._params, self._opt_state, dev_batch, idx, extra)
+                pending.append(st)
+        host = jax.device_get(pending)  # single forcing point
+        stacked = {k: np.stack([np.asarray(s[k]) for s in host])
+                   for k in host[0]} if host else {}
+        return self._sweep_stats(stacked)
 
     @staticmethod
     def _sweep_stats(stats_seq: Dict[str, Any]) -> Dict[str, Any]:
@@ -375,8 +418,16 @@ class MultiAgentLearnerMixin:
         idx_mat = {mid: np.stack(r).astype(np.int32)
                    for mid, r in rows.items()}
         dev_batch = jax.device_put(batch)
-        with self._state_lock:
-            self._params, self._opt_state, stats_seq = self._sweep_fn(
-                self._params, self._opt_state, dev_batch, idx_mat,
-                self.extra_inputs())
-        return self._sweep_stats(jax.device_get(stats_seq))
+        if self._use_scan_sweep():
+            with self._state_lock:
+                self._params, self._opt_state, stats_seq = \
+                    self._sweep_fn(self._params, self._opt_state,
+                                   dev_batch, idx_mat,
+                                   self.extra_inputs())
+            return self._sweep_stats(jax.device_get(stats_seq))
+        # loop sweep (Learner._loop_sweep): per-step dict idx
+        n_steps = len(next(iter(idx_mat.values())))
+        return self._loop_sweep(
+            dev_batch,
+            [{mid: m[s] for mid, m in idx_mat.items()}
+             for s in range(n_steps)])

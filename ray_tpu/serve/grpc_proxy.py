@@ -41,7 +41,13 @@ class GRPCProxyActor:
 
                 def unary(request: bytes, context):
                     try:
-                        return proxy._dispatch(name, request)
+                        # bound by the CLIENT's deadline so abandoned
+                        # calls release their worker thread instead of
+                        # blocking the bounded executor for 120s
+                        remaining = context.time_remaining()
+                        timeout = min(120.0, remaining) \
+                            if remaining is not None else 120.0
+                        return proxy._dispatch(name, request, timeout)
                     except Exception as e:  # noqa: BLE001
                         context.abort(grpc.StatusCode.INTERNAL, str(e))
 
@@ -61,7 +67,8 @@ class GRPCProxyActor:
             raise OSError(f"gRPC proxy could not bind 127.0.0.1:{port}")
         self._server.start()
 
-    def _dispatch(self, name: str, request: bytes) -> bytes:
+    def _dispatch(self, name: str, request: bytes,
+                  timeout: float = 120.0) -> bytes:
         import ray_tpu
         from ray_tpu.serve.api import DeploymentHandle
 
@@ -71,14 +78,18 @@ class GRPCProxyActor:
                 handle = DeploymentHandle(name)
                 self._handles[name] = handle
         args, kwargs = pickle.loads(request) if request else ((), {})
-        result = ray_tpu.get(handle.remote(*args, **kwargs), timeout=120)
+        result = ray_tpu.get(handle.remote(*args, **kwargs),
+                             timeout=timeout)
         return pickle.dumps(result, protocol=5)
 
     def ready(self) -> int:
         return self.port
 
     def stop(self) -> None:
-        self._server.stop(grace=1.0)
+        # stop() is async in grpc: wait the returned event so callers
+        # can rebind the port immediately after this returns (the HTTP
+        # proxy's shutdown() blocks the same way)
+        self._server.stop(grace=1.0).wait()
 
 
 def start_grpc(port: int = 9000):

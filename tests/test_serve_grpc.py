@@ -49,13 +49,15 @@ def test_grpc_and_http_proxies_coexist(serve_session):
 
     serve.run(double)
     gproxy = serve.start_grpc(port=0)
-    gport = ray_tpu.get(gproxy.ready.remote())
-    hproxy = serve.start_http(port=8124)
+    hproxy = None
     try:
+        gport = ray_tpu.get(gproxy.ready.remote())
+        hproxy = serve.start_http(port=0)
+        hport = ray_tpu.get(hproxy.ready.remote())
         assert serve.grpc_call(f"127.0.0.1:{gport}", "both_ways",
                                5) == 10
         req = urllib.request.Request(
-            "http://127.0.0.1:8124/both_ways",
+            f"http://127.0.0.1:{hport}/both_ways",
             data=json.dumps({"x": 5}).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=30) as resp:
@@ -63,4 +65,5 @@ def test_grpc_and_http_proxies_coexist(serve_session):
     finally:
         ray_tpu.get(gproxy.stop.remote(), timeout=30)
         ray_tpu.kill(gproxy)
-        ray_tpu.kill(hproxy)
+        if hproxy is not None:
+            ray_tpu.kill(hproxy)
