@@ -525,7 +525,16 @@ class CoreWorker:
                                       worker_id_hex=self.worker_id.hex())
                     except Exception:  # noqa: BLE001
                         pass
-                out.append(self._get_one(ref, deadline))
+                # may raise DeadlockError instead of blocking forever
+                edge = self._register_wait_edge(ref) if need_wait else None
+                try:
+                    out.append(self._get_one(ref, deadline))
+                finally:
+                    # removed the moment THIS ref resolves: an edge held
+                    # until the whole multi-ref get returned could close
+                    # a false cycle against a peer we no longer wait on
+                    if edge is not None:
+                        self._remove_wait_edge(edge)
             return out
         finally:
             if blocked_notified:
@@ -534,6 +543,76 @@ class CoreWorker:
                                   worker_id_hex=self.worker_id.hex())
                 except Exception:  # noqa: BLE001
                     pass
+
+    def _remove_wait_edge(self, token: str) -> None:
+        # token-keyed and idempotent: the rpc layer retries it through
+        # connection blips, so a stale edge can't outlive this get
+        try:
+            self._gcs.call("wait_graph_remove", token=token)
+        except Exception:  # noqa: BLE001 - GCS gone; edge moot
+            pass
+
+    # Blocking this long before an edge is registered keeps the GCS off
+    # the hot path (gets that resolve quickly — the common trajectory
+    # plane — never call it) and closes the remove/add race: a peer that
+    # just stopped waiting on us has long since sent its removal by the
+    # time our registration lands.
+    WAIT_EDGE_GRACE_S = 0.2
+
+    def _register_wait_edge(self, ref: ObjectRef) -> Optional[str]:
+        """Actor-context blocking get on another actor's pending result:
+        register a waits-for edge with the GCS wait graph BEFORE
+        blocking; returns the edge's token to remove once the ref
+        resolves, or None when no edge applies. If the edge would
+        close a cycle, every actor on it is waiting on the next with
+        its executor thread held — raise DeadlockError (with the cycle)
+        instead of joining the hang. Best-effort: an unreachable GCS
+        only costs detection, not the get itself."""
+        ex = self.executor
+        if ex is None or ex.actor_id is None:
+            return None
+        if ex.has_spare_capacity():
+            # an idle executor thread can still serve calls from cycle
+            # peers (async actors, max_concurrency > 1): not a hard
+            # deadlock, so don't contribute an edge
+            return None
+        waiter = ex.actor_id.hex()
+        with self._lock:
+            entry = self.tasks.get(ref.task_id().hex())
+            target = entry.spec.actor_id if entry is not None else None
+        if target is None:
+            return None  # not an actor task we submitted; no actor edge
+        target_hex = target.hex()
+        if target_hex == waiter:
+            # re-entrant self-get surfaces as a plain hang/timeout
+            return None
+        # Grace wait on the local completion event (the target came from
+        # our own task table, so we own the ref and its event): fast
+        # results never involve the GCS at all.
+        with self._lock:
+            loc = self.objects.get(ref.hex())
+            ev = self.object_events.get(ref.hex())
+        if loc is None or loc[0] != PENDING:
+            return None  # already resolved
+        if ev is not None and ev.wait(timeout=self.WAIT_EDGE_GRACE_S):
+            return None  # resolved within the grace window
+        token = os.urandom(8).hex()
+        try:
+            cycle = self._gcs.call("wait_graph_add", waiter_hex=waiter,
+                                   target_hex=target_hex, token=token)
+        except Exception:  # noqa: BLE001 - detection is advisory
+            return None
+        if cycle is not None:
+            from ray_tpu._private.wait_graph import format_cycle
+            names = {e["actor_id"]: e["class_name"] for e in cycle}
+            path = format_cycle([e["actor_id"] for e in cycle], names)
+            raise exc.DeadlockError(
+                f"blocking get() would deadlock: waits-for cycle "
+                f"{path} (every actor on the cycle holds its executor "
+                f"thread; return the ObjectRef, use an async method, or "
+                f"raise max_concurrency)",
+                cycle=[e["actor_id"] for e in cycle])
+        return token
 
     def _is_own(self, ref: ObjectRef) -> bool:
         return ref.owner_address in (None, self.address)
@@ -1802,7 +1881,9 @@ class CoreWorker:
             try:
                 self._pool.get(owner_addr).call(
                     "cw_remove_ref", oid_hex=oid_hex, borrower=self.address)
-            except Exception:  # noqa: BLE001
+            # best-effort release during shutdown: the owner may already
+            # be gone, and there is nothing left to free on our side
+            except Exception:  # noqa: BLE001  graftlint: disable=RT008
                 pass
         self._borrow_release_queue.put(None)
         try:
@@ -1845,7 +1926,27 @@ class _Executor:
         self._running: Dict[str, int] = {}
         # per-function execution counts for max_calls worker recycling
         self._calls_by_fn: Dict[str, int] = {}
+        # which concurrency group the current thread serves (threads are
+        # group-pinned for life) + per-group thread-pool widths, for
+        # spare-capacity accounting
+        self._group_tls = threading.local()
+        self._default_threads = 0
+        self._group_widths: Dict[str, int] = {}
         self._spawn_exec_threads(1)
+
+    def has_spare_capacity(self) -> bool:
+        """True while at least one executor thread of the CALLING
+        thread's concurrency group is idle — then this actor can still
+        field the calls a cycle peer would send here, so a blocking get
+        does not make it a hard node in the waits-for graph. Counted per
+        group: an idle thread of a different group can't serve this
+        group's queue."""
+        group = getattr(self._group_tls, "group", "")
+        with self._lock:
+            running = self._running.get(group, 0)
+            width = self._group_widths.get(group, 1) if group \
+                else self._default_threads
+        return running < width
 
     def queue_depth(self, group: str = "") -> int:
         """Queued + currently-executing tasks for one concurrency group
@@ -1863,6 +1964,7 @@ class _Executor:
                                  name=f"exec-{len(self._threads)}")
             t.start()
             self._threads.append(t)
+            self._default_threads += 1
 
     def _ensure_aio_loop(self):
         """Lazily start the actor's asyncio loop thread."""
@@ -1916,6 +2018,7 @@ class _Executor:
                 return
             q: "queue.Queue" = queue.Queue()
             self._group_queues[group] = q
+            self._group_widths[group] = max(1, width)
         for i in range(max(1, width)):
             t = threading.Thread(target=self._exec_loop, args=(q, group),
                                  daemon=True,
@@ -1929,6 +2032,7 @@ class _Executor:
     def _exec_loop(self, q: Optional["queue.Queue"] = None,
                    group: str = "") -> None:
         q = q if q is not None else self._queue
+        self._group_tls.group = group
         while True:
             spec = q.get()
             if spec is None:

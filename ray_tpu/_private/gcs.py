@@ -168,6 +168,10 @@ class GcsServer:
         # dashboard event module): bounded ring of lifecycle records.
         self.cluster_events: List[Dict[str, Any]] = []
         self.CLUSTER_EVENTS_MAX = 4096
+        # Actor waits-for graph (blocking gets between actors) with
+        # cycle-at-insert deadlock detection; see _private/wait_graph.py.
+        from ray_tpu._private.wait_graph import WaitGraph
+        self.wait_graph = WaitGraph()
         self._dead = False
 
         # Reload the persisted actor directory (reference GcsInitData:
@@ -228,6 +232,10 @@ class GcsServer:
             # structured events (reference ReportEventService)
             "add_events": self.add_events,
             "list_events": self.list_events,
+            # actor waits-for graph (deadlock detection)
+            "wait_graph_add": self.wait_graph_add,
+            "wait_graph_remove": self.wait_graph_remove,
+            "wait_graph_snapshot": self.wait_graph_snapshot,
             # pubsub (reference InternalPubSubGcsService)
             "subscribe": self.subscribe,
             "publish": self.publish,
@@ -481,6 +489,9 @@ class GcsServer:
                 info.state = "DEAD"
                 info.address = None
         self._persist_actor(actor_id_hex)
+        # a dead actor's blocking gets died with it; waiters on it get
+        # ActorDiedError through the usual path, not a stale wait edge
+        self.wait_graph.drop_actor(actor_id_hex)
         if can_restart:
             logger.warning("GCS: restarting actor %s (%d/%s): %s",
                            actor_id_hex[:12], info.num_restarts,
@@ -582,6 +593,33 @@ class GcsServer:
         if severity:
             out = [e for e in out if e.get("severity") == severity]
         return out[-limit:]
+
+    # ---- actor waits-for graph (deadlock detection) ---------------------
+
+    def wait_graph_add(self, waiter_hex: str, target_hex: str,
+                       token: str) -> Optional[List[Dict[str, str]]]:
+        """Register a blocking-get edge. Returns None (edge recorded) or
+        the cycle the edge would close, annotated with class names, in
+        which case the edge is NOT recorded and the caller must raise
+        DeadlockError instead of blocking. Idempotent per token (safe
+        under RPC retry)."""
+        cycle = self.wait_graph.add(waiter_hex, target_hex, token)
+        if cycle is None:
+            return None
+        from ray_tpu._private.wait_graph import format_cycle
+        with self._lock:
+            names = {h: self.actors[h].class_name
+                     for h in cycle if h in self.actors}
+        self._emit("DEADLOCK_DETECTED", format_cycle(cycle, names),
+                   severity="ERROR", cycle=list(cycle))
+        return [{"actor_id": h, "class_name": names.get(h, "")}
+                for h in cycle]
+
+    def wait_graph_remove(self, token: str) -> None:
+        self.wait_graph.remove(token)
+
+    def wait_graph_snapshot(self) -> Dict[str, Any]:
+        return self.wait_graph.snapshot()
 
     def _emit(self, event_type: str, message: str,
               severity: str = "INFO", **fields: Any) -> None:

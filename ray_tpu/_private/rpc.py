@@ -206,7 +206,9 @@ class RpcServer:
 # writes. A send into a dead peer's kernel buffer "succeeds" locally, so
 # without this the first call after a server restart always fails.
 _IDEMPOTENT_PREFIXES = ("get_", "list_", "kv_get", "kv_keys", "nm_get",
-                        "nm_list", "cl_get", "cl_list")
+                        "nm_list", "cl_get", "cl_list",
+                        # token-keyed add/remove + snapshot reads
+                        "wait_graph_")
 _IDEMPOTENT_METHODS = frozenset({
     "ping", "nm_ping", "report_resources", "register_node", "subscribe",
     "next_job_id", "cluster_resources", "available_resources",

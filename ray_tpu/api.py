@@ -63,19 +63,44 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     if isinstance(refs, ObjectRef):
         return cw.get([refs], timeout=timeout)[0]
     if not isinstance(refs, (list, tuple)):
-        raise TypeError(f"ray_tpu.get takes an ObjectRef or a list, "
-                        f"got {type(refs)}")
+        raise TypeError(f"ray_tpu.get takes an ObjectRef or a list of "
+                        f"ObjectRefs, got {type(refs).__name__}")
+    _check_refs(refs, "get")
     return cw.get(list(refs), timeout=timeout)
+
+
+def _check_refs(refs: Sequence[Any], api: str) -> None:
+    for i, r in enumerate(refs):
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"ray_tpu.{api} takes ObjectRefs; element {i} is "
+                f"{type(r).__name__} ({r!r})")
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None):
     if isinstance(refs, ObjectRef):
-        raise TypeError("ray_tpu.wait takes a list of ObjectRefs")
+        raise TypeError("ray_tpu.wait takes a list of ObjectRefs, got a "
+                        "bare ObjectRef (wrap it in a list)")
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"ray_tpu.wait takes a list of ObjectRefs, got "
+                        f"{type(refs).__name__}")
+    if num_returns <= 0:
+        if num_returns == 0 and not refs:
+            # wait([], num_returns=len([])) is a common drain pattern
+            return [], []
+        # returning ([], refs) for num_returns=0 on real refs looks like
+        # "nothing ready yet" and silently disables the caller's
+        # backpressure
+        raise ValueError(
+            f"ray_tpu.wait needs num_returns >= 1, got {num_returns}")
     ctx = worker_mod.client_context()
     if ctx is not None:
+        # client mode carries ClientObjectRefs; the server side
+        # re-validates element types against the real ObjectRef
         return ctx.wait(list(refs), num_returns=num_returns,
                         timeout=timeout)
+    _check_refs(refs, "wait")
     cw = worker_mod.global_worker().core_worker
     return cw.wait(list(refs), num_returns=num_returns, timeout=timeout)
 

@@ -112,6 +112,27 @@ class _NoRoute(Exception):
     must surface as 500s, not 404s)."""
 
 
+_WG_GAUGES = None
+
+
+def _refresh_wait_graph_metrics() -> None:
+    """Mirror the GCS wait-graph snapshot into this process's metrics
+    registry so the Grafana panels (dashboard/metrics.py) have a real
+    series to scrape. Called per /metrics scrape; best-effort."""
+    global _WG_GAUGES
+    from ray_tpu.util import state
+    from ray_tpu.util.metrics import Gauge
+    if _WG_GAUGES is None:
+        _WG_GAUGES = (
+            Gauge("ray_tpu_wait_graph_edges",
+                  "live actor waits-for edges (blocking gets)"),
+            Gauge("ray_tpu_deadlocks_detected",
+                  "waits-for cycles detected since cluster start"))
+    snap = state.wait_graph()
+    _WG_GAUGES[0].set(float(len(snap["edges"])))
+    _WG_GAUGES[1].set(float(snap["deadlocks_detected"]))
+
+
 class DashboardHead:
     """Runs inside any process connected to the cluster (typically an
     actor started by start_dashboard)."""
@@ -138,6 +159,10 @@ class DashboardHead:
                 try:
                     if route == "/metrics":
                         from ray_tpu.util.metrics import prometheus_text
+                        try:
+                            _refresh_wait_graph_metrics()
+                        except Exception:  # noqa: BLE001 — GCS blip must
+                            pass           # not break the whole scrape
                         body = prometheus_text().encode()
                         self.send_response(200)
                         self.send_header("Content-Type",
@@ -215,6 +240,10 @@ class DashboardHead:
         if route == "/api/metrics/config":
             from ray_tpu.dashboard.metrics import write_metrics_configs
             return write_metrics_configs()
+        if route == "/api/wait_graph":
+            # live actor waits-for edges + deadlocks-detected counter
+            # (runtime counterpart of graftlint RT001)
+            return s.wait_graph()
         if route == "/api/events":
             return s.list_cluster_events(
                 event_type=params.get("type"),

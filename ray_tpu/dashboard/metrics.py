@@ -33,6 +33,10 @@ GRAFANA_DASHBOARD: Dict[str, Any] = {
          "targets": [{"expr": "rate(ray_tpu_actor_calls_total[1m])"}]},
         {"title": "Train tokens/sec", "type": "timeseries",
          "targets": [{"expr": "ray_tpu_train_tokens_per_second"}]},
+        {"title": "Actor wait edges (blocking gets)", "type": "timeseries",
+         "targets": [{"expr": "ray_tpu_wait_graph_edges"}]},
+        {"title": "Deadlocks detected", "type": "timeseries",
+         "targets": [{"expr": "ray_tpu_deadlocks_detected"}]},
     ],
 }
 

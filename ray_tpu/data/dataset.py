@@ -217,6 +217,8 @@ class Dataset:
         ex = StreamingExecutor(self._inputs, self._ops,
                                max_in_flight_blocks=max_in_flight_blocks)
         for ref in ex.execute():
+            # streaming: one block in memory at a time is the point
+            # graftlint: disable=RT002
             yield ray_tpu.get(ref) if isinstance(ref, ray_tpu.ObjectRef) \
                 else ref
 
@@ -417,14 +419,18 @@ def _merge_sorted(refs: List[Any], key: str, descending: bool) -> Block:
 def _zip_partition(left_blk: Block, right_refs: List[Any],
                    rcounts: List[int], lo: int, hi: int) -> Block:
     """Zip the left block with the right side's global rows [lo,hi)."""
-    pieces = []
+    overlaps = []
     pos = 0
     for ref, cnt in zip(right_refs, rcounts):
         s, e = max(lo, pos), min(hi, pos + cnt)
         if e > s:
-            blk = ray_tpu.get(ref)
-            pieces.append(block_mod.slice_block(blk, s - pos, e - pos))
+            overlaps.append((ref, s - pos, e - pos))
         pos += cnt
+    # one batched get for every overlapping block (found by graftlint
+    # RT002: a get per block serialized the fetches)
+    blocks = ray_tpu.get([ref for ref, _, _ in overlaps])
+    pieces = [block_mod.slice_block(blk, s0, e0)
+              for blk, (_, s0, e0) in zip(blocks, overlaps)]
     right = block_mod.concat_blocks(pieces)
     out = dict(left_blk)
     for k, v in right.items():

@@ -33,6 +33,8 @@ class DataIterator:
             yield from self._blocks
             return
         for ref in self._refs:
+            # streaming: one block in memory at a time is the point
+            # graftlint: disable=RT002
             yield ray_tpu.get(ref) if isinstance(ref, ray_tpu.ObjectRef) \
                 else ref
 

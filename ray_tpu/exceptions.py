@@ -93,6 +93,24 @@ class OwnerDiedError(ObjectLostError):
     pass
 
 
+class DeadlockError(RayError):
+    """A blocking get() inside an actor closed a waits-for cycle: every
+    actor on the cycle holds its executor thread while waiting on the
+    next one, so none can make progress. Raised by the waiter whose edge
+    would have completed the cycle (the wait-graph detector in the GCS),
+    which unwinds that waiter and lets the rest of the cycle drain —
+    instead of the whole gang hanging forever."""
+
+    def __init__(self, message: str = "", cycle: list | None = None):
+        self.cycle = list(cycle or [])
+        super().__init__(message)
+
+    def __reduce__(self):
+        # rebuild from the real fields (see RayActorError.__reduce__)
+        return (DeadlockError, (self.args[0] if self.args else "",
+                                self.cycle))
+
+
 class RaySystemError(RayError):
     pass
 

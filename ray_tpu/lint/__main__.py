@@ -1,0 +1,71 @@
+"""graftlint CLI: `python -m ray_tpu.lint [paths...]`.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. `--format=json` emits a
+machine-readable array for CI tooling and dashboards.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from ray_tpu.lint.engine import lint_paths
+    from ray_tpu.lint.rules import ALL_RULES
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.lint",
+        description="framework-aware static analysis for ray_tpu programs")
+    parser.add_argument("paths", nargs="*", default=["."],
+                        help="files or directories to lint (default: .)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt",
+                        help="output format (default: text)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--ignore", default=None,
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id} {rule.name}: {rule.rationale}")
+        return 0
+
+    select = [s for s in (args.select or "").split(",") if s] or None
+    ignore = [s for s in (args.ignore or "").split(",") if s] or None
+    from ray_tpu.lint.rules import RULES_BY_ID
+    unknown = [s for s in (select or []) + (ignore or [])
+               if s.upper() not in RULES_BY_ID]
+    if unknown:
+        # a typo'd rule id must not turn the CI gate into a green
+        # zero-findings run of zero rules
+        print(f"error: unknown rule id(s) {', '.join(unknown)} "
+              f"(known: {', '.join(sorted(RULES_BY_ID))})",
+              file=sys.stderr)
+        return 2
+    paths: List[str] = args.paths or ["."]
+    try:
+        findings = lint_paths(paths, select=select, ignore=ignore)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.fmt == "json":
+        print(json.dumps([f.to_dict() for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            print(f"\n{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

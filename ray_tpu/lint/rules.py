@@ -1,0 +1,432 @@
+"""graftlint rule set: 8 framework-aware checks.
+
+Each rule has a stable id (RT001..RT008), a one-line rationale, and a
+`check(ctx)` generator yielding Findings. Rules are deliberately
+conservative: a finding should be actionable, and intentional
+exceptions are silenced in-place with `# graftlint: disable=RTxxx`
+comments that double as documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ray_tpu.lint.engine import Finding, ModuleContext
+
+# Calls that block the calling worker thread until remote work finishes.
+BLOCKING_GET = {"ray_tpu.get", "ray.get"}
+BLOCKING_WAIT = {"ray_tpu.wait", "ray.wait"}
+
+# Host-side-effect callables that silently bake into (or retrigger) an
+# XLA trace instead of running per step.
+HOST_EFFECT_EXACT = {"print", "input", "open", "breakpoint"}
+HOST_EFFECT_PREFIX = ("time.", "numpy.random.", "np.random.", "os.system",
+                      "subprocess.", "logging.", "random.")
+# jax.debug.* and jax.random are the traced-safe alternatives.
+HOST_EFFECT_ALLOWED_PREFIX = ("jax.",)
+
+MUTATING_METHODS = {"append", "extend", "add", "update", "insert",
+                    "setdefault", "popitem", "clear", "remove",
+                    "discard"}
+
+
+class Rule:
+    id: str = "RT000"
+    name: str = "base"
+    rationale: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(self.id, ctx.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+def _blocking_calls(ctx: ModuleContext, include_wait: bool = True
+                    ) -> Iterator[ast.Call]:
+    names = BLOCKING_GET | (BLOCKING_WAIT if include_wait else set())
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and ctx.call_name(node) in names:
+            yield node
+
+
+def _in_remote_context(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    """Name of the enclosing actor method / remote function, if any."""
+    fns = ctx.enclosing_functions(node)
+    for fn in fns:
+        if fn in ctx.remote_fns:
+            return f"remote function '{getattr(fn, 'name', '<lambda>')}'"
+    # a method of a @remote class: innermost non-lambda function whose
+    # enclosing class is an actor class
+    for fn in fns:
+        cls = ctx.enclosing_class(fn)
+        if cls is not None and cls in ctx.actor_classes:
+            return (f"actor method "
+                    f"'{cls.name}.{getattr(fn, 'name', '<lambda>')}'")
+    return None
+
+
+class NestedBlockingGet(Rule):
+    id = "RT001"
+    name = "nested-blocking-get"
+    rationale = ("blocking get()/wait() inside an actor method or remote "
+                 "function holds its executor thread while waiting on "
+                 "other remote work - mutual calls deadlock the cluster")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call in _blocking_calls(ctx):
+            where = _in_remote_context(ctx, call)
+            if where is not None:
+                fn = ctx.call_name(call)
+                yield self.finding(
+                    ctx, call,
+                    f"blocking {fn}() inside {where}: a cycle of such "
+                    f"calls deadlocks (return the ObjectRef, use an "
+                    f"async method, or raise max_concurrency)")
+
+
+class GetInLoop(Rule):
+    id = "RT002"
+    name = "get-in-loop"
+    rationale = ("get() in a loop serializes the trajectory plane: each "
+                 "iteration round-trips before the next task is even "
+                 "looked at")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call in _blocking_calls(ctx, include_wait=False):
+            loops = ctx.loops_between(call)
+            if loops:
+                fn = ctx.call_name(call)
+                yield self.finding(
+                    ctx, call,
+                    f"{fn}() inside a loop serializes on each result: "
+                    f"batch refs and call {fn}(refs) once, or drain "
+                    f"with wait(refs) as results land")
+
+
+class HostEffectInJit(Rule):
+    id = "RT003"
+    name = "host-side-effect-in-jit"
+    rationale = ("host callables inside jit/scan bodies run once at trace "
+                 "time (stale values baked in) or force retraces - use "
+                 "jax.debug.print / jax.random instead")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or not ctx.in_traced_code(node):
+                continue
+            name = ctx.call_name(node)
+            if name is None:
+                continue
+            if name.startswith(HOST_EFFECT_ALLOWED_PREFIX):
+                continue
+            if name in HOST_EFFECT_EXACT or \
+                    name.startswith(HOST_EFFECT_PREFIX):
+                yield self.finding(
+                    ctx, node,
+                    f"host call {name}() inside a jit/scan-traced "
+                    f"function executes at trace time, not per step "
+                    f"(use jax.debug.print / jax.random, or hoist it "
+                    f"out of the traced body)")
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Names bound inside a function: params, assignments, loop/with
+    targets, comprehension targets, local defs."""
+    bound: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            bound.add(a.arg)
+        if args.vararg:
+            bound.add(args.vararg.arg)
+        if args.kwarg:
+            bound.add(args.kwarg.arg)
+
+    def add_target(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            bound.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+        elif isinstance(t, ast.Starred):
+            add_target(t.value)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            add_target(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            add_target(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    add_target(item.optional_vars)
+        elif isinstance(node, ast.comprehension):
+            add_target(node.target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                bound.add((a.asname or a.name).split(".")[0])
+    return bound
+
+
+class ClosureMutationInJit(Rule):
+    id = "RT004"
+    name = "closure-mutation-in-jit"
+    rationale = ("mutating closed-over state inside a traced function "
+                 "happens once at trace time - subsequent calls reuse "
+                 "the compiled program and the mutation never reruns")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.traced_fns:
+            if isinstance(fn, ast.Lambda):
+                continue  # lambdas cannot contain statements
+            bound = _bound_names(fn)
+            for node in ast.walk(fn):
+                if node is fn:
+                    continue
+                # nested defs are themselves in traced_fns; their bodies
+                # report against their own (tighter) bound-name sets
+                if ctx.enclosing_function(node) is not fn:
+                    continue
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    yield self.finding(
+                        ctx, node,
+                        f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                        f"write inside a traced function only happens at "
+                        f"trace time; thread state through the function's "
+                        f"inputs/outputs instead")
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            yield self.finding(
+                                ctx, t,
+                                f"assignment to self.{t.attr} inside a "
+                                f"traced function mutates untraced host "
+                                f"state; return the new value instead")
+                        elif isinstance(t, ast.Subscript) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id not in bound:
+                            yield self.finding(
+                                ctx, t,
+                                f"item assignment on closed-over "
+                                f"'{t.value.id}' inside a traced function "
+                                f"is a trace-time side effect")
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in MUTATING_METHODS and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id not in bound and \
+                        node.func.value.id != "self" and \
+                        isinstance(ctx.parent(node), ast.Expr):
+                    # result discarded => called for the side effect;
+                    # `u, s = optimizer.update(...)`-style pure APIs
+                    # (optax) assign the result and are fine
+                    yield self.finding(
+                        ctx, node,
+                        f"mutating call "
+                        f"{node.func.value.id}.{node.func.attr}() on "
+                        f"closed-over state inside a traced function is "
+                        f"a trace-time side effect")
+
+
+class ActorCallWithoutRemote(Rule):
+    id = "RT005"
+    name = "actor-call-without-remote"
+    rationale = ("calling handle.method(...) runs nothing: actor methods "
+                 "execute only via handle.method.remote(...)")
+
+    _HANDLE_OK_ATTRS = {"remote", "options", "bind"}
+
+    def _scope_nodes(self, fn: ast.AST, ctx: ModuleContext):
+        """Nodes belonging directly to this scope (module scope must not
+        re-walk function bodies — they are their own scopes)."""
+        scope = None if fn is ctx.tree else fn
+        for node in ast.walk(fn):
+            if ctx.enclosing_function(node) is scope:
+                yield node
+
+    def _handle_names(self, fn: ast.AST, ctx: ModuleContext) -> Set[str]:
+        """Names assigned from ActorClass.remote(...) /
+        .options(...).remote(...) within this scope, where ActorClass
+        is a @remote class defined in this module."""
+        actor_names = {c.name for c in ctx.actor_classes}
+        handles: Set[str] = set()
+        for node in self._scope_nodes(fn, ctx):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            func = call.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr == "remote"):
+                continue
+            root = func.value
+            # unwrap Class.options(...).remote(...)
+            if isinstance(root, ast.Call) and \
+                    isinstance(root.func, ast.Attribute) and \
+                    root.func.attr == "options":
+                root = root.func.value
+            if isinstance(root, ast.Name) and root.id in actor_names:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        handles.add(t.id)
+        return handles
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        fns = [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        fns.append(ctx.tree)
+        for fn in fns:
+            handles = self._handle_names(fn, ctx)
+            if not handles:
+                continue
+            for node in self._scope_nodes(fn, ctx):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in handles and \
+                        node.func.attr not in self._HANDLE_OK_ATTRS and \
+                        not node.func.attr.startswith("_"):
+                    yield self.finding(
+                        ctx, node,
+                        f"'{node.func.value.id}.{node.func.attr}(...)' "
+                        f"calls an actor method without .remote() - it "
+                        f"raises at runtime; use "
+                        f".{node.func.attr}.remote(...)")
+
+
+class LeakedObjectRef(Rule):
+    id = "RT006"
+    name = "leaked-objectref"
+    rationale = ("a .remote() result that is never stored, awaited or "
+                 "passed on cannot be gotten, waited or cancelled - the "
+                 "task's result (and error!) vanish")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr == "remote":
+                yield self.finding(
+                    ctx, node,
+                    "discarded .remote() call leaks its ObjectRef: "
+                    "errors are silently dropped and the result is "
+                    "unreachable; keep the ref (get/wait it) or note "
+                    "why fire-and-forget is safe")
+
+
+class DictOrderPytree(Rule):
+    id = "RT007"
+    name = "dict-order-pytree"
+    rationale = ("pytree construction by dict iteration inside traced "
+                 "code bakes one process's insertion order into the "
+                 "compiled program - ranks built in a different order "
+                 "desync collectives/checkpoints; iterate sorted(...)")
+
+    _DICT_ITERS = {"items", "keys", "values"}
+
+    def _uses_trees(self, fn: ast.AST, ctx: ModuleContext) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = ctx.call_name(node) or ""
+                if name.startswith(("jax.tree", "jax.tree_util",
+                                    "tree_map", "tree_flatten")):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            iters: List[ast.AST] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters = [node.iter]
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                iters = [g.iter for g in node.generators]
+            for it in iters:
+                if not (isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Attribute)
+                        and it.func.attr in self._DICT_ITERS):
+                    continue
+                fn = ctx.enclosing_function(node)
+                traced = ctx.in_traced_code(node)
+                treey = fn is not None and self._uses_trees(fn, ctx)
+                if traced or treey:
+                    yield self.finding(
+                        ctx, it,
+                        f"pytree built by iterating .{it.func.attr}() in "
+                        f"{'traced' if traced else 'tree-manipulating'} "
+                        f"code depends on dict insertion order; wrap in "
+                        f"sorted(...) for a rank-stable structure")
+
+
+class SwallowedException(Rule):
+    id = "RT008"
+    name = "swallowed-exception"
+    rationale = ("a bare except (or except-pass in a forever loop) eats "
+                 "KeyboardInterrupt/SystemExit and turns actor-loop "
+                 "crashes into silent hangs")
+
+    def _is_forever_loop(self, node: ast.While) -> bool:
+        return isinstance(node.test, ast.Constant) and \
+            bool(node.test.value)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            has_raise = any(isinstance(n, ast.Raise)
+                            for n in ast.walk(node))
+            if node.type is None and not has_raise:
+                yield self.finding(
+                    ctx, node,
+                    "bare 'except:' swallows KeyboardInterrupt and "
+                    "SystemExit; catch Exception (or narrower) and "
+                    "log/handle it")
+                continue
+            # except Exception: pass  inside a while True loop: the
+            # actor event-loop keeps spinning with the failure invisible
+            body_is_noop = all(isinstance(n, (ast.Pass, ast.Continue))
+                               for n in node.body)
+            if body_is_noop and node.type is not None and \
+                    ctx.dotted(node.type) in ("Exception", "BaseException"):
+                in_forever = any(
+                    isinstance(a, ast.While) and self._is_forever_loop(a)
+                    for a in ctx.ancestors(node))
+                fn_between = ctx.enclosing_function(node)
+                loop_fn_ok = True
+                if in_forever and fn_between is not None:
+                    # the while True must be in the same function
+                    loop_fn_ok = any(
+                        isinstance(a, ast.While)
+                        and self._is_forever_loop(a)
+                        for a in ctx.ancestors(node)
+                        if ctx.enclosing_function(a) is fn_between)
+                if in_forever and loop_fn_ok:
+                    yield self.finding(
+                        ctx, node,
+                        "except-and-ignore inside a forever loop hides "
+                        "every failure of this event loop; at minimum "
+                        "log the exception before continuing")
+
+
+ALL_RULES: List[Rule] = [
+    NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
+    ClosureMutationInJit(), ActorCallWithoutRemote(), LeakedObjectRef(),
+    DictOrderPytree(), SwallowedException(),
+]
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -278,6 +278,19 @@ def cmd_job(args) -> int:
     raise SystemExit(f"unknown job command {args.job_cmd!r}")
 
 
+def cmd_lint(args) -> int:
+    """graftlint passthrough (same engine as `python -m ray_tpu.lint`)."""
+    from ray_tpu.lint.__main__ import main as lint_main
+    argv = list(args.paths)
+    if args.format != "text":
+        argv.append(f"--format={args.format}")
+    if args.select:
+        argv.append(f"--select={args.select}")
+    if args.ignore:
+        argv.append(f"--ignore={args.ignore}")
+    return lint_main(argv)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster CLI")
@@ -318,6 +331,14 @@ def main(argv=None) -> int:
     p.add_argument("--output", "-o", default="/tmp/ray_tpu_timeline.json")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("lint", help="framework-aware static analysis "
+                                    "(graftlint; see README)")
+    p.add_argument("paths", nargs="*", default=["."])
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", default=None, help="rule ids to run")
+    p.add_argument("--ignore", default=None, help="rule ids to skip")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("microbenchmark")
     p.add_argument("--num-ops", type=int, default=200)

@@ -335,7 +335,8 @@ def _listen_loop(handle_ref) -> None:
             # server-side park (10s) stays well under the client timeout
             # (40s) so a call queued behind a full 'control' group still
             # returns in time instead of feeding the failure counter
-            out = ray_tpu.get(
+            # long-poll: ONE in-flight call per loop turn is the design
+            out = ray_tpu.get(  # graftlint: disable=RT002
                 controller.listen_for_change.remote({name: version}, 10.0),
                 timeout=40)
             failures = 0
@@ -386,7 +387,8 @@ class _StreamingResponse:
 
     def __iter__(self):
         for ref in self._gen:
-            yield ray_tpu.get(ref)
+            # streaming: chunks are consumed in order as they land
+            yield ray_tpu.get(ref)  # graftlint: disable=RT002
 
 
 def run(app: Any, *, name: Optional[str] = None) -> DeploymentHandle:

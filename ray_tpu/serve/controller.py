@@ -392,12 +392,18 @@ class ServeController:
             extra = alive[state.target_replicas:]
             alive = alive[:state.target_replicas]
             self._stop_replicas(extra)
-            # wait for newly started replicas to answer
+            # wait for newly started replicas to answer — one batched
+            # wait bounds the whole rollout by 120s instead of 120s per
+            # replica (found by graftlint RT002); submits stay guarded
+            # per replica so one stuck/full replica can't fail deploy()
+            pings = []
             for r in alive:
                 try:
-                    ray_tpu.get(r.ping.remote(), timeout=120)
-                except Exception:  # noqa: BLE001
+                    pings.append(r.ping.remote())
+                except Exception:  # noqa: BLE001 — e.g. pending-calls full
                     pass
+            if pings:
+                ray_tpu.wait(pings, num_returns=len(pings), timeout=120)
             with self._lock:
                 if state.deleted:
                     pending_stop = alive

@@ -151,7 +151,8 @@ class BackendExecutor:
         assert self.worker_group is not None
         while True:
             try:
-                results = ray_tpu.get(
+                # the get IS batched; the loop is the restart-retry path
+                results = ray_tpu.get(  # graftlint: disable=RT002
                     [w.next_result.remote(timeout=timeout)
                      for w in self.worker_group.workers],
                     timeout=timeout + 60)

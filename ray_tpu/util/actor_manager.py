@@ -130,14 +130,29 @@ class FaultTolerantActorManager:
                 if _is_actor_failure(e):
                     self.set_actor_state(i, False)
                 refs.append((i, e))
+        # Resolve the whole fan-out in parallel: one wait bounds it by
+        # timeout_seconds TOTAL instead of timeout per actor (found by
+        # graftlint RT002), while the per-ref gets below keep per-actor
+        # failure isolation.
+        real = [r for _, r in refs if not isinstance(r, Exception)]
+        ready_set = set()
+        if real:
+            ready, _ = ray_tpu.wait(real, num_returns=len(real),
+                                    timeout=timeout_seconds)
+            ready_set = set(ready)
         out: List[CallResult] = []
         for i, ref in refs:
             if isinstance(ref, Exception):
                 out.append(CallResult(i, False, ref))
                 continue
+            if ref not in ready_set:
+                out.append(CallResult(i, False, exc.GetTimeoutError(
+                    f"actor {i} did not answer within "
+                    f"{timeout_seconds}s")))
+                continue
             try:
-                out.append(CallResult(
-                    i, True, ray_tpu.get(ref, timeout=timeout_seconds)))
+                # ready refs resolve instantly # graftlint: disable=RT002
+                out.append(CallResult(i, True, ray_tpu.get(ref)))
             except Exception as e:  # noqa: BLE001
                 if _is_actor_failure(e):
                     self.set_actor_state(i, False)
@@ -187,6 +202,8 @@ class FaultTolerantActorManager:
                 continue
             i, tag = meta
             try:
+                # only READY refs reach here; each get resolves
+                # instantly # graftlint: disable=RT002
                 out.append(CallResult(i, True, ray_tpu.get(ref), tag))
             except Exception as e:  # noqa: BLE001
                 if _is_actor_failure(e):
@@ -207,13 +224,30 @@ class FaultTolerantActorManager:
         with self._lock:
             unhealthy = [(i, a) for i, a in self._actors.items()
                          if not self._healthy.get(i)]
-        restored: List[int] = []
+        # Probe every unhealthy actor concurrently: submitting + getting
+        # one probe at a time cost timeout_seconds per dead actor (found
+        # by graftlint RT002).
+        probes: List[Tuple[int, Any]] = []
         for i, a in unhealthy:
             try:
-                ray_tpu.get(
-                    getattr(a, self._health_probe_method).remote(),
-                    timeout=timeout_seconds)
+                probes.append(
+                    (i, getattr(a, self._health_probe_method).remote()))
             except Exception:  # noqa: BLE001 - still dead
+                continue
+        refs = [r for _, r in probes]
+        ready_set: set = set()
+        if refs:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=timeout_seconds)
+            ready_set = set(ready)
+        restored: List[int] = []
+        for i, ref in probes:
+            if ref not in ready_set:
+                continue
+            try:
+                # ready refs resolve instantly # graftlint: disable=RT002
+                ray_tpu.get(ref)
+            except Exception:  # noqa: BLE001 - probe answered with error
                 continue
             restored.append(i)
             if mark_healthy:

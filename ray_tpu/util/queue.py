@@ -119,10 +119,12 @@ class Queue:
             remaining = None if deadline is None \
                 else deadline - time.time()
             if remaining is not None and remaining <= 0:
+                # blocking-queue emulation: ONE server-parked call per
+                # wait slice by design # graftlint: disable=RT002
                 return ray_tpu.get(submit(0.0))
             wait = self._WAIT_SLICE_S if remaining is None \
                 else min(self._WAIT_SLICE_S, remaining)
-            result = ray_tpu.get(submit(wait))
+            result = ray_tpu.get(submit(wait))  # graftlint: disable=RT002
             ok = result[0] if isinstance(result, tuple) else result
             if ok:
                 return result

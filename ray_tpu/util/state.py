@@ -154,6 +154,13 @@ def summarize_tasks() -> Dict[str, int]:
     return counts
 
 
+def wait_graph() -> Dict[str, Any]:
+    """Live actor waits-for graph + deadlocks-detected counter (the
+    runtime counterpart of graftlint's RT001: blocking gets between
+    actors, detected as they happen; see _private/wait_graph.py)."""
+    return _gcs().call("wait_graph_snapshot")
+
+
 def emit_event(event_type: str, message: str = "",
                severity: str = "INFO", **fields: Any) -> None:
     """Application-level structured event into the cluster event table

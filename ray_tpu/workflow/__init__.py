@@ -219,6 +219,9 @@ class _DurableExecutor:
         values: Dict[str, Any] = {}
         for step_id, ref in self._pending:
             try:
+                # ordered durable harvest: steps run concurrently
+                # regardless; each result checkpoints before the next is
+                # examined # graftlint: disable=RT002
                 value = ray_tpu.get(ref)
             except Exception as e:  # noqa: BLE001
                 if first_error is None:

@@ -285,3 +285,34 @@ def test_runtime_env_env_vars(ray_start):
     out = ray_tpu.get(read_env.options(
         runtime_env={"env_vars": {"MY_TEST_VAR": "hello"}}).remote())
     assert out == "hello"
+
+
+def test_get_wait_type_errors_name_offender(ray_start):
+    """get/wait TypeErrors name the offending type; wait(num_returns=0)
+    raises ValueError instead of silently returning ([], refs)."""
+    import pytest
+
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError, match="int"):
+        ray_tpu.get(7)
+    with pytest.raises(TypeError, match="element 1 is str"):
+        ray_tpu.get([ref, "oops"])
+    with pytest.raises(TypeError, match="bare ObjectRef"):
+        ray_tpu.wait(ref)
+    with pytest.raises(TypeError, match="set"):
+        ray_tpu.wait({ref})
+    with pytest.raises(TypeError, match="element 0 is int"):
+        ray_tpu.wait([3, ref])
+    with pytest.raises(ValueError, match="num_returns >= 1, got 0"):
+        ray_tpu.wait([ref], num_returns=0)
+    with pytest.raises(ValueError, match="got -2"):
+        ray_tpu.wait([ref], num_returns=-2)
+    # the happy path still works
+    ready, rest = ray_tpu.wait([ref], num_returns=1, timeout=10)
+    assert ready and not rest
+
+
+def test_wait_empty_drain_pattern_still_noop(ray_start):
+    """wait([], num_returns=len([])) is a common drain idiom and must
+    stay a no-op (only literal num_returns=0 on real refs raises)."""
+    assert ray_tpu.wait([], num_returns=0) == ([], [])

@@ -1,0 +1,641 @@
+"""graftlint: per-rule fixtures (positive + suppressed negative), CLI
+behavior, and the tier-1 self-hosting baseline (ray_tpu/ lints clean)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.lint import lint_paths, lint_source
+from ray_tpu.lint.rules import ALL_RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_hit(src):
+    return {f.rule_id for f in lint_source(textwrap.dedent(src), "fix.py")}
+
+
+def findings(src):
+    return lint_source(textwrap.dedent(src), "fix.py")
+
+
+# ---- RT001 nested blocking get -------------------------------------------
+
+RT001_POS = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Worker:
+        def step(self, other):
+            ref = other.ping.remote()
+            return ray_tpu.get(ref)
+"""
+
+RT001_SUPPRESSED = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Worker:
+        def step(self, other):
+            ref = other.ping.remote()
+            return ray_tpu.get(ref)  # graftlint: disable=RT001
+"""
+
+
+def test_rt001_nested_get_in_actor_method():
+    assert "RT001" in rules_hit(RT001_POS)
+
+
+def test_rt001_suppressed():
+    assert "RT001" not in rules_hit(RT001_SUPPRESSED)
+
+
+def test_rt001_remote_function():
+    src = """
+        import ray_tpu
+
+        @ray_tpu.remote
+        def fanout(refs):
+            return ray_tpu.wait(refs)
+    """
+    assert "RT001" in rules_hit(src)
+
+
+def test_rt001_not_flagged_outside_remote_context():
+    src = """
+        import ray_tpu
+
+        def driver(refs):
+            return ray_tpu.get(refs)
+    """
+    assert "RT001" not in rules_hit(src)
+
+
+# ---- RT002 get in loop ----------------------------------------------------
+
+RT002_POS = """
+    import ray_tpu
+
+    def harvest(refs):
+        out = []
+        for r in refs:
+            out.append(ray_tpu.get(r))
+        return out
+"""
+
+RT002_SUPPRESSED = """
+    import ray_tpu
+
+    def harvest(refs):
+        out = []
+        for r in refs:
+            out.append(ray_tpu.get(r))  # graftlint: disable=RT002
+        return out
+"""
+
+
+def test_rt002_get_in_loop():
+    fs = findings(RT002_POS)
+    assert any(f.rule_id == "RT002" for f in fs)
+    # findings carry file:line pointing at the get call
+    f = next(f for f in fs if f.rule_id == "RT002")
+    assert f.path == "fix.py" and f.line == 7
+
+
+def test_rt002_suppressed():
+    assert "RT002" not in rules_hit(RT002_SUPPRESSED)
+
+
+def test_rt002_comprehension_body_flagged():
+    src = """
+        import ray_tpu
+
+        def harvest(refs):
+            return [ray_tpu.get(r) for r in refs]
+    """
+    assert "RT002" in rules_hit(src)
+
+
+def test_rt002_get_as_iterable_not_flagged():
+    # the get() runs ONCE to produce the iterable — es.py regression
+    src = """
+        import ray_tpu
+
+        def harvest(refs):
+            return [x for part in ray_tpu.get(refs) for x in part]
+    """
+    assert "RT002" not in rules_hit(src)
+
+
+def test_rt002_batched_get_not_flagged():
+    src = """
+        import ray_tpu
+
+        def harvest(refs):
+            return ray_tpu.get([r for r in refs])
+    """
+    assert "RT002" not in rules_hit(src)
+
+
+# ---- RT003 host side effects in jit ---------------------------------------
+
+RT003_POS = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        print("step", x)
+        return x + np.random.normal()
+"""
+
+RT003_SUPPRESSED = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("step", x)  # graftlint: disable=RT003
+        return x + 1
+"""
+
+
+def test_rt003_host_effects_in_jit():
+    hit = findings(RT003_POS)
+    msgs = [f for f in hit if f.rule_id == "RT003"]
+    assert len(msgs) == 2  # print AND np.random
+    assert any("print" in f.message for f in msgs)
+
+
+def test_rt003_suppressed():
+    assert "RT003" not in rules_hit(RT003_SUPPRESSED)
+
+
+def test_rt003_scan_body_and_partial_jit():
+    src = """
+        import time
+        from functools import partial
+        import jax
+
+        def sweep(xs):
+            def body(carry, x):
+                time.sleep(0.1)
+                return carry, x
+            return jax.lax.scan(body, 0, xs)
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, n):
+            t0 = time.time()
+            return x * n
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT003"]
+    assert len(fs) == 2
+
+
+def test_rt003_jax_debug_allowed():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("x = {x}", x=x)
+            return x + 1
+    """
+    assert "RT003" not in rules_hit(src)
+
+
+def test_rt003_method_name_collision_not_traced():
+    # a method merely SHARING a name with a jitted nested def must not
+    # be treated as traced (learner.py regression)
+    src = """
+        import jax
+
+        class Learner:
+            def build(self):
+                def update(p, x):
+                    return p + x
+                self._fn = jax.jit(update)
+
+            def update(self, batch):
+                print("host-side logging is fine here")
+                return self._fn(0, batch)
+    """
+    assert "RT003" not in rules_hit(src)
+
+
+# ---- RT004 closure mutation in jit ----------------------------------------
+
+RT004_POS = """
+    import jax
+
+    class Learner:
+        def build(self):
+            @jax.jit
+            def step(x):
+                self.calls = self.calls + 1
+                return x + 1
+            self._fn = step
+"""
+
+RT004_SUPPRESSED = """
+    import jax
+
+    class Learner:
+        def build(self):
+            @jax.jit
+            def step(x):
+                self.calls = self.calls + 1  # graftlint: disable=RT004
+                return x + 1
+            self._fn = step
+"""
+
+
+def test_rt004_self_mutation_in_jit():
+    assert "RT004" in rules_hit(RT004_POS)
+
+
+def test_rt004_suppressed():
+    assert "RT004" not in rules_hit(RT004_SUPPRESSED)
+
+
+def test_rt004_nonlocal_and_closure_append():
+    src = """
+        import jax
+
+        def build():
+            seen = []
+            count = 0
+
+            @jax.jit
+            def step(x):
+                nonlocal count
+                count = count + 1
+                seen.append(x)
+                return x
+
+            return step
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT004"]
+    assert len(fs) == 2  # the nonlocal decl and the .append
+
+
+def test_rt004_local_mutation_fine():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(xs):
+            out = []
+            for x in xs:
+                out.append(x + 1)
+            return out
+    """
+    assert "RT004" not in rules_hit(src)
+
+
+def test_rt004_pure_optax_update_fine():
+    # `u, s = optimizer.update(...)` assigns the result: pure API
+    src = """
+        import jax
+
+        def build(optimizer):
+            @jax.jit
+            def step(params, opt_state, grads):
+                updates, opt_state = optimizer.update(grads, opt_state)
+                return updates, opt_state
+            return step
+    """
+    assert "RT004" not in rules_hit(src)
+
+
+# ---- RT005 actor call without .remote() -----------------------------------
+
+RT005_POS = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Counter:
+        def incr(self):
+            return 1
+
+    def main():
+        c = Counter.remote()
+        c.incr()
+"""
+
+RT005_SUPPRESSED = """
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Counter:
+        def incr(self):
+            return 1
+
+    def main():
+        c = Counter.remote()
+        c.incr()  # graftlint: disable=RT005
+"""
+
+
+def test_rt005_call_without_remote():
+    fs = [f for f in findings(RT005_POS) if f.rule_id == "RT005"]
+    assert len(fs) == 1
+    assert "c.incr" in fs[0].message
+
+
+def test_rt005_suppressed():
+    assert "RT005" not in rules_hit(RT005_SUPPRESSED)
+
+
+def test_rt005_proper_remote_call_fine():
+    src = """
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Counter:
+            def incr(self):
+                return 1
+
+        def main():
+            c = Counter.options(num_cpus=1).remote()
+            ref = c.incr.remote()
+            return ray_tpu.get(ref)
+    """
+    assert "RT005" not in rules_hit(src)
+
+
+# ---- RT006 leaked ObjectRef -----------------------------------------------
+
+RT006_POS = """
+    def kick(worker):
+        worker.step.remote()
+"""
+
+RT006_SUPPRESSED = """
+    def kick(worker):
+        # fire-and-forget heartbeat; failures handled by health probes
+        worker.step.remote()  # graftlint: disable=RT006
+"""
+
+
+def test_rt006_leaked_ref():
+    assert "RT006" in rules_hit(RT006_POS)
+
+
+def test_rt006_suppressed():
+    assert "RT006" not in rules_hit(RT006_SUPPRESSED)
+
+
+def test_rt006_assigned_ref_fine():
+    src = """
+        def kick(worker):
+            ref = worker.step.remote()
+            return ref
+    """
+    assert "RT006" not in rules_hit(src)
+
+
+# ---- RT007 dict-order pytrees ---------------------------------------------
+
+RT007_POS = """
+    import jax
+
+    @jax.jit
+    def step(params):
+        return {k: v * 2 for k, v in params.items()}
+"""
+
+RT007_SUPPRESSED = """
+    import jax
+
+    @jax.jit
+    def step(params):
+        # graftlint: disable=RT007
+        return {k: v * 2 for k, v in params.items()}
+"""
+
+
+def test_rt007_dict_iteration_in_traced_code():
+    assert "RT007" in rules_hit(RT007_POS)
+
+
+def test_rt007_suppressed():
+    assert "RT007" not in rules_hit(RT007_SUPPRESSED)
+
+
+def test_rt007_sorted_iteration_fine():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(params):
+            return {k: v * 2 for k, v in sorted(params.items())}
+    """
+    assert "RT007" not in rules_hit(src)
+
+
+def test_rt007_plain_host_code_fine():
+    src = """
+        def summarize(stats):
+            return {k: float(v) for k, v in stats.items()}
+    """
+    assert "RT007" not in rules_hit(src)
+
+
+# ---- RT008 swallowed exceptions -------------------------------------------
+
+RT008_POS = """
+    def loop(q):
+        while True:
+            try:
+                q.drain()
+            except Exception:
+                pass
+"""
+
+RT008_SUPPRESSED = """
+    def loop(q):
+        while True:
+            try:
+                q.drain()
+            except Exception:  # graftlint: disable=RT008
+                pass
+"""
+
+
+def test_rt008_except_pass_in_forever_loop():
+    assert "RT008" in rules_hit(RT008_POS)
+
+
+def test_rt008_suppressed():
+    assert "RT008" not in rules_hit(RT008_SUPPRESSED)
+
+
+def test_rt008_bare_except():
+    src = """
+        def f():
+            try:
+                g()
+            except:
+                pass
+    """
+    assert "RT008" in rules_hit(src)
+
+
+def test_rt008_bare_except_reraise_fine():
+    src = """
+        def f():
+            try:
+                g()
+            except:
+                cleanup()
+                raise
+    """
+    assert "RT008" not in rules_hit(src)
+
+
+def test_rt008_logged_handler_fine():
+    src = """
+        import logging
+
+        def loop(q):
+            while True:
+                try:
+                    q.drain()
+                except Exception:
+                    logging.exception("drain failed")
+    """
+    assert "RT008" not in rules_hit(src)
+
+
+# ---- engine behavior ------------------------------------------------------
+
+def test_suppress_all_and_stacked_comment():
+    src = """
+        import ray_tpu
+
+        def harvest(refs):
+            out = []
+            for r in refs:
+                out.append(ray_tpu.get(r))  # noqa: X  graftlint: disable=all
+            return out
+    """
+    assert rules_hit(src) == set()
+
+
+def test_syntax_error_reported_not_raised():
+    fs = lint_source("def broken(:\n", "bad.py")
+    assert [f.rule_id for f in fs] == ["RT000"]
+
+
+def test_alias_resolution():
+    src = """
+        import ray_tpu as rt
+
+        def harvest(refs):
+            return [rt.get(r) for r in refs]
+    """
+    assert "RT002" in rules_hit(src)
+
+
+def test_rule_catalogue_complete():
+    ids = [r.id for r in ALL_RULES]
+    assert ids == [f"RT00{i}" for i in range(1, 9)]
+    assert all(r.rationale for r in ALL_RULES)
+
+
+# ---- CLI ------------------------------------------------------------------
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    from ray_tpu.lint.__main__ import main
+    bad = _write(tmp_path, "bad.py", RT006_POS)
+    clean = _write(tmp_path, "clean.py", "x = 1\n")
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert main([bad, "--format=json"]) == 1
+    payload = json.loads(buf.getvalue())
+    assert payload and payload[0]["rule"] == "RT006"
+    # line 3: the fixture string starts with a blank line
+    assert payload[0]["path"] == bad and payload[0]["line"] == 3
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert main([clean]) == 0
+    assert buf.getvalue().strip() == ""
+
+
+def test_cli_select_and_ignore(tmp_path):
+    from ray_tpu.lint.__main__ import main
+    bad = _write(tmp_path, "two.py", """
+        import ray_tpu
+
+        def harvest(refs, worker):
+            worker.step.remote()
+            return [ray_tpu.get(r) for r in refs]
+    """)
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert main([bad, "--select=RT006", "--format=json"]) == 1
+    assert {f["rule"] for f in json.loads(buf.getvalue())} == {"RT006"}
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert main([bad, "--ignore=RT002,RT006"]) == 0
+
+
+def test_cli_module_invocation():
+    """`python -m ray_tpu.lint --list-rules` works as a subprocess."""
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "RT001" in out.stdout and "RT008" in out.stdout
+
+
+# ---- tier-1 self-hosting baseline -----------------------------------------
+
+def test_ray_tpu_package_lints_clean():
+    """The zero-findings baseline: the framework passes its own linter.
+    Any new finding means either a real bug crept in or an intentional
+    pattern is missing its `# graftlint: disable=...` justification."""
+    pkg = os.path.join(REPO_ROOT, "ray_tpu")
+    fs = lint_paths([pkg])
+    assert fs == [], "\n" + "\n".join(f.format() for f in fs)
+
+
+def test_tools_lint_runner_clean():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "lint.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_cli_nonexistent_path_exits_2(tmp_path):
+    """A typo'd path must fail loudly (exit 2), not lint nothing and
+    report a green zero-findings gate."""
+    from ray_tpu.lint.__main__ import main
+    assert main([str(tmp_path / "no_such_dir")]) == 2
+
+
+def test_cli_unknown_rule_id_exits_2():
+    """--select/--ignore with a typo'd rule id must fail loudly, not
+    run zero rules and report a green gate."""
+    from ray_tpu.lint.__main__ import main
+    assert main([".", "--select=RT999"]) == 2
+    assert main([".", "--ignore=RT01,RT002"]) == 2

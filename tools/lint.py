@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""CI lint runner: `python tools/lint.py [paths...]`.
+
+Thin wrapper over `python -m ray_tpu.lint` that defaults to linting the
+ray_tpu package itself (the checked-in zero-findings baseline). Exits
+non-zero on any finding so CI fails the PR; `--format=json` feeds
+dashboards and future tooling. Fast and JAX_PLATFORMS=cpu-safe: pure
+AST analysis, nothing under test is imported.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, _REPO_ROOT)
+    from ray_tpu.lint.__main__ import main as lint_main
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not any(not a.startswith("-") for a in argv):
+        argv.append(os.path.join(_REPO_ROOT, "ray_tpu"))
+    return lint_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
