@@ -13,6 +13,7 @@ Importing ray_tpu is deliberately jax-free and fast; ML subpackages
 lazily on first use.
 """
 
+from ray_tpu import chaos  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
 from ray_tpu.actor import (ActorClass, ActorHandle, get_actor,  # noqa: F401
@@ -30,6 +31,6 @@ __all__ = [
     "remote", "init",
     "shutdown", "is_initialized", "get", "put", "wait", "kill", "cancel",
     "free", "nodes", "cluster_resources", "available_resources",
-    "get_gcs_address", "get_runtime_context", "exceptions", "RemoteFunction",
-    "timeline", "__version__",
+    "get_gcs_address", "get_runtime_context", "exceptions", "chaos",
+    "RemoteFunction", "timeline", "__version__",
 ]

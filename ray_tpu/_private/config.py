@@ -41,17 +41,33 @@ class Config:
     # Scheduling
     lease_request_timeout_s = _define("lease_request_timeout_s", 120.0, float)
     resource_report_period_s = _define("resource_report_period_s", 0.5, float)
-    # Health
+    # Health (reference gcs_health_check_manager.h): probe period and the
+    # number of CONSECUTIVE failed probes before a node is declared dead —
+    # one chaos-delayed or GC-paused probe must never kill a healthy node.
     health_check_period_s = _define("health_check_period_s", 2.0, float)
+    health_check_failure_threshold = _define(
+        "health_check_failure_threshold", 3, int)
     # Task retries (reference: default max_retries=3 for tasks)
     default_task_max_retries = _define("default_task_max_retries", 3, int)
-    # Chaos testing: inject random handler delays up to this many micros
-    # (reference: RAY_testing_asio_delay_us, asio_chaos.cc).
+    # DEPRECATED (compat shim): random RPC handler delays up to this many
+    # micros (reference RAY_testing_asio_delay_us, asio_chaos.cc). Now a
+    # startup-installed `delay` rule in the chaos plane — use
+    # ray_tpu.chaos.inject("delay", delay_ms=..., jitter=True, seed=...)
+    # instead; see _private/chaos.py.
     testing_rpc_delay_us = _define("testing_rpc_delay_us", 0, int)
     # OOM defense (reference memory_usage_threshold, ray_config_def.h:77)
     memory_usage_threshold = _define("memory_usage_threshold", 0.95, float)
     memory_monitor_refresh_ms = _define("memory_monitor_refresh_ms",
                                         1000, int)
+
+
+if Config.testing_rpc_delay_us:
+    import warnings
+
+    warnings.warn(
+        "RAY_TPU_testing_rpc_delay_us/_seed are deprecated; use the chaos "
+        "plane (ray_tpu.chaos.inject('delay', delay_ms=..., jitter=True, "
+        "seed=...)) instead", DeprecationWarning, stacklevel=2)
 
 
 def get(name: str) -> Any:

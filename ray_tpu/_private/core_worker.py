@@ -220,6 +220,18 @@ class CoreWorker:
         except Exception:  # noqa: BLE001
             logger.warning("could not subscribe to GCS events",
                            exc_info=True)
+        # Chaos plane (_private/chaos.py): identify this process to the
+        # fault-injection hooks, pick up the current policy (pubsub only
+        # reaches processes alive at publish time), and follow updates.
+        from ray_tpu._private import chaos as chaos_lib
+        chaos_lib.client().set_context(
+            node_id=node_id_hex, is_worker=(mode == "worker"),
+            gcs_address=self.gcs_address)
+        chaos_lib.fetch_policy(self._gcs.call)
+        try:
+            self.subscribe("chaos", chaos_lib.on_policy_message)
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------------------------------------
     # Context
@@ -2097,6 +2109,11 @@ class _Executor:
                 if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                     cls = cw.import_function(spec.function_key)
                     args, kwargs = self._resolve_args(spec)
+                    # kill_worker chaos rules select by actor class;
+                    # tagged before __init__ runs so pushes dispatched
+                    # during a slow constructor already match
+                    from ray_tpu._private import chaos as chaos_lib
+                    chaos_lib.client().set_actor_class(spec.function_name)
                     self.actor_instance = cls(*args, **kwargs)
                     self.actor_id = spec.actor_id
                     cw._gcs.call("report_actor_alive",

@@ -128,11 +128,21 @@ class PersistentStore(InMemoryStore):
 class GcsServer:
     """The control-plane process (can be hosted in a thread or standalone)."""
 
+    # Class-level defaults; __init__ reads the live values from Config so
+    # operators can tune them per-cluster (reference
+    # gcs_health_check_manager.h: health_check_period_ms +
+    # health_check_failure_threshold). K consecutive probe failures are
+    # required before a node is declared dead — a single chaos-delayed or
+    # GC-paused probe must not kill a healthy node.
     HEALTH_CHECK_PERIOD_S = 2.0
     HEALTH_CHECK_FAILURES_TO_DEAD = 3
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persist_path: Optional[str] = None):
+        from ray_tpu._private.config import Config
+        self.HEALTH_CHECK_PERIOD_S = Config.health_check_period_s
+        self.HEALTH_CHECK_FAILURES_TO_DEAD = max(
+            1, Config.health_check_failure_threshold)
         # Pluggable storage (reference StoreClient): file-backed when a
         # persist path is given (env RAY_TPU_GCS_PERSIST_PATH works too),
         # so KV state — function table, job metadata, checkpoint pointers
@@ -172,6 +182,12 @@ class GcsServer:
         # cycle-at-insert deadlock detection; see _private/wait_graph.py.
         from ray_tpu._private.wait_graph import WaitGraph
         self.wait_graph = WaitGraph()
+        # Chaos plane (see _private/chaos.py): ordered rule list + the
+        # cluster-wide fired-count aggregate, distributed over pubsub.
+        self.chaos_rules: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.chaos_fired: Dict[str, int] = {}
+        self.chaos_version = 0
+        self._chaos_rule_counter = 0
         self._dead = False
 
         # Reload the persisted actor directory (reference GcsInitData:
@@ -236,6 +252,12 @@ class GcsServer:
             "wait_graph_add": self.wait_graph_add,
             "wait_graph_remove": self.wait_graph_remove,
             "wait_graph_snapshot": self.wait_graph_snapshot,
+            # chaos plane (_private/chaos.py)
+            "chaos_inject": self.chaos_inject,
+            "chaos_clear": self.chaos_clear,
+            "chaos_list": self.chaos_list,
+            "chaos_get_policy": self.chaos_get_policy,
+            "chaos_report_fired": self.chaos_report_fired,
             # pubsub (reference InternalPubSubGcsService)
             "subscribe": self.subscribe,
             "publish": self.publish,
@@ -620,6 +642,103 @@ class GcsServer:
 
     def wait_graph_snapshot(self) -> Dict[str, Any]:
         return self.wait_graph.snapshot()
+
+    # ---- chaos plane (_private/chaos.py) --------------------------------
+
+    def _chaos_policy_locked(self) -> Dict[str, Any]:
+        return {"version": self.chaos_version,
+                "rules": [dict(r) for r in self.chaos_rules.values()]}
+
+    def _chaos_publish(self) -> None:
+        """Push the policy to every subscriber AND install it into this
+        process's own chaos client (the GCS's RPC server is a hook point
+        too; in-process head nodes share this client with the driver)."""
+        with self._lock:
+            policy = self._chaos_policy_locked()
+        from ray_tpu._private import chaos as chaos_lib
+        chaos_lib.client().install(policy)
+        self.publish("chaos", policy)
+
+    def chaos_inject(self, rules: List[Dict[str, Any]]) -> List[str]:
+        """Append rules to the policy (ordered). Fills in each rule's
+        node-address map from the live node table so partition /
+        node-targeted rules can match peer addresses, then distributes
+        the bumped policy over pubsub."""
+        from ray_tpu._private.chaos import FAULT_TYPES, ChaosRule
+        with self._lock:
+            node_addrs = {
+                nid: [tuple(n.address), tuple(n.store_address)]
+                for nid, n in self.nodes.items() if n.alive}
+            ids = []
+            for rec in rules:
+                rule = ChaosRule.from_dict(rec)
+                if rule.fault not in FAULT_TYPES:
+                    raise ValueError(
+                        f"unknown chaos fault {rule.fault!r} "
+                        f"(one of {FAULT_TYPES})")
+                if not rule.rule_id:
+                    self._chaos_rule_counter += 1
+                    rule.rule_id = f"cr-{self._chaos_rule_counter:04d}"
+                if not rule.node_addrs:
+                    rule.node_addrs = node_addrs
+                self.chaos_rules[rule.rule_id] = rule.to_dict()
+                self.chaos_fired.setdefault(rule.rule_id, 0)
+                ids.append(rule.rule_id)
+            self.chaos_version += 1
+        for rid in ids:
+            self._emit("CHAOS_RULE_INSTALLED",
+                       f"chaos rule {rid} installed", severity="WARNING",
+                       rule_id=rid,
+                       fault=self.chaos_rules[rid]["fault"])
+        self._chaos_publish()
+        return ids
+
+    def chaos_clear(self, rule_ids: Optional[List[str]] = None) -> int:
+        with self._lock:
+            doomed = list(self.chaos_rules) if rule_ids is None \
+                else [r for r in rule_ids if r in self.chaos_rules]
+            for rid in doomed:
+                del self.chaos_rules[rid]
+            if doomed:
+                self.chaos_version += 1
+        if doomed:
+            self._chaos_publish()
+        return len(doomed)
+
+    def chaos_list(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"version": self.chaos_version,
+                    "rules": [{**dict(r), "fired": self.chaos_fired.get(
+                        rid, 0)} for rid, r in self.chaos_rules.items()]}
+
+    def chaos_get_policy(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._chaos_policy_locked()
+
+    def chaos_report_fired(self, rule_id: str, fault: str = "",
+                           where: str = "", node_id: str = "") -> None:
+        """A process fired a rule: aggregate the count, audit it as a
+        cluster event, and retire the rule cluster-wide once its
+        max_fires budget is spent (per-process counters alone can't
+        bound fires across worker restarts)."""
+        disable = False
+        with self._lock:
+            self.chaos_fired[rule_id] = \
+                self.chaos_fired.get(rule_id, 0) + 1
+            rule = self.chaos_rules.get(rule_id)
+            if rule is not None and rule.get("max_fires", -1) >= 0 and \
+                    self.chaos_fired[rule_id] >= rule["max_fires"]:
+                rule["disabled"] = True
+                self.chaos_version += 1
+                disable = True
+        self._emit("CHAOS_FAULT_INJECTED",
+                   f"chaos rule {rule_id} fired {fault} at {where}",
+                   severity="WARNING", rule_id=rule_id, fault=fault,
+                   node_id=node_id)
+        if disable:
+            logger.warning("chaos: rule %s reached max_fires; disabling "
+                           "cluster-wide", rule_id)
+            self._chaos_publish()
 
     def _emit(self, event_type: str, message: str,
               severity: str = "INFO", **fields: Any) -> None:

@@ -155,6 +155,10 @@ class NodeManager:
 
         self.server = rpc_lib.RpcServer({
             "nm_ping": lambda: "pong",
+            # chaos-policy pubsub lands here too (the GCS publishes to
+            # subscriber addresses via this one method name)
+            "cw_pubsub_push": self._on_pubsub_push,
+            "nm_chaos_kill_worker": self.chaos_kill_worker,
             "nm_register_worker": self.register_worker,
             "nm_request_lease": self.request_lease,
             "nm_cancel_lease": self.cancel_lease,
@@ -197,6 +201,20 @@ class NodeManager:
         self.log_monitor = LogMonitor(
             os.path.join(self.session_dir, "logs"), self.gcs_address,
             self.node_id.hex())
+        # Chaos plane (_private/chaos.py): this daemon is the kill_worker
+        # actuator for rules targeting this node, and must track policy
+        # updates (fetch now + follow the "chaos" pubsub channel).
+        from ray_tpu._private import chaos as chaos_lib
+        chaos_lib.client().set_context(node_id=self.node_id.hex(),
+                                       gcs_address=self.gcs_address)
+        chaos_lib.client().set_kill_actuator(self.chaos_kill_worker)
+        chaos_lib.fetch_policy(self._gcs.call)
+        self._chaos_token = uuid.uuid4().hex
+        try:
+            self._gcs.call("subscribe", channel="chaos",
+                           address=self.address, token=self._chaos_token)
+        except Exception:  # noqa: BLE001 - chaos updates degrade to fetch
+            pass
 
     # ---- resource sync ---------------------------------------------------
 
@@ -907,6 +925,46 @@ class NodeManager:
                 self.resources_total.subtract(ResourceSet(add))
                 self.available.subtract(ResourceSet(add))
                 self.available.add(ResourceSet(resources))
+
+    # ---- chaos plane (_private/chaos.py) --------------------------------
+
+    def _on_pubsub_push(self, channel: str, token: str,
+                        message: Any) -> None:
+        """GCS pubsub delivery into this daemon (currently only the
+        chaos-policy channel subscribes with the NM's address)."""
+        if channel == "chaos":
+            from ray_tpu._private import chaos as chaos_lib
+            chaos_lib.on_policy_message(message)
+
+    def chaos_kill_worker(self, actor_class: str = "") -> bool:
+        """kill_worker actuator: SIGKILL one live local worker whose
+        hosted actor class matches the glob (empty glob prefers busy
+        task workers, then anything registered). Simulates a preempted
+        TPU worker — death detection, task retries, and actor restarts
+        proceed through the normal machinery. Returns True if a worker
+        was killed."""
+        import fnmatch as _fnmatch
+        with self._lock:
+            live = [h for h in self.workers.values()
+                    if h.proc is not None and h.registered]
+            if actor_class:
+                pool = [h for h in live if h.is_actor
+                        and h.current_task is not None
+                        and _fnmatch.fnmatchcase(
+                            h.current_task.function_name, actor_class)]
+            else:
+                pool = sorted(live, key=lambda h: not bool(h.current_task))
+            victim = pool[0] if pool else None
+        if victim is None:
+            return False
+        logger.warning("chaos: killing worker %s (%s)",
+                       victim.worker_id.hex()[:12],
+                       actor_class or "any")
+        try:
+            victim.proc.kill()
+        except OSError:
+            return False
+        return True
 
     # ---- misc ------------------------------------------------------------
 

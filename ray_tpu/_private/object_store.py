@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import chaos as chaos_lib
 from ray_tpu._private import rpc as rpc_lib
 
 logger = logging.getLogger(__name__)
@@ -290,6 +291,7 @@ class StoreServer:
         by the owner's refcount) removes them. Pulled replica copies are
         created unpinned and evictable (the primary exists elsewhere).
         """
+        chaos_lib.on_store_op("store_create", [object_id], self)
         with self._lock:
             if object_id in self._objects:
                 e = self._objects[object_id]
@@ -340,6 +342,7 @@ class StoreServer:
              num_required: Optional[int] = None) -> Dict[str, Tuple]:
         """Block until objects are sealed locally; returns {id: descriptor}.
         Objects not present locally are NOT fetched here (see pull)."""
+        chaos_lib.on_store_op("store_wait", list(object_ids), self)
         deadline = None if timeout is None else time.time() + timeout
         num_required = len(object_ids) if num_required is None else num_required
         with self._sealed_cv:
@@ -368,6 +371,27 @@ class StoreServer:
         with self._lock:
             for oid in object_ids:
                 self._delete_locked(oid)
+
+    def chaos_evict(self, object_glob: Optional[str],
+                    op_object_ids: List[str]) -> int:
+        """Actuator for `evict_object` chaos rules: drop matching sealed
+        objects from this store even if pinned (simulating loss of the
+        primary, the case lineage reconstruction exists for). With no
+        glob, the objects named in the triggering op are evicted."""
+        import fnmatch as _fnmatch
+        with self._lock:
+            if object_glob:
+                victims = [oid for oid in self._objects
+                           if _fnmatch.fnmatchcase(oid, object_glob)]
+            else:
+                victims = [oid for oid in op_object_ids
+                           if oid in self._objects]
+            for oid in victims:
+                self._delete_locked(oid)
+        if victims:
+            logger.warning("chaos: evicted %d object(s) from store %s",
+                           len(victims), self.address)
+        return len(victims)
 
     def pin(self, object_id: str) -> None:
         with self._lock:
@@ -401,6 +425,7 @@ class StoreServer:
              size: int) -> Tuple:
         """Pull an object from a peer store into this one (chunked).
         reference parity: pull_manager.h / push_manager.h chunk streaming."""
+        chaos_lib.on_store_op("store_pull", [object_id], self)
         while True:
             with self._lock:
                 e = self._objects.get(object_id)

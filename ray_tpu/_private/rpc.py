@@ -13,12 +13,16 @@ from __future__ import annotations
 
 import logging
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private import chaos as chaos_lib
 
 _LEN = struct.Struct(">Q")
 
@@ -60,32 +64,14 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
-_chaos_rng = None
-
-
 def _chaos_delay() -> None:
-    """Chaos testing: inject a random handler delay (reference
-    asio_chaos.cc:29-40, env RAY_testing_asio_delay_us). Set
-    RAY_TPU_testing_rpc_delay_us to randomize RPC handler latencies and
-    surface race/ordering bugs in tests. With
-    RAY_TPU_testing_rpc_delay_seed also set, every process draws from
-    the SAME seeded stream, so sweeping seeds explores different delay
-    schedules and re-running a seed replays the per-process schedules
-    (best effort — OS scheduling nondeterminism still varies the
-    interleaving across runs; the reference relies on TSAN + the same
-    asio randomization)."""
-    from ray_tpu._private.config import Config
-    max_us = Config.testing_rpc_delay_us
-    if max_us > 0:
-        import random
-        import time
-        global _chaos_rng
-        if _chaos_rng is None:
-            import os
-            seed = os.environ.get("RAY_TPU_testing_rpc_delay_seed")
-            _chaos_rng = random.Random(
-                None if seed is None else int(seed))
-        time.sleep(_chaos_rng.uniform(0, max_us) / 1e6)
+    """Compat shim. The randomized handler delay that used to live here
+    (reference asio_chaos.cc:29-40, env RAY_TPU_testing_rpc_delay_us) is
+    now a startup-installed `delay` rule in the chaos plane
+    (_private/chaos.py; the env vars still work but are deprecated —
+    see _private/config.py). Kept for callers/tests that invoke the
+    delay point directly."""
+    chaos_lib.on_server_dispatch("_legacy_delay_hook")
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -115,7 +101,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     method, kwargs, oneway = item
                 else:
                     (method, kwargs), oneway = item, False
-                _chaos_delay()
+                # chaos plane server hook: delay / kill_worker rules
+                # (subsumes the old _chaos_delay env-var injection)
+                chaos_lib.on_server_dispatch(method)
                 try:
                     handler = server.handlers[method]
                 except KeyError:
@@ -239,14 +227,32 @@ class RpcClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
+    # Reconnect-retry budget for idempotent control-plane calls: a
+    # transient drop (server restart, chaos drop_connection on the peer,
+    # GC pause) must not cascade into OwnerDiedError/ConnectionLost at
+    # the caller. Capped exponential backoff with full jitter; the first
+    # retry is immediate (the common case is a stale pooled connection).
+    IDEMPOTENT_RETRIES = 4
+    _BACKOFF_BASE_S = 0.05
+    _BACKOFF_CAP_S = 1.0
+
     def call(self, method: str, **kwargs: Any) -> Any:
         payload = pickle.dumps((method, kwargs), protocol=5)
+        idempotent = _is_idempotent(method)
+        max_attempts = 1 + (self.IDEMPOTENT_RETRIES if idempotent else 1)
         with self._lock:
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._sock = self._connect()
+            for attempt in range(max_attempts):
                 sent = False
                 try:
+                    # chaos plane client hook: drop_connection /
+                    # partition rules raise ConnectionLost here, before
+                    # anything is sent — each retry attempt re-consults
+                    # the policy, so an injected drop behaves exactly
+                    # like a real broken socket (retried with backoff
+                    # for idempotent methods, surfaced otherwise)
+                    chaos_lib.on_client_call(method, self.address)
+                    if self._sock is None:
+                        self._sock = self._connect()
                     _send_frame(self._sock, payload)
                     sent = True
                     reply = _recv_frame(self._sock)
@@ -255,14 +261,18 @@ class RpcClient:
                         OSError):
                     self.close_locked()
                     # Retry when the request never left this client
-                    # (stale pooled connection died on send) OR the
+                    # (stale pooled connection / refused connect) OR the
                     # method is idempotent. After a successful send a
                     # non-idempotent handler may have executed —
                     # re-sending would duplicate it.
-                    if attempt == 1 or (sent and
-                                        not _is_idempotent(method)):
+                    if attempt + 1 >= max_attempts or \
+                            (sent and not idempotent):
                         raise ConnectionLost(
                             f"rpc to {self.address} failed: {method}")
+                    if attempt >= 1:
+                        backoff = min(self._BACKOFF_CAP_S,
+                                      self._BACKOFF_BASE_S * (2 ** (attempt - 1)))
+                        time.sleep(backoff * random.uniform(0.5, 1.0))
         status, result = pickle.loads(reply)
         if status != "ok":
             if isinstance(result, tuple) and len(result) == 2:
@@ -290,9 +300,10 @@ class RpcClient:
         payload = pickle.dumps((method, kwargs, True), protocol=5)
         with self._lock:
             for attempt in (0, 1):
-                if self._sock is None:
-                    self._sock = self._connect()
                 try:
+                    chaos_lib.on_client_call(method, self.address)
+                    if self._sock is None:
+                        self._sock = self._connect()
                     _send_frame(self._sock, payload)
                     return
                 except (ConnectionLost, ConnectionResetError,

@@ -210,6 +210,10 @@ def shutdown() -> None:
         w.core_worker.shutdown()
     except Exception:  # noqa: BLE001
         pass
+    # drop cluster-scoped chaos context/rules (a re-init may join a
+    # different cluster with different node ids and policy)
+    from ray_tpu._private import chaos as chaos_lib
+    chaos_lib.client().reset()
     try:
         atexit.unregister(shutdown)
     except Exception:  # noqa: BLE001

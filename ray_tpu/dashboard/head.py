@@ -244,6 +244,10 @@ class DashboardHead:
             # live actor waits-for edges + deadlocks-detected counter
             # (runtime counterpart of graftlint RT001)
             return s.wait_graph()
+        if route == "/api/chaos":
+            # installed chaos rules + cluster-wide fired counts
+            # (_private/chaos.py; `ray_tpu chaos` CLI equivalent)
+            return s.chaos_rules()
         if route == "/api/events":
             return s.list_cluster_events(
                 event_type=params.get("type"),

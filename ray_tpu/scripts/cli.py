@@ -278,6 +278,46 @@ def cmd_job(args) -> int:
     raise SystemExit(f"unknown job command {args.job_cmd!r}")
 
 
+def cmd_chaos(args) -> int:
+    """Chaos plane control (see README "Chaos testing"): list installed
+    rules + fired counts, inject a rule, or clear rules."""
+    _connect(args)
+    from ray_tpu import chaos
+    if args.chaos_cmd == "list":
+        rules = chaos.list_rules()
+        if args.format == "json":
+            print(json.dumps(rules, default=str))
+            return 0
+        _print_table(
+            [{**r, "nodes": ",".join(n for n in r.get("nodes", ()) if n)
+              or "-", "selector": r.get("method", "*")}
+             for r in rules],
+            ["rule_id", "fault", "selector", "actor_class", "after_n",
+             "max_fires", "probability", "disabled", "fired"])
+        return 0
+    if args.chaos_cmd == "inject":
+        kwargs = {}
+        if args.method:
+            kwargs["method"] = args.method
+        if args.nodes:
+            a, _, b = args.nodes.partition(",")
+            kwargs["nodes"] = (a, b)
+        rid = chaos.inject(
+            args.fault, node_id=args.node_id,
+            actor_class=args.actor_class, object_glob=args.object_glob,
+            probability=args.probability, seed=args.seed,
+            after_n=args.after_n, max_fires=args.max_fires,
+            delay_ms=args.delay_ms, jitter=args.jitter,
+            error_message=args.error_message, **kwargs)
+        print(rid)
+        return 0
+    if args.chaos_cmd == "clear":
+        n = chaos.clear(args.rule_ids or None)
+        print(f"cleared {n} rule(s)")
+        return 0
+    raise SystemExit(f"unknown chaos command {args.chaos_cmd!r}")
+
+
 def cmd_lint(args) -> int:
     """graftlint passthrough (same engine as `python -m ray_tpu.lint`)."""
     from ray_tpu.lint.__main__ import main as lint_main
@@ -339,6 +379,34 @@ def main(argv=None) -> int:
     p.add_argument("--select", default=None, help="rule ids to run")
     p.add_argument("--ignore", default=None, help="rule ids to skip")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("chaos", help="fault injection: list/inject/clear "
+                                     "chaos rules (see README)")
+    p.add_argument("chaos_cmd", choices=["list", "inject", "clear"])
+    p.add_argument("rule_ids", nargs="*", help="clear: rule ids "
+                                               "(default: all)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--fault", default="delay",
+                   help="inject: delay|drop_connection|partition|"
+                        "kill_worker|error|evict_object")
+    p.add_argument("--method", default=None, help="RPC/store-op glob")
+    p.add_argument("--node-id", default="", help="node id hex prefix")
+    p.add_argument("--nodes", default="",
+                   help="partition pair 'hexA,hexB'")
+    p.add_argument("--actor-class", default="", help="actor class glob")
+    p.add_argument("--object-glob", default="", help="object id glob")
+    p.add_argument("--probability", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--after-n", type=int, default=0,
+                   help="skip the first N matching calls")
+    p.add_argument("--max-fires", type=int, default=-1,
+                   help="stop after N fires (1 = one-shot; -1 = inf)")
+    p.add_argument("--delay-ms", type=float, default=0.0)
+    p.add_argument("--jitter", action="store_true",
+                   help="delay: uniform(0, delay_ms) from the seeded rng")
+    p.add_argument("--error-message", default="")
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("microbenchmark")
     p.add_argument("--num-ops", type=int, default=200)

@@ -161,6 +161,12 @@ def wait_graph() -> Dict[str, Any]:
     return _gcs().call("wait_graph_snapshot")
 
 
+def chaos_rules() -> Dict[str, Any]:
+    """Installed chaos rules + cluster-wide fired counts (the runtime
+    view behind `ray_tpu chaos list` and the dashboard /api/chaos)."""
+    return _gcs().call("chaos_list")
+
+
 def emit_event(event_type: str, message: str = "",
                severity: str = "INFO", **fields: Any) -> None:
     """Application-level structured event into the cluster event table

@@ -1,0 +1,205 @@
+"""Chaos seed sweep: run a workload under N seeded fault schedules.
+
+Systematic interleaving/fault-schedule exploration (the chaos-plane
+successor of the RAY_TPU_testing_rpc_delay_seed sweep in
+tests/test_fault_tolerance.py): each seed parameterizes every
+probabilistic rule in the chosen schedule, so one sweep explores N
+different — but individually replayable — fault patterns over the same
+workload. A failing seed is a repro: re-run with --seeds <seed>.
+
+Schedules are named presets over the built-in smoke workload (tasks +
+actor calls + a large put/get), or bring your own workload script with
+--script (it runs under an already-initialized driver with the schedule
+installed; exit 0 = pass).
+
+Usage:
+    python tools/chaos_sweep.py --schedule rpc-delay --seeds 1,7,42
+    python tools/chaos_sweep.py --schedule drops --num-seeds 5 \
+        --format=json
+    python tools/chaos_sweep.py --schedule store-errors \
+        --script my_workload.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Each schedule: list of rule dicts (ray_tpu.chaos.inject kwargs); the
+# sweep rewrites `seed` per run. Probabilities stay low enough that the
+# retry/recovery machinery is exercised without starving the workload.
+SCHEDULES = {
+    "rpc-delay": [
+        {"fault": "delay", "method": "*", "delay_ms": 3.0,
+         "jitter": True, "probability": 1.0},
+    ],
+    "drops": [
+        {"fault": "drop_connection", "method": "kv_*",
+         "probability": 0.05},
+        {"fault": "drop_connection", "method": "get_*",
+         "probability": 0.05},
+        {"fault": "delay", "method": "w_push_task", "delay_ms": 2.0,
+         "jitter": True, "probability": 0.5},
+    ],
+    "store-errors": [
+        {"fault": "error", "method": "store_create",
+         "probability": 0.05,
+         "error_message": "chaos sweep: injected store error"},
+        {"fault": "delay", "method": "store_*", "delay_ms": 2.0,
+         "jitter": True, "probability": 0.5},
+    ],
+}
+
+_SMOKE_WORKLOAD = """
+import ray_tpu
+
+@ray_tpu.remote(max_retries=3)
+def f(x):
+    return x + 1
+
+assert ray_tpu.get([f.remote(i) for i in range(20)],
+                   timeout=120) == list(range(1, 21))
+
+@ray_tpu.remote
+class A:
+    def g(self, x):
+        return x * 2
+
+a = A.options(num_cpus=0.1).remote()
+assert ray_tpu.get([a.g.remote(i) for i in range(10)],
+                   timeout=120) == [i * 2 for i in range(10)]
+
+import numpy as np
+arr = np.arange(1 << 18, dtype=np.int32)
+for _ in range(3):
+    try:
+        ref = ray_tpu.put(arr)
+        break
+    except Exception:
+        continue  # injected store error: retry the put
+else:
+    raise RuntimeError("put never survived the store-error schedule")
+assert ray_tpu.get(ref, timeout=120).sum() == arr.sum()
+print("SWEEP_WORKLOAD_OK")
+"""
+
+_RUNNER = """
+import json
+import sys
+
+import ray_tpu
+from ray_tpu import chaos
+
+spec = json.loads(sys.argv[1])
+ray_tpu.init(num_cpus=2)
+rules = []
+for rule in spec["rules"]:
+    rule = dict(rule)
+    rule.setdefault("seed", spec["seed"])
+    rule["seed"] = rule["seed"] or spec["seed"]
+    rules.append(rule)
+chaos.inject_many(rules)
+exec(compile(open(sys.argv[2]).read(), sys.argv[2], "exec"))
+fired = sum(r["fired"] for r in chaos.list_rules())
+print(f"SWEEP_FIRED={fired}")
+ray_tpu.shutdown()
+"""
+
+
+def run_seed(schedule, seed, script_path, timeout):
+    spec = json.dumps({"rules": SCHEDULES[schedule], "seed": seed})
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", _RUNNER, spec, script_path],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+    except subprocess.TimeoutExpired as e:
+        # a hung seed is the sweep's most valuable find — record it as
+        # a failing seed instead of crashing the sweep
+        def _txt(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) \
+                else (b or "")
+        return {
+            "seed": seed, "ok": False, "fired": 0, "timed_out": True,
+            "duration_s": round(time.time() - t0, 2),
+            "tail": ("TIMEOUT after %.0fs\n" % timeout)
+            + _txt(e.stdout)[-1500:] + _txt(e.stderr)[-1500:],
+        }
+    fired = 0
+    for line in proc.stdout.splitlines():
+        if line.startswith("SWEEP_FIRED="):
+            fired = int(line.split("=", 1)[1])
+    return {
+        "seed": seed,
+        "ok": proc.returncode == 0,
+        "fired": fired,
+        "duration_s": round(time.time() - t0, 2),
+        "tail": "" if proc.returncode == 0
+        else (proc.stdout[-1500:] + proc.stderr[-1500:]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="sweep chaos seeds over a fault schedule")
+    ap.add_argument("--schedule", choices=sorted(SCHEDULES),
+                    default="rpc-delay")
+    ap.add_argument("--seeds", default=None,
+                    help="comma-separated explicit seeds")
+    ap.add_argument("--num-seeds", type=int, default=3,
+                    help="seeds 1..N when --seeds is not given")
+    ap.add_argument("--script", default=None,
+                    help="workload python file (default: built-in smoke)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-seed wall clock budget (s)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args()
+
+    seeds = [int(s) for s in args.seeds.split(",")] if args.seeds \
+        else list(range(1, args.num_seeds + 1))
+    script_path = args.script
+    tmp = None
+    if script_path is None:
+        import tempfile
+        fd, tmp = tempfile.mkstemp(suffix="_chaos_smoke.py")
+        with os.fdopen(fd, "w") as f:
+            f.write(_SMOKE_WORKLOAD)
+        script_path = tmp
+
+    results = []
+    try:
+        for seed in seeds:
+            rec = run_seed(args.schedule, seed, script_path, args.timeout)
+            results.append(rec)
+            if args.format == "text":
+                status = "PASS" if rec["ok"] else "FAIL"
+                print(f"seed {seed:>4}: {status}  fired={rec['fired']}"
+                      f"  {rec['duration_s']}s", flush=True)
+                if not rec["ok"]:
+                    print(rec["tail"])
+    finally:
+        if tmp is not None:
+            os.unlink(tmp)
+
+    failed = [r["seed"] for r in results if not r["ok"]]
+    if args.format == "json":
+        print(json.dumps({"schedule": args.schedule, "results": results,
+                          "failed_seeds": failed}))
+    elif failed:
+        print(f"FAILED seeds: {failed} — replay with "
+              f"--schedule {args.schedule} --seeds "
+              f"{','.join(map(str, failed))}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
