@@ -25,6 +25,10 @@ Every process consults its local copy at cheap hook points:
 Counters and seeded RNG streams are PER PROCESS (each process draws the
 same seeded stream, like the reference asio randomization), so a
 counter-triggered rule is deterministic for the process it targets.
+`evict_object` honors the store's reader leases: a leased object (a
+zero-copy view is outstanding — see object_store.py pin/unpin) has its
+eviction DEFERRED to the last unpin instead of rewriting memory under a
+live array; the fire is still recorded when the rule triggers.
 Every fire increments a prometheus counter, is reported to the GCS
 (which aggregates fired counts, emits a CHAOS_FAULT_INJECTED cluster
 event, and disables the rule cluster-wide once max_fires is reached).

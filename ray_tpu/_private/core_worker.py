@@ -42,6 +42,32 @@ INLINE, STORE, ERROR, PENDING, FREED = "inline", "store", "error", "pending", "f
 # Sentinel: materialization must be retried after in-flight recovery.
 _RETRY = object()
 
+# Lazy transport metrics (util.metrics registers per-process; created on
+# first use so importing this module costs nothing).
+_TRANSPORT_COUNTER = None
+
+
+def _transport_bytes(n: int, site: str) -> None:
+    """Count payload bytes copied on the transport plane, by site
+    (put = scatter-write into shm, pull = cross-node replica stream)."""
+    global _TRANSPORT_COUNTER
+    c = _TRANSPORT_COUNTER
+    if c is None:
+        try:
+            from ray_tpu.util.metrics import get_or_create, Counter
+            c = get_or_create(
+                Counter, "ray_tpu_transport_bytes_copied_total",
+                description="payload bytes copied by the object "
+                            "transport plane, by site",
+                tag_keys=("site",))
+        except Exception:  # noqa: BLE001 - metrics are best-effort
+            return
+        _TRANSPORT_COUNTER = c
+    try:
+        c.inc(n, tags={"site": site})
+    except Exception:  # noqa: BLE001
+        pass
+
 
 @dataclass
 class _TaskEntry:
@@ -140,6 +166,10 @@ class CoreWorker:
         self.local_refs: Dict[str, int] = {}
         self.arg_pins: Dict[str, int] = {}
         self.borrowed: Dict[str, Tuple[str, int]] = {}  # oid hex -> owner addr
+        # oid hex -> reader-lease count held on the LOCAL store's pulled
+        # replica (zero-copy views stay valid while leased); released
+        # when this process's last local ref to the object drops
+        self._replica_leases: Dict[str, int] = {}
         # Owner-side borrower accounting: oid hex -> {borrower addr: count}.
         # A liveness sweep drops pins of borrowers that died without
         # releasing (reference: ReferenceCounter detects borrower failure
@@ -312,10 +342,20 @@ class CoreWorker:
                 return
             self.local_refs.pop(h, None)
             release_borrow = self.borrowed.pop(h, None)
-            if release_borrow is None:
-                if self.arg_pins.get(h, 0) > 0:
-                    return
+            lease_count = self._replica_leases.pop(h, 0)
+            # owner-side free runs regardless of replica leases: an owned
+            # ref whose value was pulled from a remote store still must
+            # free on last drop (the lease release below is independent)
+            if release_borrow is None and self.arg_pins.get(h, 0) == 0:
                 self._maybe_free_locked(h)
+        if lease_count:
+            # release the local replica's reader lease(s): the arrays a
+            # get() handed out die with the last ObjectRef, so the store
+            # may evict the block again
+            try:
+                self.store.unpin(h, count=lease_count)
+            except Exception:  # noqa: BLE001 - store gone; lease moot
+                pass
         if release_borrow is not None:
             self._borrow_release_queue.put((release_borrow, h))
 
@@ -498,36 +538,69 @@ class CoreWorker:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
-        blob = ser.pack(value)
-        self._store_owned_object(oid, blob)
-        return ObjectRef(oid, self.address)
-
-    def store_blob(self, oid_hex: str, blob: bytes) -> Tuple:
-        """Write a serialized value inline or to the local shm store;
-        returns its location tuple."""
-        if len(blob) <= Config.max_inline_object_size:
-            return (INLINE, blob)
-        buf = self.store.create(oid_hex, len(blob))
-        buf[:len(blob)] = blob
-        self.store.seal(oid_hex)
-        return (STORE, self.store.address, len(blob))
-
-    def _store_owned_object(self, oid: ObjectID, blob: bytes) -> None:
         h = oid.hex()
-        loc = self.store_blob(h, blob)
+        loc = self.store_value(h, value)
         with self._lock:
             self.objects[h] = loc
             ev = self.object_events.get(h)
             if ev is not None:
                 ev.set()
+        return ObjectRef(oid, self.address)
+
+    def store_value(self, oid_hex: str, value: Any) -> Tuple:
+        """Serialize + store a value with ONE copy of its buffers: the
+        envelope is sized up front and header/meta/arrays scatter-write
+        directly into the shm block `store.create` returns (no joined
+        intermediate blob). Small envelopes stay inline (zero store
+        RPCs); returns the location tuple."""
+        meta, buffers = ser.serialize(value)
+        raws = ser.raw_buffers(buffers)
+        total, offsets = ser.plan_envelope(meta, raws)
+        if total <= Config.max_inline_object_size:
+            out = bytearray(total)
+            ser.write_envelope(out, meta, raws, offsets)
+            return (INLINE, bytes(out))
+        buf = self.store.create(oid_hex, total)
+        try:
+            ser.write_envelope(buf, meta, raws, offsets)
+            self.store.seal(oid_hex)
+        except BaseException:
+            # reclaim the block: a fast-path allocation the server never
+            # saw would otherwise leak arena space until store teardown
+            self.store.abort_create(oid_hex)
+            raise
+        _transport_bytes(total, "put")
+        return (STORE, self.store.address, total)
+
+    def store_blob(self, oid_hex: str, blob: bytes) -> Tuple:
+        """Write an already-serialized envelope inline or to the local
+        shm store; returns its location tuple. Prefer store_value, which
+        skips the intermediate blob entirely."""
+        if len(blob) <= Config.max_inline_object_size:
+            return (INLINE, blob)
+        buf = self.store.create(oid_hex, len(blob))
+        try:
+            buf[:len(blob)] = blob
+            self.store.seal(oid_hex)
+        except BaseException:
+            self.store.abort_create(oid_hex)
+            raise
+        _transport_bytes(len(blob), "put")
+        return (STORE, self.store.address, len(blob))
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
+        """Batched multi-ref get: resolve every ref's location first
+        (per-ref wait-graph edges, removed the moment that ref
+        resolves), then materialize the whole batch — all local store
+        objects in ONE store_wait RPC, remote replicas via pipelined
+        concurrent pulls, inline values with zero RPCs."""
         deadline = None if timeout is None else time.time() + timeout
         blocked_notified = False
         try:
-            out: List[Any] = []
-            for ref in refs:
+            hexes = [ref.hex() for ref in refs]
+            locs: List[Optional[Tuple]] = [None] * len(refs)
+            for i, ref in enumerate(refs):
                 need_wait = not self._ready_nowait(ref)
                 if need_wait and self.mode == "worker" and not blocked_notified \
                         and getattr(self._tls, "task_id", None) is not None:
@@ -540,14 +613,14 @@ class CoreWorker:
                 # may raise DeadlockError instead of blocking forever
                 edge = self._register_wait_edge(ref) if need_wait else None
                 try:
-                    out.append(self._get_one(ref, deadline))
+                    locs[i] = self._await_location(ref, hexes[i], deadline)
                 finally:
                     # removed the moment THIS ref resolves: an edge held
                     # until the whole multi-ref get returned could close
                     # a false cycle against a peer we no longer wait on
                     if edge is not None:
                         self._remove_wait_edge(edge)
-            return out
+            return self._materialize_many(refs, hexes, locs, deadline)
         finally:
             if blocked_notified:
                 try:
@@ -555,6 +628,71 @@ class CoreWorker:
                                   worker_id_hex=self.worker_id.hex())
                 except Exception:  # noqa: BLE001
                     pass
+
+    def _materialize_many(self, refs: List[ObjectRef], hexes: List[str],
+                          locs: List[Optional[Tuple]],
+                          deadline: Optional[float]) -> List[Any]:
+        """Materialize resolved locations as a batch. Local-store refs
+        share one store_wait RPC; distinct remote replicas are pulled
+        concurrently (pipelined instead of serial ~300µs round trips);
+        anything that misses the fast path (inline, errors, lost objects
+        needing lineage recovery) falls back to the per-ref path."""
+        prefetched: Dict[str, memoryview] = {}
+        local_ids = []
+        remote: Dict[str, Tuple] = {}
+        for h, loc in zip(hexes, locs):
+            if loc is None or loc[0] != STORE or h in remote:
+                continue
+            store_addr = tuple(loc[1])
+            if store_addr == self.store.address:
+                local_ids.append(h)
+            else:
+                remote[h] = (store_addr, int(loc[2]))
+        if len(local_ids) > 1:
+            try:
+                prefetched = self.store.get(
+                    list(dict.fromkeys(local_ids)), timeout=5)
+            except Exception:  # noqa: BLE001 - per-ref path surfaces it
+                prefetched = {}
+        if len(remote) > 1:
+            # pipeline the pulls: each replica streams on its own thread
+            # while the others are in flight (leased for zero-copy use,
+            # released when this process's last local ref drops)
+            import concurrent.futures as _fut
+            with _fut.ThreadPoolExecutor(
+                    max_workers=min(8, len(remote))) as pool:
+                futs = {
+                    h: pool.submit(self._pull_replica, h, addr, size)
+                    for h, (addr, size) in remote.items()}
+            for h, f in futs.items():
+                try:
+                    prefetched[h] = f.result()
+                except Exception:  # noqa: BLE001 - per-ref path retries
+                    pass
+        out: List[Any] = []
+        for ref, h, loc in zip(refs, hexes, locs):
+            buf = prefetched.get(h)
+            if buf is not None:
+                try:
+                    out.append(ser.unpack(buf))
+                    continue
+                except Exception:  # noqa: BLE001 - torn/evicted: re-get
+                    logger.warning("batched unpack of %s failed; "
+                                   "refetching", h[:16], exc_info=True)
+            out.append(self._get_one(ref, deadline))
+        return out
+
+    def _pull_replica(self, oid_hex: str, store_addr: Tuple[str, int],
+                      size: int) -> memoryview:
+        """Pull + lease a remote object's replica into the local store;
+        the lease (released with the last local ref, see
+        remove_local_ref) keeps the zero-copy view valid."""
+        view = self.store.pull(oid_hex, store_addr, size, pin=True)
+        with self._lock:
+            self._replica_leases[oid_hex] = \
+                self._replica_leases.get(oid_hex, 0) + 1
+        _transport_bytes(size, "pull")
+        return view
 
     def _remove_wait_edge(self, token: str) -> None:
         # token-keyed and idempotent: the rpc layer retries it through
@@ -709,9 +847,10 @@ class CoreWorker:
     def _on_recover_object(self, oid_hex: str) -> bool:
         return self._recover_object(oid_hex)
 
-    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
-        h = ref.hex()
-        recover_attempts = [0]
+    def _await_location(self, ref: ObjectRef, h: str,
+                        deadline: Optional[float]) -> Tuple:
+        """Block until the ref has a resolved (non-PENDING) location and
+        return it — the waiting half of a get, RPC-free for own refs."""
         # Long-polls park server-side for up to 30s; a dedicated
         # per-get connection keeps them off the shared pooled client,
         # where they would head-of-line-block every other call to that
@@ -726,54 +865,62 @@ class CoreWorker:
                             h, threading.Event())
                     else:
                         ev = None
-                if loc is None or loc[0] == PENDING:
-                    if self._is_own(ref):
-                        if loc is None:
-                            raise exc.ObjectLostError(
-                                f"object {h[:16]} unknown to its owner "
-                                "(freed?)")
-                        # our own pending task result: wait on event
-                        remaining = None if deadline is None \
-                            else deadline - time.time()
-                        if remaining is not None and remaining <= 0:
-                            raise exc.GetTimeoutError(
-                                f"get timed out waiting for {h[:16]}")
-                        ev.wait(timeout=min(remaining, 1.0)
-                                if remaining is not None else 1.0)
-                        continue
-                    # borrower: long-poll the owner (reference pubsub
-                    # long-poll; a 5ms busy-poll collapses at scale)
+                if loc is not None and loc[0] != PENDING:
+                    return loc
+                if self._is_own(ref):
+                    if loc is None:
+                        raise exc.ObjectLostError(
+                            f"object {h[:16]} unknown to its owner "
+                            "(freed?)")
+                    # our own pending task result: wait on event
                     remaining = None if deadline is None \
                         else deadline - time.time()
                     if remaining is not None and remaining <= 0:
                         raise exc.GetTimeoutError(
                             f"get timed out waiting for {h[:16]}")
-                    try:
-                        if longpoll_client is None:
-                            longpoll_client = rpc_lib.RpcClient(
-                                ref.owner_address, timeout=120)
-                        loc = longpoll_client.call(
-                            "cw_wait_object", oid_hex=h,
-                            timeout=min(remaining or 30.0, 30.0))
-                    except rpc_lib.ConnectionLost:
-                        raise exc.OwnerDiedError(
-                            f"owner {ref.owner_address} of {h[:16]} died")
-                    if loc[0] in (PENDING, "unknown"):
-                        if deadline is not None and time.time() > deadline:
-                            raise exc.GetTimeoutError(
-                                f"get timed out waiting for {h[:16]}")
-                        time.sleep(0.05 if loc[0] == "unknown" else 0.0)
-                        continue
-                    with self._lock:
-                        self.objects.setdefault(h, loc)
-                result = self._materialize_with_recovery(
-                    ref, h, loc, recover_attempts)
-                if result is _RETRY:
+                    ev.wait(timeout=min(remaining, 1.0)
+                            if remaining is not None else 1.0)
                     continue
-                return result
+                # borrower: long-poll the owner (reference pubsub
+                # long-poll; a 5ms busy-poll collapses at scale)
+                remaining = None if deadline is None \
+                    else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    raise exc.GetTimeoutError(
+                        f"get timed out waiting for {h[:16]}")
+                try:
+                    if longpoll_client is None:
+                        longpoll_client = rpc_lib.RpcClient(
+                            ref.owner_address, timeout=120)
+                    loc = longpoll_client.call(
+                        "cw_wait_object", oid_hex=h,
+                        timeout=min(remaining or 30.0, 30.0))
+                except rpc_lib.ConnectionLost:
+                    raise exc.OwnerDiedError(
+                        f"owner {ref.owner_address} of {h[:16]} died")
+                if loc[0] in (PENDING, "unknown"):
+                    if deadline is not None and time.time() > deadline:
+                        raise exc.GetTimeoutError(
+                            f"get timed out waiting for {h[:16]}")
+                    time.sleep(0.05 if loc[0] == "unknown" else 0.0)
+                    continue
+                with self._lock:
+                    self.objects.setdefault(h, loc)
+                return loc
         finally:
             if longpoll_client is not None:
                 longpoll_client.close()
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        h = ref.hex()
+        recover_attempts = [0]
+        while True:
+            loc = self._await_location(ref, h, deadline)
+            result = self._materialize_with_recovery(
+                ref, h, loc, recover_attempts)
+            if result is _RETRY:
+                continue
+            return result
 
     def _materialize_with_recovery(self, ref, h, loc,
                                    recover_attempts: List[int]) -> Any:
@@ -820,9 +967,10 @@ class CoreWorker:
                     # from real loss (which lineage recovery then handles).
                     bufs = self.store.get([oid_hex], timeout=5)
                 else:
-                    # use the pulled buffer directly (for arena-layout
-                    # replicas it's an owned copy, safe across eviction)
-                    bufs = {oid_hex: self.store.pull(
+                    # zero-copy view of the pulled replica, leased so
+                    # eviction can't rewrite it under the deserialized
+                    # arrays (released with our last local ref)
+                    bufs = {oid_hex: self._pull_replica(
                         oid_hex, store_addr, size)}
             except ObjectStoreFullError:
                 raise
@@ -1898,6 +2046,18 @@ class CoreWorker:
             except Exception:  # noqa: BLE001  graftlint: disable=RT008
                 pass
         self._borrow_release_queue.put(None)
+        # release reader leases on pulled replicas so the local store can
+        # evict them (a SIGKILLed process leaks its leases until the
+        # store itself is torn down — graceful exits should not)
+        with self._lock:
+            leases = dict(self._replica_leases)
+            self._replica_leases.clear()
+        for h, n in leases.items():
+            try:
+                self.store.unpin(h, count=n)
+            # best-effort during teardown: the store may already be gone
+            except Exception:  # noqa: BLE001  graftlint: disable=RT008
+                pass
         try:
             self.task_events.stop()
         except Exception:  # noqa: BLE001
@@ -2182,8 +2342,8 @@ class _Executor:
                 oid = ObjectID.for_task_return(spec.task_id, i + 1)
                 collected: List[Any] = []
                 with collect_serialized_refs(collected):
-                    blob = ser.pack(v)
-                results.append(cw.store_blob(oid.hex(), blob))
+                    # scatter-write: serialize + store in one copy
+                    results.append(cw.store_value(oid.hex(), v))
                 # PER RETURN: borrows must key to the return value that
                 # actually embeds the ref (freeing return 0 must not
                 # release refs held only by return 1)
@@ -2255,7 +2415,7 @@ class _Executor:
         children = []
         for i, item in enumerate(iterator):
             child = ObjectID.for_task_return(spec.task_id, i + 2)
-            loc = cw.store_blob(child.hex(), ser.pack(item))
+            loc = cw.store_value(child.hex(), item)
             children.append((child, loc))
             report_q.put((child, loc))
         report_q.put(None)

@@ -47,6 +47,10 @@ class _Entry:
     path: Optional[str] = None     # backing file (file layout / spilled)
     sealed: bool = False
     pinned: int = 0          # pin count (owner pins while referenced)
+    # reader leases (store_pin / pin=True on wait/pull): while > 0 a
+    # zero-copy view of this block is outstanding, so the entry is
+    # neither dropped, spilled, nor chaos-evicted (eviction defers)
+    leases: int = 0
     last_access: float = field(default_factory=time.time)
     creating: bool = True
     spilled: bool = False    # payload lives in the disk spill dir, not shm
@@ -75,6 +79,14 @@ class StoreServer:
         self.num_spilled = 0
         self.num_restored = 0
         self._objects: Dict[str, _Entry] = {}
+        # chaos evictions deferred because a reader lease was live; the
+        # delete fires when the last lease releases (unpin)
+        self._deferred_evict: set = set()
+        # arena blocks of deleted/replaced entries that still had reader
+        # leases: oid -> [[offset, remaining_leases], ...]. Releasing
+        # them would rewrite memory under live zero-copy views, so they
+        # are held until their leases drain through unpin().
+        self._orphans: Dict[str, List[List[int]]] = {}
         self._quarantine: List[Tuple[float, int]] = []  # (freed_at, offset)
         # in-flight pull dedup: oid -> Event set when the transfer ends
         # (N concurrent pulls of one object must stream it ONCE)
@@ -105,6 +117,10 @@ class StoreServer:
             "store_read_chunk": self.read_chunk,
             "store_pull": self.pull,
             "store_put_raw": self.put_raw,
+            "store_put_segments": self.put_segments,
+            "store_register": self.register_sealed,
+            "store_arena_info": self.arena_info,
+            "store_chaos_evict": self.chaos_evict,
             "store_stats": self.stats,
             "store_list": self.list_objects,
         }, host=host)
@@ -148,13 +164,18 @@ class StoreServer:
 
     def _eviction_order_locked(self) -> List[str]:
         """Victim order, computed ONCE per space request: LRU unpinned
-        replicas first (dropped), then LRU pinned primaries (spilled)."""
+        replicas first (dropped), then LRU pinned primaries (spilled).
+        Leased entries are untouchable — a reader holds a zero-copy view
+        of the block, so dropping OR spilling it (both release the arena
+        offset) would rewrite memory under a live array."""
         unpinned = sorted(
             ((e.last_access, oid) for oid, e in self._objects.items()
-             if e.sealed and e.pinned == 0 and not e.spilled))
+             if e.sealed and e.pinned == 0 and e.leases == 0
+             and not e.spilled))
         pinned = sorted(
             ((e.last_access, oid) for oid, e in self._objects.items()
-             if e.sealed and e.pinned > 0 and not e.spilled))
+             if e.sealed and e.pinned > 0 and e.leases == 0
+             and not e.spilled))
         return [oid for _, oid in unpinned] + [oid for _, oid in pinned]
 
     def _evict_next_locked(self, order: List[str]) -> bool:
@@ -269,12 +290,21 @@ class StoreServer:
 
     def _delete_locked(self, object_id: str) -> None:
         e = self._objects.pop(object_id, None)
+        self._deferred_evict.discard(object_id)
         if e is None:
             return
         if not e.spilled:
             self.used -= e.size
         if e.offset is not None:
-            self._arena_release_locked(e.offset)
+            if e.leases > 0:
+                # a reader still holds zero-copy views of this block
+                # (owner freed before unpin, or the id was re-created):
+                # orphan it until the leases drain rather than recycling
+                # memory under live arrays
+                self._orphans.setdefault(object_id, []).append(
+                    [e.offset, e.leases])
+            else:
+                self._arena_release_locked(e.offset)
         elif e.path:
             try:
                 os.unlink(e.path)
@@ -328,6 +358,27 @@ class StoreServer:
                     f.write(data)
         self.seal(object_id)
 
+    def put_segments(self, object_id: str, segments: List[bytes],
+                     pin: bool = False) -> None:
+        """Scatter variant of put_raw: the segments land back-to-back in
+        one allocation without the caller ever joining them into a
+        single bytes object."""
+        total = sum(len(s) for s in segments)
+        self.create(object_id, total, pin=pin)
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is not None and e.offset is not None:
+                view = self._payload_view(e)
+                off = 0
+                for s in segments:
+                    view[off:off + len(s)] = s
+                    off += len(s)
+            elif e is not None:
+                with open(e.path, "r+b") as f:
+                    for s in segments:
+                        f.write(s)
+        self.seal(object_id)
+
     def seal(self, object_id: str) -> None:
         with self._sealed_cv:
             e = self._objects.get(object_id)
@@ -338,10 +389,40 @@ class StoreServer:
             e.last_access = time.time()
             self._sealed_cv.notify_all()
 
+    def arena_info(self) -> Optional[str]:
+        """Arena path for client-side fast-path allocation (None in the
+        file-per-object fallback layout)."""
+        return self.arena_path if self.arena is not None else None
+
+    def register_sealed(self, object_id: str, offset: int, size: int,
+                        pin: bool = True) -> None:
+        """Adopt a client-allocated, already-written arena block as a
+        sealed object (the scatter-write put fast path: the client
+        allocs straight from the process-shared arena, writes the
+        envelope, and this one-way notification replaces the
+        create+seal round trips). The store_create chaos hook fires in
+        the CLIENT for this path (see StoreClient.create) so error
+        rules propagate to the writer and fire counts stay per-create."""
+        with self._sealed_cv:
+            e = self._objects.get(object_id)
+            if e is not None:
+                if e.offset == offset and e.size == size:
+                    return  # duplicate register (oneway resend): no-op
+                # re-created id (lineage re-execution): replace backing
+                self._delete_locked(object_id)
+            self._objects[object_id] = _Entry(
+                size=size, offset=offset, pinned=1 if pin else 0,
+                sealed=True, creating=False)
+            self.used += size
+            self._sealed_cv.notify_all()
+
     def wait(self, object_ids: List[str], timeout: Optional[float] = None,
-             num_required: Optional[int] = None) -> Dict[str, Tuple]:
+             num_required: Optional[int] = None,
+             pin: bool = False) -> Dict[str, Tuple]:
         """Block until objects are sealed locally; returns {id: descriptor}.
-        Objects not present locally are NOT fetched here (see pull)."""
+        Objects not present locally are NOT fetched here (see pull).
+        pin=True takes one reader lease per returned object (release
+        with unpin) so the descriptors stay valid as zero-copy views."""
         chaos_lib.on_store_op("store_wait", list(object_ids), self)
         deadline = None if timeout is None else time.time() + timeout
         num_required = len(object_ids) if num_required is None else num_required
@@ -356,9 +437,15 @@ class StoreServer:
                         e.last_access = time.time()
                         ready[oid] = self._descriptor(e)
                 if len(ready) >= num_required:
+                    if pin:
+                        for oid in ready:
+                            self._objects[oid].leases += 1
                     return ready
                 remaining = None if deadline is None else deadline - time.time()
                 if remaining is not None and remaining <= 0:
+                    if pin:
+                        for oid in ready:
+                            self._objects[oid].leases += 1
                     return ready
                 self._sealed_cv.wait(timeout=min(remaining or 1.0, 1.0))
 
@@ -379,6 +466,7 @@ class StoreServer:
         primary, the case lineage reconstruction exists for). With no
         glob, the objects named in the triggering op are evicted."""
         import fnmatch as _fnmatch
+        deferred = 0
         with self._lock:
             if object_glob:
                 victims = [oid for oid in self._objects
@@ -387,23 +475,57 @@ class StoreServer:
                 victims = [oid for oid in op_object_ids
                            if oid in self._objects]
             for oid in victims:
-                self._delete_locked(oid)
+                e = self._objects.get(oid)
+                if e is not None and e.leases > 0:
+                    # a reader holds a zero-copy view: deleting now would
+                    # rewrite memory under a live array. Defer the
+                    # eviction to the last unpin (the fault still lands,
+                    # just after the lease contract is honored).
+                    self._deferred_evict.add(oid)
+                    deferred += 1
+                else:
+                    self._delete_locked(oid)
         if victims:
-            logger.warning("chaos: evicted %d object(s) from store %s",
-                           len(victims), self.address)
+            logger.warning("chaos: evicted %d object(s) (%d deferred to "
+                           "unpin) from store %s",
+                           len(victims) - deferred, deferred, self.address)
         return len(victims)
 
     def pin(self, object_id: str) -> None:
+        """Take a reader lease: while held, the object is not dropped,
+        spilled, or chaos-evicted (its zero-copy views stay valid)."""
         with self._lock:
             e = self._objects.get(object_id)
             if e is not None:
-                e.pinned += 1
+                e.leases += 1
 
-    def unpin(self, object_id: str) -> None:
+    def unpin(self, object_id: str, count: int = 1) -> None:
+        """Release reader lease(s); fires any chaos eviction deferred
+        while the object was leased. Leases on orphaned blocks (the
+        entry was deleted or its id re-created while leased) drain
+        first — the caller's leases were taken on that older block."""
         with self._lock:
+            orph = self._orphans.get(object_id)
+            while count > 0 and orph:
+                rec = orph[0]
+                take = min(count, rec[1])
+                rec[1] -= take
+                count -= take
+                if rec[1] == 0:
+                    self._arena_release_locked(rec[0])
+                    orph.pop(0)
+            if orph is not None and not orph:
+                self._orphans.pop(object_id, None)
+            if count <= 0:
+                return
             e = self._objects.get(object_id)
-            if e is not None and e.pinned > 0:
-                e.pinned -= 1
+            if e is None:
+                self._deferred_evict.discard(object_id)
+                return
+            e.leases = max(0, e.leases - count)
+            if e.leases == 0 and object_id in self._deferred_evict:
+                self._deferred_evict.discard(object_id)
+                self._delete_locked(object_id)
 
     # -- node-to-node transfer --------------------------------------------
 
@@ -422,8 +544,10 @@ class StoreServer:
             return f.read(length)
 
     def pull(self, object_id: str, from_store: Tuple[str, int],
-             size: int) -> Tuple:
+             size: int, lease: bool = False) -> Tuple:
         """Pull an object from a peer store into this one (chunked).
+        lease=True takes a reader lease on the local replica so the
+        returned descriptor is safe for zero-copy views until unpin.
         reference parity: pull_manager.h / push_manager.h chunk streaming."""
         chaos_lib.on_store_op("store_pull", [object_id], self)
         while True:
@@ -436,6 +560,8 @@ class StoreServer:
                         # evicted its copy)
                         self._restore_locked(object_id)
                         e = self._objects[object_id]
+                    if lease:
+                        e.leases += 1
                     return self._descriptor(e)
                 in_flight = self._pulls_in_flight.get(object_id)
                 if in_flight is None:
@@ -444,7 +570,8 @@ class StoreServer:
             # another thread is streaming this object: wait, then re-check
             in_flight.wait(timeout=300)
         try:
-            return self._pull_stream(object_id, from_store, size)
+            return self._pull_stream(object_id, from_store, size,
+                                     lease=lease)
         finally:
             with self._lock:
                 ev = self._pulls_in_flight.pop(object_id, None)
@@ -452,7 +579,7 @@ class StoreServer:
                 ev.set()
 
     def _pull_stream(self, object_id: str, from_store: Tuple[str, int],
-                     size: int) -> Tuple:
+                     size: int, lease: bool = False) -> Tuple:
         expected = self.create(object_id, size, pin=False)
         client = self._pool.get(tuple(from_store))
         off = 0
@@ -477,19 +604,25 @@ class StoreServer:
             off += len(chunk)
         self.seal(object_id)
         with self._lock:
-            return self._descriptor(self._objects[object_id])
+            e = self._objects[object_id]
+            if lease:
+                e.leases += 1
+            return self._descriptor(e)
 
     def list_objects(self) -> List[Dict[str, Any]]:
         """Object-level metadata for the state API (`ray list objects`)."""
         with self._lock:
             return [{"object_id": oid, "size": e.size, "sealed": e.sealed,
-                     "pinned": e.pinned, "spilled": e.spilled}
+                     "pinned": e.pinned, "leases": e.leases,
+                     "spilled": e.spilled}
                     for oid, e in self._objects.items()]
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
             return {"used": self.used, "capacity": self.capacity,
                     "num_objects": len(self._objects),
+                    "num_leased": sum(1 for e in self._objects.values()
+                                      if e.leases > 0),
                     "num_spilled": self.num_spilled,
                     "num_restored": self.num_restored,
                     "native_arena": self.arena is not None}
@@ -520,6 +653,11 @@ class StoreClient:
         self._arenas: Dict[str, Any] = {}     # arena path -> NativeArena
         # file-layout fallback: object id -> (mmap, view, inode)
         self._maps: Dict[str, Tuple[mmap.mmap, memoryview, int]] = {}
+        # fast-path put state: the server's arena path ("" = file-layout
+        # server, None = not asked yet) and blocks we allocated directly
+        # from the process-shared arena but have not registered yet
+        self._fast_arena_path: Optional[str] = None
+        self._fast_pending: Dict[str, Tuple[int, int]] = {}
 
     # -- descriptor resolution ----------------------------------------
 
@@ -536,7 +674,11 @@ class StoreClient:
               writable: bool = False) -> memoryview:
         if desc[0] == "arena":
             _, path, offset, size = desc
-            return self._arena(path).view(offset, size)
+            view = self._arena(path).view(offset, size)
+            # Readers get read-only views: a stored object is immutable,
+            # and a writable alias would let one consumer corrupt the
+            # arrays every other consumer (zero-copy) reads.
+            return view if writable else view.toreadonly()
         _, path, size = desc
         return self._map_file(object_id, path, size, writable)
 
@@ -565,43 +707,167 @@ class StoreClient:
 
     # -- lifecycle ------------------------------------------------------
 
+    def _fast_arena(self):
+        """The server's arena, attachable for client-side allocation
+        (the allocator's lock is process-shared); None when the server
+        runs the file-per-object fallback or the native lib is missing
+        locally."""
+        if self._fast_arena_path is None:
+            try:
+                # "" caches an authoritative no-arena answer; a transient
+                # RPC failure leaves None so the next put re-probes
+                # instead of silently pinning this process to the slow
+                # path forever
+                self._fast_arena_path = self._rpc.call(
+                    "store_arena_info") or ""
+            except Exception:  # noqa: BLE001 - transient: retry later
+                return None
+        if not self._fast_arena_path:
+            return None
+        try:
+            return self._arena(self._fast_arena_path)
+        except Exception:  # noqa: BLE001 - no local native toolchain
+            self._fast_arena_path = ""
+            return None
+
     def create(self, object_id: str, size: int) -> memoryview:
+        """Writable block for a new object. Fast path: allocate straight
+        from the process-shared arena — no RPC; seal() then registers
+        the block with one one-way message, so a put costs zero round
+        trips. Falls back to the server's create RPC when the arena is
+        unavailable or full (the server can evict/spill; we can't)."""
+        arena = self._fast_arena()
+        if arena is not None:
+            off = arena.alloc(size)
+            if off:
+                # chaos parity with the server-side create hook, fired
+                # exactly once per create (only after committing to this
+                # path — an alloc failure falls through to the RPC
+                # create, whose handler fires the hook instead). Evict
+                # rules actuate on the server through this client's
+                # chaos_evict proxy.
+                try:
+                    chaos_lib.on_store_op("store_create", [object_id],
+                                          self)
+                except Exception:
+                    try:
+                        arena.free(off)
+                    except ValueError:
+                        pass
+                    raise
+                with self._lock:
+                    self._fast_pending[object_id] = (off, size)
+                return arena.view(off, max(size, 1))
         desc = self._rpc.call("store_create", object_id=object_id,
                               size=size)
         return self._view(object_id, desc, writable=True)
 
     def seal(self, object_id: str) -> None:
-        self._rpc.call("store_seal", object_id=object_id)
+        with self._lock:
+            fast = self._fast_pending.pop(object_id, None)
+        # One-way sends: sealing/registering only flips server metadata
+        # + notifies waiters, and same-socket ordering guarantees our
+        # own later store RPCs observe it — dropping the reply round
+        # trip makes a put RPC-free on the fast path. Durability: a
+        # send failure (including a chaos drop_connection, which raises
+        # in the client hook before anything is sent) surfaces HERE as
+        # an exception, so the put fails loudly; a frame accepted by
+        # the kernel is only lost if the store process dies, which
+        # loses the whole store and lands in the existing
+        # ObjectLostError/lineage path anyway.
+        if fast is not None:
+            off, size = fast
+            self._rpc.send_oneway("store_register", object_id=object_id,
+                                  offset=off, size=size)
+            return
+        self._rpc.send_oneway("store_seal", object_id=object_id)
 
     def put_raw(self, object_id: str, data: bytes) -> None:
-        if len(data) > CHUNK_SIZE:
-            buf = self.create(object_id, len(data))
-            buf[:] = data
-            self.seal(object_id)
-        else:
-            self._rpc.call("store_put_raw", object_id=object_id, data=data)
+        self.put_segments(object_id, [data])
 
-    def get(self, object_ids: List[str], timeout: Optional[float] = None
-            ) -> Dict[str, memoryview]:
+    def put_segments(self, object_id: str, segments: List[Any]) -> None:
+        """Scatter-write pre-serialized parts as one object. Large
+        payloads are written straight into the shm mapping (no joined
+        intermediate bytes); small ones ride a single put_raw RPC."""
+        total = sum(len(s) for s in segments)
+        if total > CHUNK_SIZE:
+            buf = self.create(object_id, total)
+            try:
+                off = 0
+                for s in segments:
+                    buf[off:off + len(s)] = s
+                    off += len(s)
+                self.seal(object_id)
+            except BaseException:
+                self.abort_create(object_id)
+                raise
+        elif len(segments) == 1:
+            self._rpc.call("store_put_raw", object_id=object_id,
+                           data=bytes(segments[0]))
+        else:
+            self._rpc.call("store_put_segments", object_id=object_id,
+                           segments=[bytes(s) for s in segments])
+
+    def get(self, object_ids: List[str], timeout: Optional[float] = None,
+            pin: bool = False) -> Dict[str, memoryview]:
+        """Zero-copy views of sealed local objects (ONE store_wait RPC
+        for the whole batch). pin=True leases every returned object so
+        the views outlive LRU pressure; release with unpin()."""
         descs = self._rpc.call("store_wait", object_ids=object_ids,
-                               timeout=timeout)
+                               timeout=timeout, pin=pin)
         return {oid: self._view(oid, desc)
                 for oid, desc in descs.items()}
 
     def contains(self, object_id: str) -> bool:
         return self._rpc.call("store_contains", object_id=object_id)
 
-    def pull(self, object_id: str, from_store: Tuple[str, int], size: int
-             ) -> memoryview:
+    def chaos_evict(self, object_glob: Optional[str],
+                    op_object_ids: List[str]) -> int:
+        """Actuator proxy for chaos rules that fire in THIS process
+        (fast-path create): forwards the eviction to the store server,
+        which owns the objects."""
+        return self._rpc.call("store_chaos_evict",
+                              object_glob=object_glob,
+                              op_object_ids=list(op_object_ids))
+
+    def abort_create(self, object_id: str) -> None:
+        """Undo a create whose write/seal failed, so the backing space
+        is reclaimed instead of leaking: fast-path blocks are freed
+        straight back to the arena (the server never knew), RPC-created
+        entries are deleted server-side."""
+        with self._lock:
+            fast = self._fast_pending.pop(object_id, None)
+        if fast is not None:
+            arena = self._fast_arena()
+            if arena is not None:
+                try:
+                    arena.free(fast[0])
+                except ValueError:
+                    pass
+            return
+        try:
+            self._rpc.call("store_delete", object_ids=[object_id])
+        except Exception:  # noqa: BLE001 - server gone; nothing to free
+            pass
+
+    def pin(self, object_id: str) -> None:
+        self._rpc.call("store_pin", object_id=object_id)
+
+    def unpin(self, object_id: str, count: int = 1) -> None:
+        self._rpc.call("store_unpin", object_id=object_id, count=count)
+
+    def pull(self, object_id: str, from_store: Tuple[str, int], size: int,
+             pin: bool = False) -> memoryview:
+        """Zero-copy view of a replica pulled from a peer store. With
+        pin=True the replica is leased (LRU/chaos eviction defer) until
+        unpin() — the contract long-lived consumers must use. Unpinned
+        callers rely on the arena free-quarantine bounding the reuse
+        hazard (fine for transient reads like prefetch or immediate
+        copies)."""
         desc = self._rpc.call("store_pull", object_id=object_id,
-                              from_store=tuple(from_store), size=size)
-        view = self._view(object_id, desc)
-        if desc[0] == "arena":
-            # Replicas are LRU-evictable and their arena blocks get
-            # reused; hand the caller an owned copy rather than a view
-            # that could be rewritten underneath a zero-copy array.
-            return memoryview(bytes(view))
-        return view
+                              from_store=tuple(from_store), size=size,
+                              lease=pin)
+        return self._view(object_id, desc)
 
     def delete(self, object_ids: List[str]) -> None:
         self._release(object_ids)

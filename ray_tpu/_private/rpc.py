@@ -200,6 +200,9 @@ _IDEMPOTENT_PREFIXES = ("get_", "list_", "kv_get", "kv_keys", "nm_get",
 _IDEMPOTENT_METHODS = frozenset({
     "ping", "nm_ping", "report_resources", "register_node", "subscribe",
     "next_job_id", "cluster_resources", "available_resources",
+    # object-store reads (store_wait is excluded: pin=True takes a
+    # lease, and a blind resend would double-count it)
+    "store_contains", "store_stats", "store_list", "store_arena_info",
 })
 
 

@@ -4,15 +4,46 @@ reference parity: python/ray/_private/serialization.py (SerializationContext).
 Values are serialized to a (meta, buffers) envelope so large numpy/jax arrays
 travel as raw buffers that can land in (and be read zero-copy out of) the
 shared-memory object store.
+
+Envelope layout (the on-shm format of a stored object):
+
+    u32 meta_len | u32 nbuf                      -- 8-byte fixed header
+    (u64 buf_offset | u64 buf_len) * nbuf        -- buffer table
+    meta bytes                                   -- pickle stream (in-band)
+    ...padding...                                -- to 64-byte alignment
+    buffer payloads at their table offsets       -- each 64-byte aligned
+
+Offsets are absolute from the envelope start. Because the arena allocator
+hands out 64-byte-aligned blocks and maps the arena at a page boundary,
+aligned-relative means aligned-absolute: zero-copy numpy views over the
+buffers are SIMD/cacheline aligned. Writers size the envelope with
+plan_envelope() and scatter-write it straight into the destination
+(`store.create` view) with write_envelope() — one copy from the source
+arrays into shm, no intermediate joined blob. Readers (`unpack`) slice
+buffer views out of the envelope without copying.
 """
 
 from __future__ import annotations
 
 import io
 import pickle
-from typing import Any, List, Tuple
+import struct
+from typing import Any, List, Sequence, Tuple
 
 import cloudpickle
+
+try:
+    import numpy as _np
+except Exception:  # noqa: BLE001 - numpy-less env: slower copies only
+    _np = None
+
+_HDR = struct.Struct(">II")      # meta_len, nbuf
+_BUF = struct.Struct(">QQ")      # offset, length (per buffer)
+BUFFER_ALIGN = 64
+# numpy's copy loop moves large buffers into the shm mapping ~3x faster
+# than memoryview slice assignment on this class of box; below this size
+# the frombuffer setup costs more than it saves
+_NP_COPY_MIN = 1 << 14
 
 
 def dumps_function(fn: Any) -> bytes:
@@ -53,39 +84,78 @@ def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
     return b"C" + f.getvalue(), buffers
 
 
-def deserialize(meta: bytes, buffers: List[Any]) -> Any:
-    tag, body = meta[:1], meta[1:]
+def deserialize(meta: Any, buffers: List[Any]) -> Any:
+    tag = bytes(meta[:1])
     if tag in (b"P", b"C"):
-        return pickle.loads(body, buffers=buffers)
+        return pickle.loads(meta[1:], buffers=buffers)
     raise ValueError(f"bad serialization tag {tag!r}")
 
 
+def raw_buffers(buffers: Sequence[pickle.PickleBuffer]) -> List[memoryview]:
+    """Flat C-contiguous views of the out-of-band buffers (raw() raises
+    on non-contiguous data, but pickle5 only emits contiguous ones)."""
+    return [b.raw() for b in buffers]
+
+
+def plan_envelope(meta: bytes, raws: Sequence[memoryview]
+                  ) -> Tuple[int, List[int]]:
+    """(total envelope size, per-buffer offsets) for write_envelope.
+
+    Computing the size up front lets the writer allocate the destination
+    (shm block or bytearray) exactly once and scatter the parts in.
+    """
+    off = _HDR.size + _BUF.size * len(raws) + len(meta)
+    offsets: List[int] = []
+    for r in raws:
+        off = (off + BUFFER_ALIGN - 1) & ~(BUFFER_ALIGN - 1)
+        offsets.append(off)
+        off += r.nbytes
+    return off, offsets
+
+
+def write_envelope(dest: Any, meta: bytes, raws: Sequence[memoryview],
+                   offsets: Sequence[int]) -> None:
+    """Scatter-write header + meta + buffers into `dest` (a writable
+    bytes-like of plan_envelope() size): each source buffer is copied
+    exactly once, directly to its final (aligned) location."""
+    _HDR.pack_into(dest, 0, len(meta), len(raws))
+    pos = _HDR.size
+    for off, r in zip(offsets, raws):
+        _BUF.pack_into(dest, pos, off, r.nbytes)
+        pos += _BUF.size
+    dest[pos:pos + len(meta)] = meta
+    np_dest = None
+    for off, r in zip(offsets, raws):
+        n = r.nbytes
+        if _np is not None and n >= _NP_COPY_MIN:
+            if np_dest is None:
+                np_dest = _np.frombuffer(dest, dtype=_np.uint8)
+            _np.copyto(np_dest[off:off + n],
+                       _np.frombuffer(r, dtype=_np.uint8))
+        else:
+            dest[off:off + n] = r
+
+
 def pack(value: Any) -> bytes:
-    """Serialize into one contiguous blob: u32 meta_len | meta | u32 nbuf |
-    (u64 len | bytes)*  — the on-disk/shm layout of a stored object."""
-    import struct
+    """Serialize into one contiguous envelope blob (inline objects, task
+    args — payloads that travel in-band over RPC rather than through
+    the shm store)."""
     meta, buffers = serialize(value)
-    parts = [struct.pack(">I", len(meta)), meta, struct.pack(">I", len(buffers))]
-    for b in buffers:
-        raw = b.raw()
-        parts.append(struct.pack(">Q", raw.nbytes))
-        parts.append(raw)
-    return b"".join(parts)
+    raws = raw_buffers(buffers)
+    total, offsets = plan_envelope(meta, raws)
+    out = bytearray(total)
+    write_envelope(out, meta, raws, offsets)
+    return bytes(out)
 
 
 def unpack(buf: memoryview) -> Any:
-    """Zero-copy deserialize from a packed blob (buffers view into `buf`)."""
-    import struct
-    (meta_len,) = struct.unpack_from(">I", buf, 0)
-    off = 4
-    meta = bytes(buf[off:off + meta_len])
-    off += meta_len
-    (nbuf,) = struct.unpack_from(">I", buf, off)
-    off += 4
+    """Zero-copy deserialize from an envelope (buffers view into `buf`)."""
+    meta_len, nbuf = _HDR.unpack_from(buf, 0)
+    pos = _HDR.size
     buffers = []
     for _ in range(nbuf):
-        (blen,) = struct.unpack_from(">Q", buf, off)
-        off += 8
+        off, blen = _BUF.unpack_from(buf, pos)
+        pos += _BUF.size
         buffers.append(buf[off:off + blen])
-        off += blen
+    meta = buf[pos:pos + meta_len]
     return deserialize(meta, buffers)

@@ -423,10 +423,99 @@ class SwallowedException(Rule):
                         "log the exception before continuing")
 
 
+class StoreViewCopy(Rule):
+    id = "RT009"
+    name = "store-view-copy"
+    rationale = ("bytes(view) / memoryview(bytes(...)) on a "
+                 "store-returned buffer copies the payload and defeats "
+                 "the zero-copy object plane - hold the view (pin the "
+                 "object for long-lived use) instead")
+
+    # The store implementation itself legitimately materializes bytes
+    # (chunked cross-node reads, small-object RPC payloads).
+    _EXEMPT_SUFFIXES = ("_private/object_store.py", "native/__init__.py")
+
+    # attribute calls whose result is a shm-backed view when the
+    # receiver is store-/arena-shaped
+    _VIEW_METHODS = {"view", "pull", "get"}
+
+    def _store_like(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        name = ctx.dotted(node)
+        if name is None:
+            # self.store.get(...): dotted() fails on self-attributes;
+            # fall back to the attribute chain's text
+            parts = []
+            cur = node
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                parts.append(cur.id)
+            name = ".".join(reversed(parts))
+        return "store" in name.lower() or "arena" in name.lower()
+
+    def _is_view_call(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._VIEW_METHODS
+                and self._store_like(ctx, node.func.value))
+
+    def _view_names(self, fn: ast.AST, ctx: ModuleContext) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    self._is_view_call(ctx, node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.path.replace("\\", "/").endswith(self._EXEMPT_SUFFIXES):
+            return
+        view_names_cache: dict = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            if node.func.id == "memoryview" and node.args and \
+                    isinstance(node.args[0], ast.Call) and \
+                    isinstance(node.args[0].func, ast.Name) and \
+                    node.args[0].func.id == "bytes":
+                yield self.finding(
+                    ctx, node,
+                    "memoryview(bytes(...)) materializes a full copy of "
+                    "the buffer; keep the original view (pin the object "
+                    "if it must outlive the ref)")
+                continue
+            if node.func.id != "bytes" or not node.args:
+                continue
+            arg = node.args[0]
+            # unwrap bytes(store.get([...])[oid]) / slices of a view
+            while isinstance(arg, ast.Subscript):
+                arg = arg.value
+            if self._is_view_call(ctx, arg):
+                yield self.finding(
+                    ctx, node,
+                    "bytes(...) over a store view copies the whole "
+                    "payload out of shared memory; use the view "
+                    "zero-copy (pin the object for long-lived use)")
+            elif isinstance(arg, ast.Name):
+                scope = ctx.enclosing_function(node) or ctx.tree
+                if scope not in view_names_cache:
+                    view_names_cache[scope] = self._view_names(scope, ctx)
+                if arg.id in view_names_cache[scope]:
+                    yield self.finding(
+                        ctx, node,
+                        f"bytes({arg.id}) copies a store-returned view "
+                        f"out of shared memory; use it zero-copy (pin "
+                        f"the object for long-lived use)")
+
+
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
     ClosureMutationInJit(), ActorCallWithoutRemote(), LeakedObjectRef(),
-    DictOrderPytree(), SwallowedException(),
+    DictOrderPytree(), SwallowedException(), StoreViewCopy(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

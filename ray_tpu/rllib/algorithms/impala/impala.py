@@ -132,12 +132,17 @@ def _to_timemajor(fragment: Dict[str, Any]) -> Dict[str, np.ndarray]:
     }
 
 
+def _batch_axis(key: str) -> int:
+    """Concat axis for time-major [T, B] columns ([B] bootstrap)."""
+    return 0 if key == "bootstrap_value" else 1
+
+
 def _concat_fragments(frags: List[Dict[str, np.ndarray]]
                       ) -> Dict[str, np.ndarray]:
     """Stack same-T fragments along the batch (env) axis."""
     out: Dict[str, np.ndarray] = {}
     for k in frags[0]:
-        axis = 0 if k == "bootstrap_value" else 1
+        axis = _batch_axis(k)
         out[k] = frags[0][k] if len(frags) == 1 else np.concatenate(
             [f[k] for f in frags], axis=axis)
     return out
@@ -164,6 +169,7 @@ class Impala(Algorithm):
         self._steps_trained = 0
         self._updates_done = 0
         self._feed = None
+        self._stage = None                    # HostStage (local learner)
         self._last_reported_trained = 0
         self._weights_version = 0
         self._synced_version = 0
@@ -210,10 +216,15 @@ class Impala(Algorithm):
                 self._updates_done += 1
                 self._weights_version += 1
 
-    def _assemble_train_batch(self) -> Optional[tuple]:
+    def _assemble_train_batch(self, staged: bool = False
+                              ) -> Optional[tuple]:
         """Once train_batch_size fresh steps accumulated: drain them, mix
         in replayed fragments per replay_proportion, and return
-        (batch, steps). Shared by the async and sync paths."""
+        (batch, steps). Shared by the async and sync paths. With
+        staged=True (local-learner async path) the fragments are copied
+        into a reusable HostStage slot instead of a fresh concatenation
+        — the DeviceFeed ships the slot's per-dtype segments fused and
+        recycles it once the transfer lands."""
         cfg = self.config
         if self._fresh_steps < cfg.train_batch_size:
             return None
@@ -230,10 +241,19 @@ class Impala(Algorithm):
                     len(self._replay))]
                 frags.append(f)
                 steps += f["actions"].size
+        if staged:
+            if self._stage is None:
+                from ray_tpu.rllib.utils.device_feed import HostStage
+                self._stage = HostStage(
+                    slots=cfg.learner_queue_size + 4)
+            return self._stage.assemble(frags, _batch_axis), steps
         return _concat_fragments(frags), steps
 
     def _maybe_enqueue_batch(self) -> int:
-        assembled = self._assemble_train_batch()
+        # staged slots only work when a local learner's DeviceFeed
+        # recycles them; gang learners get plain concatenated batches
+        assembled = self._assemble_train_batch(
+            staged=self.learner_group._local is not None)
         if assembled is None:
             return 0
         batch, steps = assembled

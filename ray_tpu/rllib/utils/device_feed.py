@@ -4,9 +4,15 @@ reference parity: SURVEY.md §7.3 names "EnvRunner→Learner throughput"
 a hard part — trajectories arrive host-side and the device feed must be
 pipelined to keep env-steps/sec/chip up. The reference keeps its GPU fed
 with torch pinned-memory prefetch inside the learner; the TPU-native
-equivalent dispatches `jax.device_put` for batch k+1 on a feeder thread
-while the chip executes update k, and accounts residual blocking time so
-benchmarks can report an honest feed-stall %.
+equivalent stages each batch into reusable pinned host buffers (one
+contiguous segment per dtype — HostStage), ships the few segments with
+fused `jax.device_put` calls on a feeder thread while the chip executes
+update k, and carves the per-column leaves back out ON DEVICE with a
+jitted, buffer-donating unfuse (the segment's HBM is reused for the
+leaves instead of living twice). Residual blocking time is accounted so
+benchmarks report an honest feed-stall %, and the copied-bytes counter +
+transfer-time histogram (`ray_tpu_transport_*`) make
+`feed_xfer_stall_pct` attributable.
 """
 
 from __future__ import annotations
@@ -14,7 +20,128 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _feed_metrics():
+    from ray_tpu.util.metrics import Counter, Histogram, get_or_create
+    counter = get_or_create(
+        Counter, "ray_tpu_transport_feed_bytes_total",
+        description="host->device bytes shipped by DeviceFeed")
+    hist = get_or_create(
+        Histogram, "ray_tpu_transport_feed_xfer_seconds",
+        description="host->device transfer time per batch (seconds)",
+        boundaries=[0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0])
+    return counter, hist
+
+
+class StagedBatch:
+    """One train batch packed into per-dtype contiguous host segments.
+
+    `segments` maps dtype name -> 1-D numpy buffer holding every column
+    of that dtype back to back; `layout` maps column key ->
+    (dtype_name, offset_elems, n_elems, shape). The feed ships the
+    segments (a handful of transfers regardless of column count) and
+    reconstructs the columns on device; host-side consumers (sync path,
+    gang learners) use as_dict().
+    """
+
+    __slots__ = ("segments", "layout", "_release_cb")
+
+    def __init__(self, segments: Dict[str, np.ndarray],
+                 layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]],
+                 release_cb=None):
+        self.segments = segments
+        self.layout = layout
+        self._release_cb = release_cb
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self.segments.values())
+
+    def as_dict(self) -> Dict[str, np.ndarray]:
+        """Host-side column views into the staging segments (valid until
+        release())."""
+        return {k: self.segments[dt][off:off + n].reshape(shape)
+                for k, (dt, off, n, shape) in self.layout.items()}
+
+    def release(self) -> None:
+        """Hand the staging slot back to its HostStage for reuse. Call
+        only when the segments' contents are no longer referenced (the
+        transfer landed, or the dict was deep-copied)."""
+        cb, self._release_cb = self._release_cb, None
+        if cb is not None:
+            cb(self.segments)
+
+
+class HostStage:
+    """Pool of reusable per-dtype staging buffers.
+
+    assemble() copies a list of same-structure fragments into ONE
+    contiguous buffer per dtype — the copy that np.concatenate would do
+    anyway, but into preallocated memory that is reused batch after
+    batch (steady state: zero allocations on the trajectory hot path).
+    Slots cycle through a bounded free list; if consumers fall behind
+    the pool grows a fresh slot rather than deadlocking.
+    """
+
+    def __init__(self, slots: int = 4):
+        self._slots = max(1, slots)
+        self._free: "queue.Queue[Dict[str, np.ndarray]]" = queue.Queue()
+        for _ in range(self._slots):
+            self._free.put({})
+        self.bytes_staged = 0
+
+    def _acquire(self) -> Dict[str, np.ndarray]:
+        try:
+            return self._free.get_nowait()
+        except queue.Empty:
+            # all slots in flight (consumer stalled): grow immediately
+            # rather than blocking the trajectory assembly hot path
+            return {}
+
+    def _release(self, segments: Dict[str, np.ndarray]) -> None:
+        # drop oversized pools silently (the grown slot replaces a lost one)
+        if self._free.qsize() < self._slots:
+            self._free.put(segments)
+
+    def assemble(self, frags: Sequence[Dict[str, np.ndarray]],
+                 axis_for) -> StagedBatch:
+        """Stack same-structure fragments along axis_for(key) into a
+        StagedBatch backed by a pooled slot."""
+        keys = list(frags[0].keys())
+        plans: List[Tuple[str, str, int, Tuple[int, ...], int]] = []
+        totals: Dict[str, int] = {}
+        for k in keys:
+            axis = axis_for(k)
+            parts = [np.asarray(f[k]) for f in frags]
+            shape = list(parts[0].shape)
+            shape[axis] = sum(p.shape[axis] for p in parts)
+            n = int(np.prod(shape))
+            dt = parts[0].dtype.name
+            plans.append((k, dt, totals.get(dt, 0), tuple(shape), axis))
+            totals[dt] = totals.get(dt, 0) + n
+        slot = self._acquire()
+        segments: Dict[str, np.ndarray] = {}
+        for dt, n in totals.items():
+            buf = slot.get(dt)
+            if buf is None or buf.size < n:
+                buf = np.empty(max(n, 1), dtype=np.dtype(dt))
+            segments[dt] = buf
+        layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]] = {}
+        for k, dt, off, shape, axis in plans:
+            n = int(np.prod(shape))
+            dest = segments[dt][off:off + n].reshape(shape)
+            parts = [np.asarray(f[k]) for f in frags]
+            if len(parts) == 1:
+                np.copyto(dest, parts[0])
+            else:
+                np.concatenate(parts, axis=axis, out=dest)
+            layout[k] = (dt, off, n, shape)
+            self.bytes_staged += dest.nbytes
+        return StagedBatch(segments, layout, release_cb=self._release)
 
 
 class DeviceFeed:
@@ -25,6 +152,11 @@ class DeviceFeed:
     `depth` bounds how many transfers may be in flight (double buffering
     at the default 2): enough to hide transfer latency behind compute,
     small enough not to pile batches up in HBM.
+
+    StagedBatch items take the fused path: one device_put per dtype
+    segment (instead of one per column), an on-device jitted unfuse that
+    DONATES the segment buffers into the reconstructed columns, and slot
+    recycling back to the HostStage the moment the transfer lands.
 
     Stall accounting (all in seconds, monotonically increasing):
       - wait_s: total consumer time blocked in get() — includes upstream
@@ -44,20 +176,76 @@ class DeviceFeed:
         self.xfer_s = 0.0
         self.busy_s = 0.0
         self.batches = 0
+        self.fused_batches = 0
+        self.bytes_fed = 0
+        self._unfuse_cache: Dict[Tuple, Any] = {}
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="device-feed")
         self._thread.start()
 
-    def _run(self) -> None:
+    # -- fused transfer ------------------------------------------------
+
+    def _unfuse_fn(self, layout_sig: Tuple):
+        """Jitted segments->columns reconstruction for one layout. The
+        segment arrays are donated: XLA reuses their HBM for the column
+        views instead of keeping batch bytes resident twice."""
         import jax
+        fn = self._unfuse_cache.get(layout_sig)
+        if fn is None:
+            layout = {k: (dt, off, n, shape)
+                      for k, dt, off, n, shape in layout_sig}
+
+            def unfuse(segs):
+                return {k: jax.lax.dynamic_slice_in_dim(
+                            segs[dt], off, n).reshape(shape)
+                        for k, (dt, off, n, shape)
+                        in sorted(layout.items())}
+
+            donate = () if jax.default_backend() == "cpu" else (0,)
+            fn = jax.jit(unfuse, donate_argnums=donate)
+            self._unfuse_cache[layout_sig] = fn
+        return fn
+
+    def _ship(self, batch: Any) -> Tuple[Any, int]:
+        """Host→device for one batch; returns (device batch, bytes)."""
+        import jax
+        if isinstance(batch, StagedBatch):
+            nbytes = batch.nbytes
+            segs = {dt: jax.device_put(seg)
+                    for dt, seg in sorted(batch.segments.items())}
+            # the transfer must land before the slot is reused
+            jax.block_until_ready(list(segs.values()))
+            sig = tuple((k, dt, off, n, shape) for k, (dt, off, n, shape)
+                        in sorted(batch.layout.items()))
+            dev = self._unfuse_fn(sig)(segs)
+            batch.release()
+            self.fused_batches += 1
+            return dev, nbytes
+        dev = jax.device_put(batch)
+        jax.block_until_ready(dev)
+        nbytes = sum(getattr(v, "nbytes", 0)
+                     for v in jax.tree_util.tree_leaves(dev))
+        return dev, nbytes
+
+    def _run(self) -> None:
+        counter = hist = None
         while not self._stop.is_set():
             try:
                 batch, meta = self._host.get(timeout=0.2)
             except queue.Empty:
                 continue
-            # Async dispatch: returns immediately; the copy streams to the
-            # device while the consumer is still computing on batch k-1.
-            dev = jax.device_put(batch)
+            t0 = time.perf_counter()
+            dev, nbytes = self._ship(batch)
+            dt = time.perf_counter() - t0
+            self.bytes_fed += nbytes
+            if counter is None:
+                try:
+                    counter, hist = _feed_metrics()
+                except Exception:  # noqa: BLE001 - metrics best-effort
+                    counter, hist = False, False
+            if counter:
+                counter.inc(nbytes)
+                hist.observe(dt)
             while not self._stop.is_set():
                 try:
                     self._out.put((dev, meta), timeout=0.2)
@@ -99,6 +287,8 @@ class DeviceFeed:
             "feed_xfer_stall_pct": (
                 100.0 * self.xfer_s / total) if total else 0.0,
             "batches_fed": self.batches,
+            "fused_batches": self.fused_batches,
+            "feed_bytes": self.bytes_fed,
         }
 
     def stop(self) -> None:

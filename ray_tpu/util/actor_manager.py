@@ -194,16 +194,27 @@ class FaultTolerantActorManager:
             return []
         ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
                                 timeout=timeout_seconds)
-        out: List[CallResult] = []
+        claimed: List[Tuple[Any, int, Any]] = []
         for ref in ready:
             with self._lock:
                 meta = self._in_flight.pop(ref, None)
-            if meta is None:
-                continue
-            i, tag = meta
+            if meta is not None:
+                claimed.append((ref, meta[0], meta[1]))
+        if not claimed:
+            return []
+        # One batched get for the whole ready set (a single store_wait
+        # RPC for local results) — the per-ref path below only runs when
+        # some result is an error, to keep per-actor failure isolation.
+        try:
+            values = ray_tpu.get([ref for ref, _, _ in claimed])
+            return [CallResult(i, True, v, tag)
+                    for (_, i, tag), v in zip(claimed, values)]
+        except Exception:  # noqa: BLE001 - isolate the failing actor(s)
+            pass
+        out: List[CallResult] = []
+        for ref, i, tag in claimed:
             try:
-                # only READY refs reach here; each get resolves
-                # instantly # graftlint: disable=RT002
+                # ready refs resolve instantly # graftlint: disable=RT002
                 out.append(CallResult(i, True, ray_tpu.get(ref), tag))
             except Exception as e:  # noqa: BLE001
                 if _is_actor_failure(e):

@@ -113,6 +113,33 @@ class Histogram(Metric):
                     "sum": dict(self._sums), "count": dict(self._counts)}
 
 
+def get_or_create(cls, name: str, **kwargs) -> "Metric":
+    """Idempotent registration for library-internal metrics (the
+    transport plane's counters are created on first use from whichever
+    hot path runs first): returns the existing instance when `name` is
+    already registered — raising TypeError if its kind differs — and
+    constructs it otherwise. User code should construct metrics directly
+    so accidental name collisions still fail loudly."""
+    with _REGISTRY_LOCK:
+        existing = _REGISTRY.get(name)
+    if existing is not None:
+        if not isinstance(existing, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(existing).__name__}, not {cls.__name__}")
+        return existing
+    try:
+        return cls(name, **kwargs)
+    except ValueError:
+        # a lost registration race leaves the winner in the registry;
+        # any other ValueError (bad kwargs) must propagate untouched
+        with _REGISTRY_LOCK:
+            winner = _REGISTRY.get(name)
+        if winner is None:
+            raise
+        return winner
+
+
 def collect() -> List[Dict]:
     """Snapshot every metric registered in this process."""
     with _REGISTRY_LOCK:

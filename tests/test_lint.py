@@ -511,6 +511,71 @@ def test_rt008_logged_handler_fine():
     assert "RT008" not in rules_hit(src)
 
 
+# ---- RT009 store-view copies ----------------------------------------------
+
+RT009_POS_DIRECT = """
+    def read(store, oid):
+        return bytes(store.get([oid])[oid])
+"""
+
+RT009_POS_NAME = """
+    def read(store, oid, addr, size):
+        view = store.pull(oid, addr, size)
+        return bytes(view)
+"""
+
+RT009_POS_MEMORYVIEW = """
+    def copy(view):
+        return memoryview(bytes(view))
+"""
+
+RT009_SUPPRESSED = """
+    def read(store, oid, addr, size):
+        view = store.pull(oid, addr, size)
+        return bytes(view)  # graftlint: disable=RT009
+"""
+
+
+def test_rt009_direct_store_call():
+    assert "RT009" in rules_hit(RT009_POS_DIRECT)
+
+
+def test_rt009_named_view():
+    assert "RT009" in rules_hit(RT009_POS_NAME)
+
+
+def test_rt009_memoryview_of_bytes():
+    assert "RT009" in rules_hit(RT009_POS_MEMORYVIEW)
+
+
+def test_rt009_suppressed():
+    assert "RT009" not in rules_hit(RT009_SUPPRESSED)
+
+
+def test_rt009_arena_view():
+    src = """
+        def read(arena, off, n):
+            v = arena.view(off, n)
+            return bytes(v)
+    """
+    assert "RT009" in rules_hit(src)
+
+
+def test_rt009_unrelated_bytes_fine():
+    src = """
+        def encode(s, q):
+            data = q.get()
+            return bytes(s, "utf-8") + bytes(data)
+    """
+    assert "RT009" not in rules_hit(src)
+
+
+def test_rt009_store_module_exempt():
+    fs = lint_source(textwrap.dedent(RT009_POS_NAME),
+                     "ray_tpu/_private/object_store.py")
+    assert not any(f.rule_id == "RT009" for f in fs)
+
+
 # ---- engine behavior ------------------------------------------------------
 
 def test_suppress_all_and_stacked_comment():
@@ -543,7 +608,7 @@ def test_alias_resolution():
 
 def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
-    assert ids == [f"RT00{i}" for i in range(1, 9)]
+    assert ids == [f"RT00{i}" for i in range(1, 10)]
     assert all(r.rationale for r in ALL_RULES)
 
 

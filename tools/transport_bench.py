@@ -1,0 +1,203 @@
+"""Object-transport microbenchmarks -> BENCH_TRANSPORT_r{N}.json.
+
+Measures the put->store->get->device path this repo's trajectory plane
+lives on (reference parity: ray_perf.py put/get suites + the plasma
+single-copy design point, Moritz et al. OSDI'18 §4.2):
+
+- put/get throughput (MB/s) per object size, 1-64 MiB: put is the
+  scatter-write (serialize -> one copy into shm), get is the zero-copy
+  view + unpack.
+- multi-ref get latency for K small local objects, plus the number of
+  store RPCs one batched get issues (the batching contract: 1).
+- end-to-end fragment ship: IMPALA-shaped time-major fragments staged
+  through HostStage into per-dtype segments (the DeviceFeed fused-feed
+  input), fragments/sec.
+
+Usage: python tools/transport_bench.py [--out FILE] [--format=json]
+Numbers are machine-dependent; medians of repeated batches (see
+box-perf guidance: single averages are ±40% noisy on small CI boxes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _median_time(fn, reps: int = 5) -> float:
+    """Median wall time of fn() over reps runs (first run warms)."""
+    fn()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def _warm_arena(store) -> None:
+    """Touch every payload page of the shm arena once (alloc + memset +
+    free, bypassing the reuse quarantine). First-touch page allocation
+    is a one-time OS cost every store pays exactly once per page;
+    warming it out isolates the transport software path, which is what
+    this bench compares across revisions."""
+    import numpy as np
+    fast = getattr(store, "_fast_arena", None)
+    arena = fast() if fast is not None else None
+    if arena is None:
+        return
+    offs = []
+    while True:
+        off = arena.alloc(16 << 20)
+        if not off:
+            break
+        offs.append(off)
+        np.frombuffer(arena.view(off, 16 << 20), dtype=np.uint8)[:] = 0
+    for off in offs:
+        arena.free(off)
+
+
+def bench_put_get(results: dict) -> None:
+    import numpy as np
+
+    import ray_tpu
+
+    w = ray_tpu._private.worker.global_worker()
+    _warm_arena(w.core_worker.store)
+    for mb in (1, 4, 16, 64):
+        arr = np.random.default_rng(0).integers(
+            0, 255, size=mb << 20, dtype=np.uint8)
+        n = max(2, 32 // mb)
+
+        put_times = []
+        refs: list = []
+        for rep in range(6):
+            while refs:  # cleanup OUTSIDE the timed region
+                w.core_worker.free([refs.pop()])
+            t0 = time.perf_counter()
+            refs = [ray_tpu.put(arr) for _ in range(n)]
+            if rep > 0:  # first round warms pages/arena blocks
+                put_times.append(time.perf_counter() - t0)
+        t_put = statistics.median(put_times)
+
+        def do_gets():
+            vals = ray_tpu.get(refs)
+            assert len(vals) == n
+
+        t_get = _median_time(do_gets, reps=5)
+        results[f"put_{mb}mib_mb_per_sec"] = round(mb * n / t_put, 1)
+        results[f"get_{mb}mib_mb_per_sec"] = round(mb * n / t_get, 1)
+        results[f"roundtrip_{mb}mib_mb_per_sec"] = round(
+            2 * mb * n / (t_put + t_get), 1)
+        print(f"{mb:>3} MiB: put {mb * n / t_put:8.0f} MB/s   "
+              f"get {mb * n / t_get:8.0f} MB/s", flush=True)
+        while refs:
+            w.core_worker.free([refs.pop()])
+
+
+def bench_multi_get(results: dict) -> None:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import rpc as rpc_lib
+
+    K = 32
+    # 256 KiB: past max_inline_object_size, so every ref lives in the
+    # shm store and the batched get's RPC behavior is what's measured
+    refs = [ray_tpu.put(np.full(256 << 10, i % 256, dtype=np.uint8))
+            for i in range(K)]
+    t = _median_time(lambda: ray_tpu.get(refs), reps=20)
+    results["multi_get_32x256k_ms"] = round(t * 1e3, 3)
+
+    # count store RPCs issued by one batched get from this thread
+    calls = []
+    orig = rpc_lib.RpcClient.call
+    tid = threading.get_ident()
+
+    def counting(self, method, **kwargs):
+        if threading.get_ident() == tid and method.startswith("store_"):
+            calls.append(method)
+        return orig(self, method, **kwargs)
+
+    rpc_lib.RpcClient.call = counting
+    try:
+        ray_tpu.get(refs)
+    finally:
+        rpc_lib.RpcClient.call = orig
+    results["multi_get_store_rpcs"] = len(calls)
+    print(f"multi-get {K}x256KiB: {t * 1e3:.2f} ms, "
+          f"{len(calls)} store RPC(s)", flush=True)
+
+
+def bench_fragment_ship(results: dict) -> None:
+    """EnvRunner-shaped fragments -> staged train batch, the host half
+    of the fused device feed."""
+    import numpy as np
+
+    from ray_tpu.rllib.utils.device_feed import HostStage
+
+    T, N, FRAGS = 50, 8, 8
+    rng = np.random.default_rng(0)
+    frags = [{
+        "obs": rng.random((T, N, 4, 16), dtype=np.float32),
+        "actions": rng.integers(0, 6, size=(T, N)).astype(np.int32),
+        "rewards": rng.random((T, N), dtype=np.float32),
+        "dones": np.zeros((T, N), dtype=bool),
+        "behaviour_logp": rng.random((T, N), dtype=np.float32),
+        "bootstrap_value": rng.random(N, dtype=np.float32),
+    } for _ in range(FRAGS)]
+    stage = HostStage(slots=2)
+    axis_for = (lambda k: 0 if k == "bootstrap_value" else 1)
+
+    def assemble():
+        sb = stage.assemble(frags, axis_for)
+        sb.release()
+        return sb
+
+    t = _median_time(assemble, reps=10)
+    nbytes = sum(v.nbytes for v in frags[0].values()) * FRAGS
+    results["fragment_ship_batches_per_sec"] = round(1.0 / t, 1)
+    results["fragment_ship_mb_per_sec"] = round(nbytes / t / (1 << 20), 1)
+    print(f"fragment ship: {1.0 / t:.1f} batches/s "
+          f"({nbytes / t / (1 << 20):.0f} MB/s staged)", flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="write results JSON to this path")
+    ap.add_argument("--format", choices=["text", "json"], default="text")
+    args = ap.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=512 << 20,
+                 ignore_reinit_error=True)
+    results: dict = {}
+    bench_put_get(results)
+    bench_multi_get(results)
+    bench_fragment_ship(results)
+    ray_tpu.shutdown()
+
+    doc = {"suite": "object_transport", "platform": "cpu",
+           "results": results}
+    if args.format == "json":
+        print(json.dumps(doc, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
