@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import serialization as ser
+from ray_tpu._private import spans as _spans
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID)
 from ray_tpu._private.object_ref import ObjectRef
@@ -228,6 +229,8 @@ class CoreWorker:
             "cw_kill_self": self._on_kill_self,
             "cw_can_exit": self._on_can_exit,
             "cw_ping": lambda: "pong",
+            # flight-recorder gather point (ray_tpu timeline --spans)
+            "cw_spans_snapshot": _spans.snapshot,
         }
         self.executor: Optional[_Executor] = None
         if mode == "worker":
@@ -236,6 +239,9 @@ class CoreWorker:
             handlers["w_cancel_task"] = self.executor.cancel_task
         self.server = rpc_lib.RpcServer(handlers, host=host)
         self.address = self.server.address
+        # one trace row per process in the merged timeline
+        _spans.set_process_label(f"{mode}-{self.worker_id.hex()[:8]}",
+                                 node_id=node_id_hex)
         # Owner-side node-failure detection (reference: the raylet notifies
         # owners via the object directory / lease failures; here the GCS
         # node channel is the death signal). Without it, tasks in flight
@@ -285,6 +291,8 @@ class CoreWorker:
                           name: Optional[str] = None) -> None:
         self._tls.trace_id = trace_id
         self._tls.trace_name = name
+        # mirror into the flight recorder so span records carry the trace
+        _spans.set_current_trace(trace_id)
 
     def _attach_trace(self, spec: TaskSpec) -> None:
         """Child tasks inherit the caller's trace; a driver-side submit
@@ -493,10 +501,10 @@ class CoreWorker:
                 pass
         with self._lock:
             self._ttl_pins.append(
-                (time.time() + ttl_s, local, remote_keys))
+                (time.monotonic() + ttl_s, local, remote_keys))
 
     def _expire_ttl_pins(self) -> None:
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             due = [p for p in self._ttl_pins if p[0] <= now]
             if not due:
@@ -553,24 +561,30 @@ class CoreWorker:
         directly into the shm block `store.create` returns (no joined
         intermediate blob). Small envelopes stay inline (zero store
         RPCs); returns the location tuple."""
-        meta, buffers = ser.serialize(value)
-        raws = ser.raw_buffers(buffers)
-        total, offsets = ser.plan_envelope(meta, raws)
-        if total <= Config.max_inline_object_size:
-            out = bytearray(total)
-            ser.write_envelope(out, meta, raws, offsets)
-            return (INLINE, bytes(out))
-        buf = self.store.create(oid_hex, total)
+        _t0 = _spans.begin()
+        total = 0
         try:
-            ser.write_envelope(buf, meta, raws, offsets)
-            self.store.seal(oid_hex)
-        except BaseException:
-            # reclaim the block: a fast-path allocation the server never
-            # saw would otherwise leak arena space until store teardown
-            self.store.abort_create(oid_hex)
-            raise
-        _transport_bytes(total, "put")
-        return (STORE, self.store.address, total)
+            meta, buffers = ser.serialize(value)
+            raws = ser.raw_buffers(buffers)
+            total, offsets = ser.plan_envelope(meta, raws)
+            if total <= Config.max_inline_object_size:
+                out = bytearray(total)
+                ser.write_envelope(out, meta, raws, offsets)
+                return (INLINE, bytes(out))
+            buf = self.store.create(oid_hex, total)
+            try:
+                ser.write_envelope(buf, meta, raws, offsets)
+                self.store.seal(oid_hex)
+            except BaseException:
+                # reclaim the block: a fast-path allocation the server
+                # never saw would otherwise leak arena space until store
+                # teardown
+                self.store.abort_create(oid_hex)
+                raise
+            _transport_bytes(total, "put")
+            return (STORE, self.store.address, total)
+        finally:
+            _spans.end("cw.store_value", _t0, bytes=total)
 
     def store_blob(self, oid_hex: str, blob: bytes) -> Tuple:
         """Write an already-serialized envelope inline or to the local
@@ -595,8 +609,9 @@ class CoreWorker:
         resolves), then materialize the whole batch — all local store
         objects in ONE store_wait RPC, remote replicas via pipelined
         concurrent pulls, inline values with zero RPCs."""
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         blocked_notified = False
+        _t0 = _spans.begin()
         try:
             hexes = [ref.hex() for ref in refs]
             locs: List[Optional[Tuple]] = [None] * len(refs)
@@ -622,6 +637,12 @@ class CoreWorker:
                         self._remove_wait_edge(edge)
             return self._materialize_many(refs, hexes, locs, deadline)
         finally:
+            # single-ref fast gets are 1:1 with their store_wait RPC
+            # (already spanned client-side); record the umbrella span
+            # only when it adds information — batching, or a get that
+            # actually waited
+            if len(refs) > 1 or _spans.perf_counter() - _t0 >= 0.001:
+                _spans.end("cw.get", _t0, nrefs=len(refs))
             if blocked_notified:
                 try:
                     self._nm.call("nm_worker_unblocked",
@@ -874,7 +895,7 @@ class CoreWorker:
                             "(freed?)")
                     # our own pending task result: wait on event
                     remaining = None if deadline is None \
-                        else deadline - time.time()
+                        else deadline - time.monotonic()
                     if remaining is not None and remaining <= 0:
                         raise exc.GetTimeoutError(
                             f"get timed out waiting for {h[:16]}")
@@ -884,7 +905,7 @@ class CoreWorker:
                 # borrower: long-poll the owner (reference pubsub
                 # long-poll; a 5ms busy-poll collapses at scale)
                 remaining = None if deadline is None \
-                    else deadline - time.time()
+                    else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise exc.GetTimeoutError(
                         f"get timed out waiting for {h[:16]}")
@@ -899,7 +920,7 @@ class CoreWorker:
                     raise exc.OwnerDiedError(
                         f"owner {ref.owner_address} of {h[:16]} died")
                 if loc[0] in (PENDING, "unknown"):
-                    if deadline is not None and time.time() > deadline:
+                    if deadline is not None and time.monotonic() > deadline:
                         raise exc.GetTimeoutError(
                             f"get timed out waiting for {h[:16]}")
                     time.sleep(0.05 if loc[0] == "unknown" else 0.0)
@@ -992,7 +1013,7 @@ class CoreWorker:
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
         while True:
@@ -1002,7 +1023,7 @@ class CoreWorker:
             pending = still
             if len(ready) >= num_returns or not pending:
                 break
-            if deadline is not None and time.time() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 break
             time.sleep(0.005)
         # preserve input order
@@ -1144,7 +1165,7 @@ class CoreWorker:
 
     def _store_to_node_map(self) -> Dict[Tuple[str, int], str]:
         ts, cached = self._store_map_cache
-        if time.time() - ts < 5.0:
+        if time.monotonic() - ts < 5.0:
             return cached
         try:
             nodes = self._gcs.call("get_all_nodes")
@@ -1152,7 +1173,7 @@ class CoreWorker:
             return cached
         mapping = {tuple(n.store_address): n.node_id.hex()
                    for n in nodes if n.alive}
-        self._store_map_cache = (time.time(), mapping)
+        self._store_map_cache = (time.monotonic(), mapping)
         return mapping
 
     def _on_lease_respill(self, task_id: TaskID,
@@ -1758,8 +1779,8 @@ class CoreWorker:
                                  args=(spec.actor_id,), daemon=True).start()
 
     def _resolve_actor(self, actor_id: ActorID) -> None:
-        deadline = time.time() + 300
-        while time.time() < deadline and not self._shutdown:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and not self._shutdown:
             try:
                 info = self._gcs.call("get_actor_info",
                                       actor_id_hex=actor_id.hex())
@@ -1863,7 +1884,7 @@ class CoreWorker:
         """Long-poll variant of cw_get_object (reference: the pubsub
         long-poll object-location channel, core_worker.proto:441): parks
         until the object resolves instead of making borrowers busy-poll."""
-        deadline = time.time() + min(timeout, 60.0)
+        deadline = time.monotonic() + min(timeout, 60.0)
         while True:
             with self._lock:
                 loc = self.objects.get(oid_hex)
@@ -1872,7 +1893,7 @@ class CoreWorker:
                         oid_hex, threading.Event())
                 else:
                     return loc if loc is not None else ("unknown",)
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return (PENDING,)
             ev.wait(timeout=min(remaining, 1.0))
@@ -2254,6 +2275,11 @@ class _Executor:
             return recycle_candidate and cw._on_can_exit()
         cw.set_current_task(spec.task_id)
         cw.set_current_trace(spec.trace_id)
+        # manual begin/end (the finally below clears the trace context,
+        # so a `with` wrapping it would record a trace-less span)
+        _task_span = _spans.start_span("task.run",
+                                       name=spec.function_name,
+                                       task_id=spec.task_id.hex())
         cw.task_events.record(spec.task_id.hex(), state="RUNNING",
                               ts_running=_ev_now(),
                               worker_id=cw.worker_id.hex(),
@@ -2369,6 +2395,7 @@ class _Executor:
             self._report_done(spec, results, worker_exiting=will_exit,
                               nested_refs=nested)
         finally:
+            _spans.finish_span(_task_span)
             cw.task_events.record(spec.task_id.hex(), ts_exec_end=_ev_now())
             cw.set_current_task(None)
             cw.set_current_trace(None)

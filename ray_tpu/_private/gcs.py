@@ -245,6 +245,9 @@ class GcsServer:
             # task events (reference TaskInfoGcsService / GcsTaskManager)
             "add_task_events": self.add_task_events,
             "list_tasks": self.list_tasks,
+            # flight recorder: cluster-wide span-ring gather
+            # (`ray_tpu timeline --spans`, dashboard /api/timeline?spans=1)
+            "spans_collect": self.spans_collect,
             # structured events (reference ReportEventService)
             "add_events": self.add_events,
             "list_events": self.list_events,
@@ -264,6 +267,10 @@ class GcsServer:
             "ping": lambda: "pong",
         }, host=host, port=port)
         self.address = self.server.address
+        # standalone GCS processes get a trace row; in-process head nodes
+        # are relabeled by the driver's CoreWorker (one process, one row)
+        from ray_tpu._private import spans as spans_lib
+        spans_lib.set_process_label("gcs")
         self._health_thread = threading.Thread(
             target=self._health_check_loop, daemon=True, name="gcs-health")
         self._health_thread.start()
@@ -452,8 +459,8 @@ class GcsServer:
     def _schedule_actor(self, actor_id_hex: str) -> None:
         spec = self.actor_specs[actor_id_hex]
         required = spec.required_resources()
-        deadline = time.time() + 120
-        while time.time() < deadline:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
             node_id_hex = self._pick_node_for(required, spec)
             if node_id_hex is None:
                 time.sleep(0.1)
@@ -593,6 +600,108 @@ class GcsServer:
             records = [r for r in records
                        if all(r.get(k) == v for k, v in filters.items())]
         return records[-limit:]
+
+    # ---- flight recorder (see _private/spans.py) ------------------------
+
+    SPANS_COLLECT_TIMEOUT_S = 5.0
+
+    def spans_collect(self) -> List[Dict[str, Any]]:
+        """Fan a snapshot request out to every process and gather the
+        span rings: this process, every node manager (which gathers its
+        own workers), and every pubsub subscriber (drivers live outside
+        any node manager's worker table). Each snapshot is annotated
+        with `clock_offset_s` — the RPC-midpoint estimate of
+        peer_wall_clock - gcs_wall_clock — so the merger can align all
+        processes onto one timebase. Best-effort: unreachable processes
+        just drop out of the trace."""
+        from ray_tpu._private import spans as spans_lib
+        own = spans_lib.snapshot()
+        own["clock_offset_s"] = 0.0
+        # a process can be reached twice (subscribed workers also appear
+        # in their node manager's table); dedupe is by proc uid with a
+        # deterministic preference: own ring (offset exactly 0), then
+        # direct core-worker estimates, then NM-chained ones (two
+        # estimation hops)
+        direct: List[Dict[str, Any]] = []
+        via_nm: List[Dict[str, Any]] = []
+        with self._lock:
+            nm_addrs = [tuple(n.address) for n in self.nodes.values()
+                        if n.alive]
+            sub_addrs = {tuple(addr) for subs in self.subscribers.values()
+                         for addr, _tok in subs}
+        sub_addrs -= set(nm_addrs)  # NMs answer nm_*, not cw_*
+
+        lock = threading.Lock()
+
+        covered_addrs: set = set()
+
+        def _pull_nm(addr: Tuple[str, int]) -> None:
+            got = spans_lib.pull_snapshot(
+                addr, "nm_spans_snapshot",
+                timeout=self.SPANS_COLLECT_TIMEOUT_S)
+            if got is None:
+                return
+            reply, t0, _t1 = got
+            # offset of the NM's wall clock vs ours; the NM already
+            # stamped each of its workers relative to ITS clock. The NM
+            # stamps wall_time at handler ENTRY (its own worker gather
+            # can take seconds, so the usual RPC-midpoint reference
+            # would be skewed by half the gather) — the reference point
+            # is t0 + one-way network latency.
+            # cross-process clock-offset estimation is the one place a
+            # wall-clock difference is the point (monotonic clocks are
+            # not comparable across processes/hosts).
+            offset = reply["wall_time"] - t0
+            batch = []
+            for snap in reply["snapshots"]:
+                snap["clock_offset_s"] = \
+                    snap.get("clock_offset_s", 0.0) + offset
+                batch.append(snap)
+            with lock:
+                via_nm.extend(batch)
+                covered_addrs.update(
+                    tuple(a) for a in reply.get("worker_addrs", ()))
+
+        def _pull_cw(addr: Tuple[str, int]) -> None:
+            got = spans_lib.pull_snapshot(
+                addr, "cw_spans_snapshot",
+                timeout=self.SPANS_COLLECT_TIMEOUT_S)
+            if got is None:
+                return
+            snap, t0, t1 = got
+            snap["clock_offset_s"] = snap["wall_time"] - (t0 + t1) / 2.0
+            with lock:
+                direct.append(snap)
+
+        deadline = time.monotonic() + self.SPANS_COLLECT_TIMEOUT_S + 2.0
+        # Phase 1: node managers (each gathers its own workers). Joining
+        # first lets phase 2 skip every worker an NM already shipped —
+        # workers also sit in `subscribers`, and pulling them directly
+        # too would transfer each ring twice just to dedupe by proc uid.
+        threads = [threading.Thread(target=_pull_nm, args=(a,),
+                                    daemon=True) for a in nm_addrs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        # Phase 2: remaining subscribers — drivers, plus workers whose
+        # NM dropped out mid-collect.
+        threads = [threading.Thread(target=_pull_cw, args=(a,),
+                                    daemon=True)
+                   for a in sub_addrs - covered_addrs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        seen: set = set()
+        unique: List[Dict[str, Any]] = []
+        for snap in [own] + direct + via_nm:
+            uid = snap.get("proc_uid")
+            if uid in seen:
+                continue
+            seen.add(uid)
+            unique.append(snap)
+        return unique
 
     # ---- structured events (reference util/event.h sink) ----------------
 
@@ -770,8 +879,8 @@ class GcsServer:
                                   deadline_s: float = 120.0) -> None:
         from ray_tpu._private.scheduler import pack_bundles
         info = self.placement_groups[pg_id_hex]
-        deadline = time.time() + deadline_s
-        while time.time() < deadline and not self._dead:
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline and not self._dead:
             if info.state == "REMOVED":
                 return
             with self._lock:

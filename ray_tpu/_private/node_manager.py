@@ -66,7 +66,7 @@ class _WorkerHandle:
     proc: Optional[subprocess.Popen]
     address: Optional[Tuple[str, int]] = None
     runtime_env_key: str = ""
-    idle_since: float = field(default_factory=time.time)
+    idle_since: float = field(default_factory=time.monotonic)
     # Set while leased/executing
     lease_id: Optional[str] = None
     current_task: Optional[TaskSpec] = None
@@ -83,7 +83,7 @@ class _PendingLease:
     spec: TaskSpec
     reply_to: Tuple[str, int]    # requesting core worker's RPC address
     acquired: Optional[ResourceSet] = None
-    submitted_at: float = field(default_factory=time.time)
+    submitted_at: float = field(default_factory=time.monotonic)
 
 
 class NodeManager:
@@ -171,11 +171,15 @@ class NodeManager:
             "nm_return_bundle": self.return_bundle,
             "nm_get_info": self.get_info,
             "nm_list_workers": self.list_workers,
+            "nm_spans_snapshot": self.spans_snapshot,
             "nm_profile_worker": self.profile_worker,
             "nm_drain": self.drain,
         }, host=host)
         self.address = self.server.address
 
+        from ray_tpu._private import spans as _spans_lib
+        _spans_lib.set_process_label(f"raylet-{self.node_id.hex()[:8]}",
+                                     node_id=self.node_id.hex())
         self.info = NodeInfo(
             node_id=self.node_id, address=self.address,
             store_address=self.store.address,
@@ -276,7 +280,7 @@ class NodeManager:
         if timeout <= 0:
             return
         floor = max(0, int(Config.idle_worker_pool_floor))
-        now = time.time()
+        now = time.monotonic()
         candidates: List[_WorkerHandle] = []
         with self._lock:
             n_idle = sum(len(ids) for ids in self.idle.values())
@@ -590,7 +594,7 @@ class NodeManager:
                 raise KeyError(f"unknown worker {worker_id_hex}")
             handle.address = tuple(address)
             handle.registered = True
-            handle.idle_since = time.time()
+            handle.idle_since = time.monotonic()
             self._starting = max(0, self._starting - 1)
             key = handle.runtime_env_key
             self._starting_by_key[key] = max(
@@ -823,7 +827,7 @@ class NodeManager:
             handle.blocked = False
             handle.current_task = None
             handle.lease_id = None
-            handle.idle_since = time.time()
+            handle.idle_since = time.monotonic()
             if reuse:
                 self.idle.setdefault(handle.runtime_env_key, []).append(wid)
         if not reuse and handle.proc is not None:
@@ -840,9 +844,9 @@ class NodeManager:
             if not required.is_subset_of(self.available):
                 return False
             self.available.subtract(required)
-        deadline = time.time() + Config.worker_register_timeout_s
+        deadline = time.monotonic() + Config.worker_register_timeout_s
         handle: Optional[_WorkerHandle] = None
-        while handle is None and time.time() < deadline:
+        while handle is None and time.monotonic() < deadline:
             handle = self._pop_worker(spec)
             if handle is None:
                 time.sleep(0.02)
@@ -1052,9 +1056,9 @@ class NodeManager:
         before = os.path.getsize(log_path) \
             if os.path.exists(log_path) else 0
         os.kill(handle.proc.pid, _signal.SIGUSR1)
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         stack = ""
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             time.sleep(0.1)
             if os.path.exists(log_path) and \
                     os.path.getsize(log_path) > before:
@@ -1067,6 +1071,59 @@ class NodeManager:
                 "pid": handle.proc.pid,
                 "node_id": self.node_id.hex(),
                 "stack": stack}
+
+    SPANS_WORKER_TIMEOUT_S = 3.0
+
+    def spans_snapshot(self) -> Dict[str, Any]:
+        """Flight-recorder gather for this node: the daemon's own span
+        ring (which includes the store server — same process) plus every
+        registered worker's, each annotated with the RPC-midpoint
+        estimate of worker_wall_clock - nm_wall_clock. The reply's
+        top-level wall_time lets the GCS chain its own offset estimate
+        on top (see gcs.spans_collect)."""
+        from ray_tpu._private import spans as spans_lib
+        # stamp the reply's wall clock BEFORE the worker gather: the GCS
+        # estimates this node's clock offset as wall_time - rpc_midpoint,
+        # and a slow gather (one hung worker burns its full timeout)
+        # stamped at the end would skew every snapshot from this node by
+        # half the gather duration
+        reply_wall = time.time()
+        own = spans_lib.snapshot()
+        own["clock_offset_s"] = 0.0
+        snapshots: List[Dict[str, Any]] = [own]
+        with self._lock:
+            worker_addrs = [h.address for h in self.workers.values()
+                            if h.registered and h.address is not None]
+        lock = threading.Lock()
+
+        pulled_addrs: List = []
+
+        def _pull(addr) -> None:
+            got = spans_lib.pull_snapshot(
+                addr, "cw_spans_snapshot",
+                timeout=self.SPANS_WORKER_TIMEOUT_S)
+            if got is None:
+                return
+            snap, t0, t1 = got
+            snap["clock_offset_s"] = snap["wall_time"] - (t0 + t1) / 2.0
+            with lock:
+                snapshots.append(snap)
+                pulled_addrs.append(addr)
+
+        threads = [threading.Thread(target=_pull, args=(a,), daemon=True)
+                   for a in worker_addrs]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.SPANS_WORKER_TIMEOUT_S + 1.0
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        # worker_addrs lets the GCS skip its direct-subscriber pull for
+        # workers this reply already covers (they also subscribe to
+        # pubsub, so without this every worker ring would ship twice).
+        # Only successfully-pulled workers count: one the NM couldn't
+        # reach may still be reachable from the GCS directly.
+        return {"wall_time": reply_wall, "snapshots": snapshots,
+                "worker_addrs": [list(a) for a in pulled_addrs]}
 
     def list_workers(self) -> List[Dict[str, Any]]:
         """Worker-level metadata for the state API (`ray list workers`)."""

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import chaos as chaos_lib
 from ray_tpu._private import rpc as rpc_lib
+from ray_tpu._private import spans as _spans
 
 logger = logging.getLogger(__name__)
 
@@ -147,10 +148,10 @@ class StoreServer:
     ARENA_FREE_DELAY_S = 10.0
 
     def _arena_release_locked(self, offset: int) -> None:
-        self._quarantine.append((time.time(), offset))
+        self._quarantine.append((time.monotonic(), offset))
 
     def _drain_quarantine_locked(self, force: bool = False) -> None:
-        now = time.time()
+        now = time.monotonic()
         keep = []
         for t, off in self._quarantine:
             if force or now - t >= self.ARENA_FREE_DELAY_S:
@@ -321,6 +322,9 @@ class StoreServer:
         by the owner's refcount) removes them. Pulled replica copies are
         created unpinned and evictable (the primary exists elsewhere).
         """
+        # no dedicated span: RPC creates are visible as
+        # rpc.server(store_create); fast-path client creates sit inside
+        # cw.store_value — a third record would only add recorder cost
         chaos_lib.on_store_op("store_create", [object_id], self)
         with self._lock:
             if object_id in self._objects:
@@ -424,8 +428,23 @@ class StoreServer:
         pin=True takes one reader lease per returned object (release
         with unpin) so the descriptors stay valid as zero-copy views."""
         chaos_lib.on_store_op("store_wait", list(object_ids), self)
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         num_required = len(object_ids) if num_required is None else num_required
+        # span only when the wait actually BLOCKED: that is the signal
+        # this op exists to expose, and already-sealed lookups (the
+        # trajectory-plane common case) stay recorder-free
+        _t0 = _spans.begin()
+        blocked = [False]
+        try:
+            return self._wait_impl(object_ids, deadline, num_required,
+                                   pin, blocked)
+        finally:
+            if blocked[0]:
+                _spans.end("store.wait", _t0, n=len(object_ids))
+
+    def _wait_impl(self, object_ids: List[str],
+                   deadline: Optional[float], num_required: int,
+                   pin: bool, blocked: List[bool]) -> Dict[str, Tuple]:
         with self._sealed_cv:
             while True:
                 ready = {}
@@ -441,12 +460,14 @@ class StoreServer:
                         for oid in ready:
                             self._objects[oid].leases += 1
                     return ready
-                remaining = None if deadline is None else deadline - time.time()
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     if pin:
                         for oid in ready:
                             self._objects[oid].leases += 1
                     return ready
+                blocked[0] = True
                 self._sealed_cv.wait(timeout=min(remaining or 1.0, 1.0))
 
     def contains(self, object_id: str) -> bool:
@@ -550,6 +571,13 @@ class StoreServer:
         returned descriptor is safe for zero-copy views until unpin.
         reference parity: pull_manager.h / push_manager.h chunk streaming."""
         chaos_lib.on_store_op("store_pull", [object_id], self)
+        with _spans.span("store.pull", bytes=size):
+            return self._pull_impl(object_id, from_store, size,
+                                           lease)
+
+    def _pull_impl(self, object_id: str,
+                           from_store: Tuple[str, int], size: int,
+                           lease: bool) -> Tuple:
         while True:
             with self._lock:
                 e = self._objects.get(object_id)

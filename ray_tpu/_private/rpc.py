@@ -22,9 +22,19 @@ import time
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import itertools
+
 from ray_tpu._private import chaos as chaos_lib
+from ray_tpu._private import spans as _spans
 
 _LEN = struct.Struct(">Q")
+
+# Server-handle spans are edge-sampled (Dapper-style): most handlers are
+# tens of µs and a per-dispatch record would tax every RPC by ~1%; one
+# in K still shows where server time goes, scaled by the rate. Blocking
+# ops keep their own always-on spans (store.wait / store.pull).
+_SERVER_SPAN_SAMPLE_K = 16
+_server_span_tick = itertools.count()
 
 
 def find_free_port(host: str = "127.0.0.1") -> int:
@@ -101,36 +111,43 @@ class _Handler(socketserver.BaseRequestHandler):
                     method, kwargs, oneway = item
                 else:
                     (method, kwargs), oneway = item, False
-                # chaos plane server hook: delay / kill_worker rules
-                # (subsumes the old _chaos_delay env-var injection)
-                chaos_lib.on_server_dispatch(method)
-                try:
-                    handler = server.handlers[method]
-                except KeyError:
-                    reply = ("err", f"no such rpc method: {method}")
-                else:
+                with _spans.span("rpc.server", method=method,
+                                 bytes=len(req),
+                                 sampled=_SERVER_SPAN_SAMPLE_K) \
+                        if next(_server_span_tick) \
+                        % _SERVER_SPAN_SAMPLE_K == 0 else _spans.NOOP:
+                    # chaos plane server hook: delay / kill_worker rules
+                    # (subsumes the old _chaos_delay env-var injection)
+                    chaos_lib.on_server_dispatch(method)
                     try:
-                        result = handler(**kwargs)
-                        reply = ("ok", result)
-                    except Exception as e:  # noqa: BLE001 - ship to caller
-                        # Typed propagation: the client re-raises the real
-                        # exception class (e.g. ObjectStoreFullError from a
-                        # store handler) so callers can catch specifically;
-                        # the traceback string rides along for diagnostics.
+                        handler = server.handlers[method]
+                    except KeyError:
+                        reply = ("err", f"no such rpc method: {method}")
+                    else:
                         try:
-                            blob = pickle.dumps(e, protocol=5)
-                        except Exception:  # noqa: BLE001 - unpicklable exc
-                            blob = None
-                        reply = ("err", (blob, traceback.format_exc()))
-                if oneway:
-                    # fire-and-forget frame: no reply; surface handler
-                    # errors in the server log (callers detect failures
-                    # out-of-band — death pubsub, connection loss)
-                    if reply[0] == "err":
-                        logging.getLogger(__name__).warning(
-                            "oneway rpc %s failed: %s", method, reply[1])
-                    continue
-                _send_frame(sock, pickle.dumps(reply, protocol=5))
+                            result = handler(**kwargs)
+                            reply = ("ok", result)
+                        except Exception as e:  # noqa: BLE001 - to caller
+                            # Typed propagation: the client re-raises the
+                            # real exception class (e.g.
+                            # ObjectStoreFullError from a store handler) so
+                            # callers can catch specifically; the traceback
+                            # string rides along for diagnostics.
+                            try:
+                                blob = pickle.dumps(e, protocol=5)
+                            except Exception:  # noqa: BLE001 - unpicklable
+                                blob = None
+                            reply = ("err", (blob, traceback.format_exc()))
+                    if oneway:
+                        # fire-and-forget frame: no reply; surface handler
+                        # errors in the server log (callers detect failures
+                        # out-of-band — death pubsub, connection loss)
+                        if reply[0] == "err":
+                            logging.getLogger(__name__).warning(
+                                "oneway rpc %s failed: %s", method,
+                                reply[1])
+                        continue
+                    _send_frame(sock, pickle.dumps(reply, protocol=5))
         except (ConnectionLost, ConnectionResetError, BrokenPipeError, OSError):
             return
         finally:
@@ -241,6 +258,17 @@ class RpcClient:
 
     def call(self, method: str, **kwargs: Any) -> Any:
         payload = pickle.dumps((method, kwargs), protocol=5)
+        # always-on span via the cheap begin/end pair; covers lock wait
+        # + send + recv — the latency the CALLER observes (lock
+        # contention on a shared client is real stall)
+        _t0 = _spans.begin()
+        try:
+            return self._call_locked(method, payload)
+        finally:
+            _spans.end("rpc.client", _t0, method=method,
+                       bytes=len(payload))
+
+    def _call_locked(self, method: str, payload: bytes) -> Any:
         idempotent = _is_idempotent(method)
         max_attempts = 1 + (self.IDEMPOTENT_RETRIES if idempotent else 1)
         with self._lock:
@@ -301,7 +329,13 @@ class RpcClient:
         detected out-of-band (actor-death pubsub, worker connection
         loss), never for requests whose reply carries state."""
         payload = pickle.dumps((method, kwargs, True), protocol=5)
-        with self._lock:
+        # span only for sends big enough that the kernel copy is worth
+        # measuring; tiny fire-and-forget frames (store_register, ref
+        # bookkeeping) are visible server-side as rpc.server records
+        with _spans.span("rpc.client.oneway", method=method,
+                         bytes=len(payload)) \
+                if len(payload) >= (1 << 16) else _spans.NOOP, \
+                self._lock:
             for attempt in (0, 1):
                 try:
                     chaos_lib.on_client_call(method, self.address)

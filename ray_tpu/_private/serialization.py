@@ -26,11 +26,14 @@ buffer views out of the envelope without copying.
 from __future__ import annotations
 
 import io
+import itertools
 import pickle
 import struct
 from typing import Any, List, Sequence, Tuple
 
 import cloudpickle
+
+from ray_tpu._private import spans as _spans
 
 try:
     import numpy as _np
@@ -44,6 +47,19 @@ BUFFER_ALIGN = 64
 # than memoryview slice assignment on this class of box; below this size
 # the frombuffer setup costs more than it saves
 _NP_COPY_MIN = 1 << 14
+# Envelope spans only for payloads big enough to be worth measuring —
+# tiny inline envelopes (task args) would pay more to be measured than
+# to be processed.
+_SPAN_MIN_BYTES = 1 << 16
+# Both envelope spans are edge-sampled (Dapper): they sit INSIDE the
+# always-on cw.store_value / cw.get umbrella spans, and a recorder call
+# next to a MB-scale copy runs with a cold cache (~10µs, not the ~2µs
+# tight-loop cost), which would alone break the <1% put-path budget.
+# One in K still shows the serialize-vs-copy split, scaled by the rate.
+_WRITE_SAMPLE_K = 16
+_READ_SAMPLE_K = 32
+_write_tick = itertools.count()
+_read_tick = itertools.count()
 
 
 def dumps_function(fn: Any) -> bytes:
@@ -118,22 +134,26 @@ def write_envelope(dest: Any, meta: bytes, raws: Sequence[memoryview],
     """Scatter-write header + meta + buffers into `dest` (a writable
     bytes-like of plan_envelope() size): each source buffer is copied
     exactly once, directly to its final (aligned) location."""
-    _HDR.pack_into(dest, 0, len(meta), len(raws))
-    pos = _HDR.size
-    for off, r in zip(offsets, raws):
-        _BUF.pack_into(dest, pos, off, r.nbytes)
-        pos += _BUF.size
-    dest[pos:pos + len(meta)] = meta
-    np_dest = None
-    for off, r in zip(offsets, raws):
-        n = r.nbytes
-        if _np is not None and n >= _NP_COPY_MIN:
-            if np_dest is None:
-                np_dest = _np.frombuffer(dest, dtype=_np.uint8)
-            _np.copyto(np_dest[off:off + n],
-                       _np.frombuffer(r, dtype=_np.uint8))
-        else:
-            dest[off:off + n] = r
+    sampled = (len(dest) >= _SPAN_MIN_BYTES
+               and next(_write_tick) % _WRITE_SAMPLE_K == 0)
+    with _spans.span("envelope.write", bytes=len(dest),
+                     sampled=_WRITE_SAMPLE_K) if sampled else _spans.NOOP:
+        _HDR.pack_into(dest, 0, len(meta), len(raws))
+        pos = _HDR.size
+        for off, r in zip(offsets, raws):
+            _BUF.pack_into(dest, pos, off, r.nbytes)
+            pos += _BUF.size
+        dest[pos:pos + len(meta)] = meta
+        np_dest = None
+        for off, r in zip(offsets, raws):
+            n = r.nbytes
+            if _np is not None and n >= _NP_COPY_MIN:
+                if np_dest is None:
+                    np_dest = _np.frombuffer(dest, dtype=_np.uint8)
+                _np.copyto(np_dest[off:off + n],
+                           _np.frombuffer(r, dtype=_np.uint8))
+            else:
+                dest[off:off + n] = r
 
 
 def pack(value: Any) -> bytes:
@@ -150,12 +170,16 @@ def pack(value: Any) -> bytes:
 
 def unpack(buf: memoryview) -> Any:
     """Zero-copy deserialize from an envelope (buffers view into `buf`)."""
-    meta_len, nbuf = _HDR.unpack_from(buf, 0)
-    pos = _HDR.size
-    buffers = []
-    for _ in range(nbuf):
-        off, blen = _BUF.unpack_from(buf, pos)
-        pos += _BUF.size
-        buffers.append(buf[off:off + blen])
-    meta = buf[pos:pos + meta_len]
-    return deserialize(meta, buffers)
+    sampled = (len(buf) >= _SPAN_MIN_BYTES
+               and next(_read_tick) % _READ_SAMPLE_K == 0)
+    with _spans.span("envelope.read", bytes=len(buf),
+                     sampled=_READ_SAMPLE_K) if sampled else _spans.NOOP:
+        meta_len, nbuf = _HDR.unpack_from(buf, 0)
+        pos = _HDR.size
+        buffers = []
+        for _ in range(nbuf):
+            off, blen = _BUF.unpack_from(buf, pos)
+            pos += _BUF.size
+            buffers.append(buf[off:off + blen])
+        meta = buf[pos:pos + meta_len]
+        return deserialize(meta, buffers)

@@ -14,6 +14,11 @@ from typing import Any, Dict, Optional
 
 FLUSH_PERIOD_S = 1.0
 
+# Bound on unflushed records: a slow or partitioned GCS (easy to hit
+# under chaos partition rules) must not grow _pending without limit in
+# every process — oldest deltas are dropped and counted instead.
+PENDING_MAX = 8192
+
 
 class TaskEventBuffer:
     """Accumulates partial task records; a background thread flushes deltas.
@@ -23,20 +28,39 @@ class TaskEventBuffer:
     RUNNING + execution timestamps; the GCS merges both halves.
     """
 
-    def __init__(self, gcs_client: Any):
+    def __init__(self, gcs_client: Any, pending_max: int = PENDING_MAX):
         self._gcs = gcs_client
         self._lock = threading.Lock()
         self._pending: Dict[str, Dict[str, Any]] = {}
+        self._pending_max = max(1, pending_max)
+        self.dropped_total = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._flush_loop, daemon=True,
                                         name="task-events")
         self._thread.start()
 
     def record(self, task_id_hex: str, **fields: Any) -> None:
+        dropped = 0
         with self._lock:
             rec = self._pending.setdefault(task_id_hex,
                                            {"task_id": task_id_hex})
             rec.update({k: v for k, v in fields.items() if v is not None})
+            # drop-oldest (dict preserves insertion order): losing an old
+            # task's delta beats unbounded memory while the GCS is away
+            while len(self._pending) > self._pending_max:
+                self._pending.pop(next(iter(self._pending)))
+                dropped += 1
+            self.dropped_total += dropped
+        if dropped:
+            try:
+                from ray_tpu.util.metrics import Counter, get_or_create
+                get_or_create(
+                    Counter, "ray_tpu_task_events_dropped_total",
+                    description="task-event deltas dropped because the "
+                                "pending buffer hit its cap (GCS slow or "
+                                "partitioned)").inc(dropped)
+            except Exception:  # noqa: BLE001 - metrics are best-effort
+                pass
 
     def _flush_loop(self) -> None:
         while not self._stop.wait(FLUSH_PERIOD_S):

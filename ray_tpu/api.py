@@ -157,14 +157,45 @@ def available_resources() -> Dict[str, float]:
     return avail
 
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Dump task execution as Chrome-trace JSON (reference `ray timeline`,
-    scripts/scripts.py:1856; load via chrome://tracing or Perfetto)."""
+def timeline(filename: Optional[str] = None, *, spans: bool = False,
+             trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Dump cluster execution as Chrome-trace JSON (reference `ray
+    timeline`, scripts/scripts.py:1856; load via chrome://tracing or
+    Perfetto).
+
+    spans=True additionally gathers every process's flight-recorder ring
+    (microsecond spans on the RPC/store/serialization/task/feed hot
+    paths — see _private/spans.py), aligns per-process clocks, and
+    interleaves them with the task events plus CHAOS_FAULT_INJECTED
+    cluster events. trace_id filters the dump to one `start_trace`
+    block's task records and span records."""
     import json
 
     from ray_tpu._private.task_events import timeline_events
     from ray_tpu.util import state as state_api
-    events = timeline_events(state_api.list_tasks())
+    records = state_api.list_tasks(
+        filters={"trace_id": trace_id} if trace_id else None)
+    events = timeline_events(records)
+    if spans:
+        from ray_tpu._private import spans as spans_mod
+        w = worker_mod.global_worker()
+        snaps = w.core_worker._gcs.call("spans_collect")
+        events.extend(spans_mod.merge_snapshots(snaps, trace_id=trace_id))
+        # chaos faults as instant events on a synthetic row, so injected
+        # failures line up visually with the latency they caused
+        if not trace_id:
+            for ev in state_api.list_cluster_events(
+                    event_type="CHAOS_FAULT_INJECTED"):
+                events.append({
+                    "ph": "i", "cat": "chaos",
+                    "name": "CHAOS_FAULT_INJECTED",
+                    "pid": "chaos", "tid": ev.get("fault") or "fault",
+                    "ts": float(ev.get("ts", 0.0)) * 1e6, "s": "g",
+                    "args": {"rule_id": ev.get("rule_id"),
+                             "fault": ev.get("fault"),
+                             "message": ev.get("message")},
+                })
+        events.sort(key=lambda e: e.get("ts", 0.0))
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

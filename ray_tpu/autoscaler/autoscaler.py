@@ -395,7 +395,7 @@ class StandardAutoscaler:
                            nodes_before=len(nodes))
                 return
         # ---- scale down idle provider nodes ------------------------
-        now = time.time()
+        now = time.monotonic()
         for node in nodes:
             if len(self.provider.non_terminated_nodes()) <= \
                     self.min_workers:

@@ -208,7 +208,7 @@ class AutoscalerV2:
         placeable_pending = (len(status.pending_demands)
                              - len(unplaceable)) if unplaceable else \
             len(status.pending_demands)
-        now = time.time()
+        now = time.monotonic()
         for inst in self.im.active():
             if inst.status != RAY_RUNNING:
                 continue

@@ -149,8 +149,8 @@ class Cluster:
         """Block until every started node is registered and alive
         (reference cluster_utils.py:303)."""
         want = {n.node_id_hex for n in self.list_all_nodes()}
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             alive = {n.node_id.hex() for n in self._get_nodes() if n.alive}
             if want <= alive:
                 return
@@ -189,8 +189,8 @@ class Cluster:
 
     def _wait_node_registered(self, node_id_hex: str,
                               timeout: float = 30.0) -> None:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if any(n.node_id.hex() == node_id_hex and n.alive
                    for n in self._get_nodes()):
                 return
@@ -199,8 +199,8 @@ class Cluster:
 
     def _wait_node_dead(self, node_id_hex: str,
                         timeout: float = 30.0) -> None:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if not any(n.node_id.hex() == node_id_hex and n.alive
                        for n in self._get_nodes()):
                 return

@@ -182,8 +182,18 @@ class DashboardHead:
                         return
                     if route == "/api/timeline":
                         import ray_tpu
-                        body = json.dumps(ray_tpu.timeline(),
-                                          default=str).encode()
+                        q = {k: v[0] for k, v
+                             in parse_qs(parsed.query).items()}
+                        # ?spans=1 merges the flight-recorder rings in;
+                        # ?trace_id=<hex> exports one trace standalone
+                        # (task + span records, so it implies spans=1 —
+                        # same as the CLI's --trace-id)
+                        trace_id = q.get("trace_id") or None
+                        body = json.dumps(ray_tpu.timeline(
+                            spans=(q.get("spans", "") in ("1", "true")
+                                   or trace_id is not None),
+                            trace_id=trace_id,
+                        ), default=str).encode()
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "application/json")

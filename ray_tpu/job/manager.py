@@ -138,8 +138,8 @@ class JobSubmissionClient:
         return out
 
     def wait(self, job_id: str, timeout: float = 600.0) -> str:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             status = self.get_job_status(job_id)
             if status in TERMINAL:
                 return status

@@ -512,10 +512,74 @@ class StoreViewCopy(Rule):
                         f"the object for long-lived use)")
 
 
+class WallClockDuration(Rule):
+    id = "RT010"
+    name = "wall-clock-duration"
+    rationale = ("time.time() differences measure the WALL clock, which "
+                 "jumps under NTP slew/suspend - durations, deadlines "
+                 "and span/metric timings must use time.monotonic() or "
+                 "time.perf_counter()")
+
+    _WALL_CALLS = {"time.time"}
+
+    def _is_wall_call(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and \
+            ctx.call_name(node) in self._WALL_CALLS
+
+    def _wall_names(self, scope: ast.AST, ctx: ModuleContext) -> Set[str]:
+        """Names assigned (in this scope) from an expression containing a
+        direct time.time() call — `t0 = time.time()`,
+        `deadline = time.time() + timeout`, conditional variants."""
+        names: Set[str] = set()
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if ctx.enclosing_function(node) is not (
+                    None if scope is ctx.tree else scope):
+                continue
+            if any(self._is_wall_call(ctx, n)
+                   for n in ast.walk(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+    _ORDER_OPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        wall_names_cache: dict = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                operands = (node.left, node.right)
+            elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, self._ORDER_OPS) for op in node.ops):
+                # Ordering comparisons are the deadline/TTL-expiry form:
+                # `time.time() < deadline`, `entry_ts <= now`.
+                operands = (node.left, *node.comparators)
+            else:
+                continue
+            direct = any(self._is_wall_call(ctx, o) for o in operands)
+            via_name = False
+            if not direct:
+                scope = ctx.enclosing_function(node) or ctx.tree
+                if scope not in wall_names_cache:
+                    wall_names_cache[scope] = self._wall_names(scope, ctx)
+                via_name = any(isinstance(o, ast.Name)
+                               and o.id in wall_names_cache[scope]
+                               for o in operands)
+            if direct or via_name:
+                yield self.finding(
+                    ctx, node,
+                    "duration computed from time.time() jumps when the "
+                    "wall clock is adjusted; use time.monotonic() (for "
+                    "deadlines) or time.perf_counter() (for timings)")
+
+
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
     ClosureMutationInJit(), ActorCallWithoutRemote(), LeakedObjectRef(),
     DictOrderPytree(), SwallowedException(), StoreViewCopy(),
+    WallClockDuration(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu._private import spans as _spans
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.impala.vtrace import from_importance_weights
@@ -204,7 +205,8 @@ class Impala(Algorithm):
                 continue
             try:
                 t0 = _time.perf_counter()
-                stats = self.learner_group.update(batch)
+                with _spans.span("learner.step", steps=steps):
+                    stats = self.learner_group.update(batch)
                 if self._feed is not None:
                     self._feed.add_busy(_time.perf_counter() - t0)
             except BaseException as e:  # noqa: BLE001

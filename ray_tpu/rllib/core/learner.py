@@ -283,6 +283,13 @@ class Learner:
         TorchLearner._update loop)."""
         import jax
 
+        from ray_tpu._private import spans as _spans
+        with _spans.span("learner.update", num_iters=num_iters):
+            return self._update_impl(batch, minibatch_size, num_iters,
+                                     seed, jax)
+
+    def _update_impl(self, batch, minibatch_size, num_iters, seed, jax
+                     ) -> Dict[str, float]:
         assert self._update_fn is not None, "call build() first"
         n = len(batch["obs"])
         minibatch_size = minibatch_size or n

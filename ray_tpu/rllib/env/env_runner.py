@@ -220,6 +220,11 @@ class SingleAgentEnvRunner:
         """Roll out ~num_timesteps across the vector env; returns a
         fragment batch of stacked columns [T, num_envs, ...] plus
         bootstrap values and completed-episode metrics."""
+        from ray_tpu._private import spans as _spans
+        with _spans.span("runner.sample", timesteps=num_timesteps):
+            return self._sample_impl(num_timesteps)
+
+    def _sample_impl(self, num_timesteps: int) -> Dict[str, Any]:
         import jax
 
         assert self.params is not None, "set_weights before sample"

@@ -24,6 +24,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ray_tpu._private import spans as _spans
+
 
 def _feed_metrics():
     from ray_tpu.util.metrics import Counter, Histogram, get_or_create
@@ -111,6 +113,13 @@ class HostStage:
                  axis_for) -> StagedBatch:
         """Stack same-structure fragments along axis_for(key) into a
         StagedBatch backed by a pooled slot."""
+        with _spans.span("feed.stage", nfrags=len(frags)) as _sp:
+            sb = self._assemble_impl(frags, axis_for)
+            _sp["bytes"] = sb.nbytes
+            return sb
+
+    def _assemble_impl(self, frags: Sequence[Dict[str, np.ndarray]],
+                       axis_for) -> StagedBatch:
         keys = list(frags[0].keys())
         plans: List[Tuple[str, str, int, Tuple[int, ...], int]] = []
         totals: Dict[str, int] = {}
@@ -211,20 +220,24 @@ class DeviceFeed:
         import jax
         if isinstance(batch, StagedBatch):
             nbytes = batch.nbytes
-            segs = {dt: jax.device_put(seg)
-                    for dt, seg in sorted(batch.segments.items())}
-            # the transfer must land before the slot is reused
-            jax.block_until_ready(list(segs.values()))
+            with _spans.span("feed.ship", bytes=nbytes, fused=True):
+                segs = {dt: jax.device_put(seg)
+                        for dt, seg in sorted(batch.segments.items())}
+                # the transfer must land before the slot is reused
+                jax.block_until_ready(list(segs.values()))
             sig = tuple((k, dt, off, n, shape) for k, (dt, off, n, shape)
                         in sorted(batch.layout.items()))
-            dev = self._unfuse_fn(sig)(segs)
+            with _spans.span("feed.unfuse"):
+                dev = self._unfuse_fn(sig)(segs)
             batch.release()
             self.fused_batches += 1
             return dev, nbytes
-        dev = jax.device_put(batch)
-        jax.block_until_ready(dev)
-        nbytes = sum(getattr(v, "nbytes", 0)
-                     for v in jax.tree_util.tree_leaves(dev))
+        with _spans.span("feed.ship", fused=False) as _sp:
+            dev = jax.device_put(batch)
+            jax.block_until_ready(dev)
+            nbytes = sum(getattr(v, "nbytes", 0)
+                         for v in jax.tree_util.tree_leaves(dev))
+            _sp["bytes"] = nbytes
         return dev, nbytes
 
     def _run(self) -> None:
@@ -261,14 +274,20 @@ class DeviceFeed:
         accumulate into wait_s; xfer_s isolates the transfer part."""
         import jax
         t0 = time.perf_counter()
-        try:
-            dev, meta = self._out.get(timeout=timeout)
-        except queue.Empty:
-            self.wait_s += time.perf_counter() - t0
-            raise
-        t1 = time.perf_counter()
-        jax.block_until_ready(dev)
-        t2 = time.perf_counter()
+        # feed.wait = consumer blocked on the feed (starvation: upstream
+        # sampling is the bottleneck); feed.xfer isolates the tail spent
+        # waiting for an already-dequeued transfer to land in HBM
+        with _spans.span("feed.wait") as _sp:
+            try:
+                dev, meta = self._out.get(timeout=timeout)
+            except queue.Empty:
+                self.wait_s += time.perf_counter() - t0
+                _sp["empty"] = True
+                raise
+            t1 = time.perf_counter()
+            with _spans.span("feed.xfer"):
+                jax.block_until_ready(dev)
+            t2 = time.perf_counter()
         self.wait_s += t2 - t0
         self.xfer_s += t2 - t1
         self.batches += 1

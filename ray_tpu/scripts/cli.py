@@ -187,8 +187,14 @@ def cmd_stack(args) -> int:
 
 def cmd_timeline(args) -> int:
     rt = _connect(args)
-    events = rt.timeline(args.output)
-    print(f"wrote {len(events)} events to {args.output}")
+    # --trace-id promises the block's task records AND span records as
+    # a standalone trace, so it implies --spans
+    spans = args.spans or args.trace_id is not None
+    events = rt.timeline(args.output, spans=spans,
+                         trace_id=args.trace_id)
+    n_spans = sum(1 for e in events if e.get("cat") == "span")
+    extra = f" ({n_spans} spans)" if spans else ""
+    print(f"wrote {len(events)} events{extra} to {args.output}")
     return 0
 
 
@@ -212,9 +218,9 @@ def cmd_microbenchmark(args) -> int:
 
     def bench(name, fn, n):
         fn()  # warm
-        t0 = time.time()
+        t0 = time.perf_counter()
         fn()
-        dt = time.time() - t0
+        dt = time.perf_counter() - t0
         print(f"{name}: {n / dt:,.0f} /s")
 
     @ray_tpu.remote
@@ -370,6 +376,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("timeline", help="dump Chrome-trace timeline")
     p.add_argument("--output", "-o", default="/tmp/ray_tpu_timeline.json")
     p.add_argument("--address", default=None)
+    p.add_argument("--spans", action="store_true",
+                   help="merge every process's flight-recorder span ring "
+                        "into the trace (clock-aligned)")
+    p.add_argument("--trace-id", default=None,
+                   help="export only this start_trace block's records "
+                        "as a standalone trace (implies --spans)")
     p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("lint", help="framework-aware static analysis "

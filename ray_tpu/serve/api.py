@@ -141,7 +141,7 @@ class DeploymentHandle:
         self._listener_started = False
 
     def _refresh(self, force: bool = False) -> None:
-        now = time.time()
+        now = time.monotonic()
         # the freshness short-circuit only applies once we HAVE replicas:
         # a concurrent first caller must block for the in-flight fetch
         # rather than race ahead into an empty replica list
@@ -150,13 +150,13 @@ class DeploymentHandle:
             return
         with self._refresh_lock:
             if self._replicas and \
-                    time.time() - self._last_refresh < self.REFRESH_PERIOD_S:
+                    time.monotonic() - self._last_refresh < self.REFRESH_PERIOD_S:
                 return  # another thread refreshed while we waited
             info = ray_tpu.get(
                 self._controller.get_routing_info.remote(
                     self.deployment_name), timeout=30)
             self._apply_routing_info(info)
-            self._last_refresh = time.time()
+            self._last_refresh = time.monotonic()
             self._ensure_listener()
 
     def _apply_routing_info(self, info: Dict[str, Any]) -> None:
@@ -202,7 +202,7 @@ class DeploymentHandle:
         """Server-side ongoing count for one replica, probe-cached for
         PROBE_TTL_S with local sends since the probe added on top."""
         key = replica._actor_id.hex()
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             fresh = now - self._probed.get(key, 0.0) < self.PROBE_TTL_S
             if fresh:
@@ -216,12 +216,12 @@ class DeploymentHandle:
             # a dead/restarting replica costs one timeout per TTL, not
             # one per request
             with self._lock:
-                self._probed[key] = time.time()
+                self._probed[key] = time.monotonic()
                 self._probe_len[key] = self._in_flight.get(key, 0)
                 self._probe_delta[key] = 0
                 return self._probe_len[key]
         with self._lock:
-            self._probed[key] = time.time()
+            self._probed[key] = time.monotonic()
             self._probe_len[key] = int(qlen)
             self._probe_delta[key] = 0
             return int(qlen)
@@ -229,7 +229,7 @@ class DeploymentHandle:
     def _model_ids(self, replica) -> List[str]:
         """Loaded multiplexed-model ids for one replica, probe-cached."""
         key = replica._actor_id.hex()
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             cached = self._model_cache.get(key)
             if cached is not None and now - cached[0] < 2.0:
@@ -241,7 +241,7 @@ class DeploymentHandle:
         except Exception:  # noqa: BLE001
             ids = []
         with self._lock:
-            self._model_cache[key] = (time.time(), ids)
+            self._model_cache[key] = (time.monotonic(), ids)
         return ids
 
     def _pick(self, model_id: str = ""):
@@ -358,7 +358,7 @@ def _listen_loop(handle_ref) -> None:
             return
         version, info = out[name]
         handle._apply_routing_info(info)
-        handle._last_refresh = time.time()
+        handle._last_refresh = time.monotonic()
 
 
 class _HandleOptions:

@@ -265,13 +265,13 @@ class ServeController:
         long_poll.py:30 LongPollHost.listen_for_change). Runs on the
         'control' concurrency group so armed listeners never starve
         deploy/delete calls."""
-        deadline = time.time() + min(timeout_s, 60.0)
+        deadline = time.monotonic() + min(timeout_s, 60.0)
         while True:
             with self._lp_cond:
                 changed = {k: self._snapshots.get(k, 0) for k in keys
                            if self._snapshots.get(k, 0) > keys[k]}
                 if not changed:
-                    remaining = deadline - time.time()
+                    remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return {}
                     self._lp_cond.wait(remaining)
@@ -430,7 +430,7 @@ class ServeController:
         except Exception:  # noqa: BLE001
             return
         avg_in_flight = sum(s["in_flight"] for s in stats) / len(stats)
-        now = time.time()
+        now = time.monotonic()
         # Sustained-condition delays (reference autoscaling_policy): the
         # breach must HOLD for the delay window, not merely postdate the
         # previous scaling event — one bursty sample must not scale.

@@ -275,8 +275,8 @@ class TuneController:
                            exc_info=True)
 
     def run(self, timeout_s: float = 3600.0) -> List[Trial]:
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             self._maybe_suggest_trials()
             # launch pending trials up to the concurrency cap
             running = [t for t in self.trials if t.state == RUNNING]

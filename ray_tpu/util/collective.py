@@ -132,10 +132,10 @@ class CollectiveCoordinator:
             self._mailbox_cv.notify_all()
 
     def get_p2p(self, tag: Any, timeout: float = 300.0) -> Any:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._mailbox_cv:
             while tag not in self._mailbox:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(f"recv timed out for {tag}")
                 self._mailbox_cv.wait(timeout=min(remaining, 1.0))

@@ -59,8 +59,8 @@ class PlacementGroup:
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         """Block until CREATED (or timeout). reference
         placement_group.py:111."""
-        deadline = time.time() + timeout_seconds
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout_seconds
+        while time.monotonic() < deadline:
             info = self._info()
             if info is not None and info.state == "CREATED":
                 return True

@@ -114,10 +114,10 @@ class Queue:
         import time
         if not block:
             return ray_tpu.get(submit(0.0))
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             remaining = None if deadline is None \
-                else deadline - time.time()
+                else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 # blocking-queue emulation: ONE server-parked call per
                 # wait slice by design # graftlint: disable=RT002

@@ -161,6 +161,13 @@ def wait_graph() -> Dict[str, Any]:
     return _gcs().call("wait_graph_snapshot")
 
 
+def spans_snapshots() -> List[Dict[str, Any]]:
+    """Every process's flight-recorder ring, clock-offset annotated
+    (the raw material behind `ray_tpu timeline --spans`; see
+    _private/spans.py)."""
+    return _gcs().call("spans_collect")
+
+
 def chaos_rules() -> Dict[str, Any]:
     """Installed chaos rules + cluster-wide fired counts (the runtime
     view behind `ray_tpu chaos list` and the dashboard /api/chaos)."""

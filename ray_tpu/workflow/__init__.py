@@ -84,8 +84,8 @@ def _await_event(events_dir: str, name: str, timeout_s: float) -> Any:
     """Worker-side: poll the durable event file until delivered."""
     import time as _time
     path = os.path.join(events_dir, f"{name}.pkl")
-    deadline = _time.time() + timeout_s
-    while _time.time() < deadline:
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
         if os.path.exists(path):
             with open(path, "rb") as f:
                 return pickle.load(f)

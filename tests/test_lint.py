@@ -606,9 +606,106 @@ def test_alias_resolution():
     assert "RT002" in rules_hit(src)
 
 
+# ---- RT010 wall-clock durations ------------------------------------------
+
+RT010_POS_DIRECT = """
+    import time
+
+    def measure(fn):
+        t0 = time.time()
+        fn()
+        return time.time() - t0
+"""
+
+RT010_POS_DEADLINE = """
+    import time
+
+    def wait_until(pred, timeout):
+        deadline = time.time() + timeout
+        while not pred():
+            if deadline - time.time() <= 0:
+                return False
+        return True
+"""
+
+RT010_POS_VIA_NAME = """
+    import time
+
+    def sweep(entries, ttl):
+        now = time.time()
+        return [e for e in entries if now - e.ts < ttl]
+"""
+
+RT010_POS_COMPARE = """
+    import time
+
+    def wait_until(pred, timeout):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+        return False
+"""
+
+RT010_SUPPRESSED = """
+    import time
+
+    def measure(fn):
+        t0 = time.time()
+        fn()
+        return time.time() - t0  # graftlint: disable=RT010
+"""
+
+RT010_NEG_MONOTONIC = """
+    import time
+
+    def measure(fn):
+        t0 = time.perf_counter()
+        fn()
+        dur = time.perf_counter() - t0
+        deadline = time.monotonic() + 5.0
+        return dur, deadline - time.monotonic()
+"""
+
+
+def test_rt010_direct_difference():
+    assert "RT010" in rules_hit(RT010_POS_DIRECT)
+
+
+def test_rt010_deadline_pattern():
+    assert "RT010" in rules_hit(RT010_POS_DEADLINE)
+
+
+def test_rt010_via_assigned_name():
+    assert "RT010" in rules_hit(RT010_POS_VIA_NAME)
+
+
+def test_rt010_comparison_deadline():
+    assert "RT010" in rules_hit(RT010_POS_COMPARE)
+
+
+def test_rt010_suppressed():
+    assert "RT010" not in rules_hit(RT010_SUPPRESSED)
+
+
+def test_rt010_monotonic_fine():
+    assert "RT010" not in rules_hit(RT010_NEG_MONOTONIC)
+
+
+def test_rt010_timestamp_without_arithmetic_fine():
+    src = """
+        import time
+
+        def stamp(record):
+            record["ts"] = time.time()
+            return record
+    """
+    assert "RT010" not in rules_hit(src)
+
+
 def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
-    assert ids == [f"RT00{i}" for i in range(1, 10)]
+    assert ids == [f"RT00{i}" for i in range(1, 10)] + ["RT010"]
     assert all(r.rationale for r in ALL_RULES)
 
 
