@@ -59,6 +59,19 @@ class Config:
     memory_usage_threshold = _define("memory_usage_threshold", 0.95, float)
     memory_monitor_refresh_ms = _define("memory_monitor_refresh_ms",
                                         1000, int)
+    # Cluster metrics plane (_private/metrics_plane.py): GCS harvest
+    # cadence (0 disables the sampler; /metrics then harvests on
+    # demand), in-memory history depth, and watchdog thresholds. All
+    # runtime-tunable via the GCS `metrics_configure` RPC.
+    metrics_sample_interval_s = _define(
+        "metrics_sample_interval_s", 2.0, float)
+    metrics_history_samples = _define("metrics_history_samples", 300, int)
+    watchdog_cooldown_s = _define("watchdog_cooldown_s", 30.0, float)
+    watchdog_wait_edge_age_s = _define(
+        "watchdog_wait_edge_age_s", 120.0, float)
+    watchdog_store_occupancy_frac = _define(
+        "watchdog_store_occupancy_frac", 0.95, float)
+    watchdog_queue_depth = _define("watchdog_queue_depth", 256, int)
 
 
 if Config.testing_rpc_delay_us:

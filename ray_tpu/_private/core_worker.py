@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import metrics_plane as _metrics_plane
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import serialization as ser
 from ray_tpu._private import spans as _spans
@@ -46,6 +47,27 @@ _RETRY = object()
 # Lazy transport metrics (util.metrics registers per-process; created on
 # first use so importing this module costs nothing).
 _TRANSPORT_COUNTER = None
+
+# Owner-side task outcome counters, harvested cluster-wide by the
+# metrics plane (the Grafana "Tasks finished/sec" panel's series).
+_TASK_COUNTERS: Dict[str, Any] = {}
+
+
+def _count_task_outcome(outcome: str) -> None:
+    c = _TASK_COUNTERS.get(outcome)
+    if c is None:
+        try:
+            from ray_tpu.util.metrics import Counter, get_or_create
+            c = get_or_create(
+                Counter, f"ray_tpu_tasks_{outcome}_total",
+                description=f"tasks {outcome} as seen by their owner")
+        except Exception:  # noqa: BLE001 - metrics are best-effort
+            return
+        _TASK_COUNTERS[outcome] = c
+    try:
+        c.inc()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _transport_bytes(n: int, site: str) -> None:
@@ -105,6 +127,15 @@ class _SchedKeyState:
     # burst fans out over workers instead of serializing onto the first
     # lease)
     requests_in_flight: int = 0
+    # of those, how many are parked at each NM awaiting an async grant
+    # ("queued" reply received, no grant yet). A slot held with no
+    # parked request and no queued work is a LEAK — the watchdog's
+    # lease_slot_balance probe alarms on in_flight - parked. Keyed by
+    # NM address so a node death discards exactly that NM's entry
+    # without corrupting counts parked elsewhere; per-NM values are
+    # signed (a grant can outrace its request's "queued" reply,
+    # dipping one to -1 until the reply lands) and clamped at read.
+    parked_at: Dict[Tuple[str, int], int] = field(default_factory=dict)
     # lease_id -> (worker_address, nm_address, node_id_hex)
     leases: Dict[str, Tuple] = field(default_factory=dict)
     # lease_id -> tasks pushed but not yet completed (pipeline depth)
@@ -231,6 +262,9 @@ class CoreWorker:
             "cw_ping": lambda: "pong",
             # flight-recorder gather point (ray_tpu timeline --spans)
             "cw_spans_snapshot": _spans.snapshot,
+            # metrics-plane gather point (dashboard /metrics,
+            # `ray_tpu metrics dump`; see _private/metrics_plane.py)
+            "cw_metrics_snapshot": _metrics_plane.snapshot_process,
         }
         self.executor: Optional[_Executor] = None
         if mode == "worker":
@@ -242,6 +276,11 @@ class CoreWorker:
         # one trace row per process in the merged timeline
         _spans.set_process_label(f"{mode}-{self.worker_id.hex()[:8]}",
                                  node_id=node_id_hex)
+        # lease/executor gauges exported at harvest time (pull-based:
+        # the submission hot path never touches the registry); the
+        # watchdog's lease_slot_balance probe reads exactly these
+        _metrics_plane.register_sampler("core_worker",
+                                        self._sample_metric_gauges)
         # Owner-side node-failure detection (reference: the raylet notifies
         # owners via the object directory / lease failures; here the GCS
         # node channel is the death signal). Without it, tasks in flight
@@ -272,6 +311,49 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # Context
     # ------------------------------------------------------------------
+
+    def _sample_metric_gauges(self) -> None:
+        """Export point-in-time submission-state gauges for the metrics
+        harvest. The lease gauges encode the scheduling invariant the
+        watchdog checks: every in-flight request slot must either be
+        parked at an NM awaiting a grant or have queued work driving
+        it — a slot with neither, held across harvests, is the leak
+        ADVICE round 5 found (in_flight - parked > 0 with an empty
+        queue)."""
+        from ray_tpu.util.metrics import Gauge, get_or_create
+        with self._lock:
+            in_flight = sum(ks.requests_in_flight
+                            for ks in self._sched_keys.values())
+            parked = sum(max(0, n)
+                         for ks in self._sched_keys.values()
+                         for n in ks.parked_at.values())
+            queued = sum(len(ks.queue)
+                         for ks in self._sched_keys.values())
+            leases = sum(len(ks.leases)
+                         for ks in self._sched_keys.values())
+        get_or_create(
+            Gauge, "ray_tpu_lease_requests_in_flight",
+            description="outstanding lease requests across scheduling "
+                        "keys (owner side)").set(float(in_flight))
+        get_or_create(
+            Gauge, "ray_tpu_lease_requests_parked",
+            description="lease requests parked at a node manager "
+                        "awaiting an async grant").set(float(parked))
+        get_or_create(
+            Gauge, "ray_tpu_lease_queued_tasks",
+            description="tasks queued for a lease across scheduling "
+                        "keys (owner side)").set(float(queued))
+        get_or_create(
+            Gauge, "ray_tpu_lease_active_leases",
+            description="worker leases currently held by this "
+                        "process").set(float(leases))
+        ex = self.executor
+        get_or_create(
+            Gauge, "ray_tpu_executor_queue_depth",
+            description="queued + running tasks on this worker's "
+                        "executor across all concurrency groups "
+                        "(serve replica saturation signal)"
+        ).set(float(ex.total_queue_depth() if ex is not None else 0))
 
     def current_task_id(self) -> TaskID:
         return getattr(self._tls, "task_id", None) or self._root_task_id
@@ -1177,15 +1259,38 @@ class CoreWorker:
         return mapping
 
     def _on_lease_respill(self, task_id: TaskID,
-                          nm_address: Tuple[str, int]) -> None:
+                          nm_address: Tuple[str, int],
+                          from_address: Optional[Tuple[str, int]] = None
+                          ) -> None:
         """Our local raylet re-routed a queued lease to another node that
         became feasible (e.g. a PG bundle committed there)."""
         with self._lock:
             entry = self.tasks.get(task_id.hex())
-        if entry is None or entry.done:
+            if entry is not None:
+                ks = self._sched_keys.get(entry.sched_key)
+                if ks is not None:
+                    # the queued request is gone at the sending NM: the
+                    # slot we hold is no longer parked anywhere until
+                    # the re-request below parks it again. The SENDER
+                    # names itself — entry.lease_node is unreliable
+                    # here, since a grant from another request may have
+                    # already pushed this task elsewhere and overwritten
+                    # it (older NMs omit from_address; fall back).
+                    old = (tuple(from_address) if from_address
+                           else tuple(entry.lease_node)
+                           if entry.lease_node else None)
+                    ks.parked_at[old] = ks.parked_at.get(old, 0) - 1
+        if entry is None:
             return
-        # The old queued request is gone at the NM: re-request the key at
-        # the redirect target (request_in_flight stays held by us).
+        # The old queued request is gone at the NM: re-enter the request
+        # path at the redirect target (request_in_flight stays held by
+        # us). Even when the task is already done (cancelled/retried
+        # while its request sat queued) we must NOT return early:
+        # _key_head drains dead queue heads and releases the held slot —
+        # an early return here leaked requests_in_flight permanently and
+        # stalled the key once MAX_PENDING_LEASE_REQUESTS slots were
+        # gone (ADVICE round 5; the metrics watchdog's
+        # lease_slot_balance probe now alarms on exactly this).
         threading.Thread(
             target=self._request_lease_for_key,
             args=(entry.sched_key,),
@@ -1262,7 +1367,16 @@ class CoreWorker:
                                     retry=True)
                     return
                 if kind == "queued":
-                    return  # grant arrives async; request stays in flight
+                    # grant arrives async; request stays in flight,
+                    # now parked at this NM (the grant or a respill
+                    # unparks it)
+                    with self._lock:
+                        ks = self._sched_keys.get(key)
+                        if ks is not None:
+                            addr = tuple(nm_cur.address)
+                            ks.parked_at[addr] = \
+                                ks.parked_at.get(addr, 0) + 1
+                    return
                 if kind == "infeasible":
                     verdict = str(payload)
                     break
@@ -1302,6 +1416,9 @@ class CoreWorker:
             ks = self._sched_keys.setdefault(key, _SchedKeyState())
             if ks.requests_in_flight > 0:
                 ks.requests_in_flight -= 1
+            # signed: may beat the request's own "queued" reply
+            addr = tuple(nm_address) if nm_address else None
+            ks.parked_at[addr] = ks.parked_at.get(addr, 0) - 1
             ks.leases[lease_id] = (tuple(worker_address),
                                    tuple(nm_address) if nm_address
                                    else None, node_id)
@@ -1517,6 +1634,7 @@ class CoreWorker:
                     ev.set()
         self._unpin_args(entry.spec.arg_object_refs)
         self.task_events.record(h, state="FINISHED", ts_finished=_ev_now())
+        _count_task_outcome("finished")
         entry.dynamic_event.set()  # wake streaming iterators: task over
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
         if lease_id is not None:
@@ -1631,6 +1749,7 @@ class CoreWorker:
         self.task_events.record(task_hex, state="FAILED",
                                 ts_finished=_ev_now(),
                                 error=f"{error_type}: {message}"[:500])
+        _count_task_outcome("failed")
         entry.dynamic_event.set()
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
 
@@ -1987,8 +2106,32 @@ class CoreWorker:
                 ks = self._sched_keys.get(e.sched_key)
                 if ks is not None and e.lease_node == dead_nm:
                     ks.requests_in_flight = 0
+                    # surgical: only the dead NM's parked entry dies —
+                    # counts parked at live NMs (and their pending
+                    # grants) keep balancing each other
+                    ks.parked_at.pop(
+                        tuple(dead_nm) if dead_nm else None, None)
                     if ks.queue:
                         kick_keys.add(e.sched_key)
+            # Sweep EVERY key's parked_at for the dead NM, not only the
+            # lost entries' keys: a request can sit parked there with no
+            # task entry pointing at it (the task completed via another
+            # NM's grant, or a later attempt overwrote lease_node).
+            # Those requests never grant — without releasing their
+            # slots the key stalls holding in_flight == parked, which
+            # the watchdog's lease_slot_balance probe reads as balanced.
+            # A negative bucket (grant outraced its "queued" reply) is
+            # dropped without a release: that slot was already returned
+            # by the grant, and the reply that would rebalance it died
+            # with the NM.
+            if dead_nm is not None:
+                for key, ks in self._sched_keys.items():
+                    n = ks.parked_at.pop(dead_nm, 0)
+                    if n > 0:
+                        ks.requests_in_flight = max(
+                            0, ks.requests_in_flight - n)
+                        if ks.queue:
+                            kick_keys.add(key)
         for e in lost:
             self._fail_task(e.spec.task_id.hex(), "WORKER_DIED",
                             f"node {dead_hex[:12]} died", retry=True)
@@ -2049,6 +2192,7 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        _metrics_plane.unregister_sampler("core_worker")
         # Drain queued borrow releases before tearing the process down so a
         # clean exit doesn't strand pins at owners.
         while True:
@@ -2150,6 +2294,16 @@ class _Executor:
         with self._lock:
             running = self._running.get(group, 0)
         return q.qsize() + running
+
+    def total_queue_depth(self) -> int:
+        """Queued + executing across the default AND every named
+        concurrency group — the saturation signal the metrics plane
+        exports (a replica saturated on one named group must not read
+        as idle)."""
+        with self._lock:
+            groups = list(self._group_queues)
+        return self.queue_depth("") + sum(
+            self.queue_depth(g) for g in groups)
 
     def _spawn_exec_threads(self, n: int) -> None:
         while len(self._threads) < n:

@@ -271,9 +271,54 @@ class GcsServer:
         # are relabeled by the driver's CoreWorker (one process, one row)
         from ray_tpu._private import spans as spans_lib
         spans_lib.set_process_label("gcs")
+        # Cluster metrics plane (_private/metrics_plane.py): harvest
+        # sampler + history ring + invariant watchdog, with its RPC
+        # surface registered post-construction (the plane needs the live
+        # node/subscriber tables this object owns).
+        from ray_tpu._private import metrics_plane as metrics_plane_lib
+        metrics_plane_lib.register_sampler("gcs",
+                                           self._sample_metric_gauges)
+        self.metrics_plane = metrics_plane_lib.MetricsPlane(self)
+        self.server.register("metrics_collect", self.metrics_plane.collect)
+        self.server.register("metrics_prometheus",
+                             self.metrics_plane.prometheus)
+        self.server.register("metrics_merged", self.metrics_plane.merged)
+        self.server.register("metrics_history",
+                             self.metrics_plane.query_history)
+        self.server.register("metrics_configure",
+                             self.metrics_plane.configure)
         self._health_thread = threading.Thread(
             target=self._health_check_loop, daemon=True, name="gcs-health")
         self._health_thread.start()
+
+    def _sample_metric_gauges(self) -> None:
+        """GCS-owned gauges for the metrics harvest. The wait-graph
+        gauges used to be mirrored into the dashboard head's registry
+        per scrape (_refresh_wait_graph_metrics); exporting them here
+        keeps the Grafana exprs (`ray_tpu_wait_graph_edges`,
+        `ray_tpu_deadlocks_detected`) alive on the merged endpoint
+        natively."""
+        from ray_tpu.util.metrics import Gauge, get_or_create
+        snap = self.wait_graph.snapshot()
+        get_or_create(
+            Gauge, "ray_tpu_wait_graph_edges",
+            description="live actor waits-for edges (blocking gets)"
+        ).set(float(len(snap["edges"])))
+        get_or_create(
+            Gauge, "ray_tpu_deadlocks_detected",
+            description="waits-for cycles detected since cluster start"
+        ).set(float(snap["deadlocks_detected"]))
+        get_or_create(
+            Gauge, "ray_tpu_wait_graph_max_edge_age_seconds",
+            description="age of the oldest live actor wait edge "
+                        "(watchdog stuck-wait probe input)"
+        ).set(float(snap["max_edge_age_s"]))
+        with self._lock:
+            alive = sum(1 for n in self.nodes.values() if n.alive)
+        get_or_create(
+            Gauge, "ray_tpu_alive_nodes",
+            description="nodes the GCS currently considers alive"
+        ).set(float(alive))
 
     # ---- KV --------------------------------------------------------------
 
@@ -624,24 +669,16 @@ class GcsServer:
         # estimation hops)
         direct: List[Dict[str, Any]] = []
         via_nm: List[Dict[str, Any]] = []
-        with self._lock:
-            nm_addrs = [tuple(n.address) for n in self.nodes.values()
-                        if n.alive]
-            sub_addrs = {tuple(addr) for subs in self.subscribers.values()
-                         for addr, _tok in subs}
-        sub_addrs -= set(nm_addrs)  # NMs answer nm_*, not cw_*
-
-        lock = threading.Lock()
-
-        covered_addrs: set = set()
-
-        def _pull_nm(addr: Tuple[str, int]) -> None:
-            got = spans_lib.pull_snapshot(
-                addr, "nm_spans_snapshot",
-                timeout=self.SPANS_COLLECT_TIMEOUT_S)
-            if got is None:
-                return
-            reply, t0, _t1 = got
+        # Two-phase gather shared with the metrics plane: node managers
+        # first (each gathers its own workers), so the subscriber phase
+        # skips every worker an NM already shipped — workers also sit in
+        # `subscribers`, and pulling them directly too would transfer
+        # each ring twice just to dedupe by proc uid.
+        nm_replies, cw_replies, _unreachable = \
+            spans_lib.gather_cluster_snapshots(
+                self, "nm_spans_snapshot", "cw_spans_snapshot",
+                timeout=self.SPANS_COLLECT_TIMEOUT_S, grace_s=2.0)
+        for _addr, reply, t0, _t1 in nm_replies:
             # offset of the NM's wall clock vs ours; the NM already
             # stamped each of its workers relative to ITS clock. The NM
             # stamps wall_time at handler ENTRY (its own worker gather
@@ -652,56 +689,14 @@ class GcsServer:
             # wall-clock difference is the point (monotonic clocks are
             # not comparable across processes/hosts).
             offset = reply["wall_time"] - t0
-            batch = []
             for snap in reply["snapshots"]:
                 snap["clock_offset_s"] = \
                     snap.get("clock_offset_s", 0.0) + offset
-                batch.append(snap)
-            with lock:
-                via_nm.extend(batch)
-                covered_addrs.update(
-                    tuple(a) for a in reply.get("worker_addrs", ()))
-
-        def _pull_cw(addr: Tuple[str, int]) -> None:
-            got = spans_lib.pull_snapshot(
-                addr, "cw_spans_snapshot",
-                timeout=self.SPANS_COLLECT_TIMEOUT_S)
-            if got is None:
-                return
-            snap, t0, t1 = got
+                via_nm.append(snap)
+        for _addr, snap, t0, t1 in cw_replies:
             snap["clock_offset_s"] = snap["wall_time"] - (t0 + t1) / 2.0
-            with lock:
-                direct.append(snap)
-
-        deadline = time.monotonic() + self.SPANS_COLLECT_TIMEOUT_S + 2.0
-        # Phase 1: node managers (each gathers its own workers). Joining
-        # first lets phase 2 skip every worker an NM already shipped —
-        # workers also sit in `subscribers`, and pulling them directly
-        # too would transfer each ring twice just to dedupe by proc uid.
-        threads = [threading.Thread(target=_pull_nm, args=(a,),
-                                    daemon=True) for a in nm_addrs]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=max(0.1, deadline - time.monotonic()))
-        # Phase 2: remaining subscribers — drivers, plus workers whose
-        # NM dropped out mid-collect.
-        threads = [threading.Thread(target=_pull_cw, args=(a,),
-                                    daemon=True)
-                   for a in sub_addrs - covered_addrs]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=max(0.1, deadline - time.monotonic()))
-        seen: set = set()
-        unique: List[Dict[str, Any]] = []
-        for snap in [own] + direct + via_nm:
-            uid = snap.get("proc_uid")
-            if uid in seen:
-                continue
-            seen.add(uid)
-            unique.append(snap)
-        return unique
+            direct.append(snap)
+        return spans_lib.dedupe_by_uid([own] + direct + via_nm)
 
     # ---- structured events (reference util/event.h sink) ----------------
 
@@ -1019,6 +1014,9 @@ class GcsServer:
 
     def shutdown(self) -> None:
         self._dead = True
+        self.metrics_plane.stop()
+        from ray_tpu._private import metrics_plane as metrics_plane_lib
+        metrics_plane_lib.unregister_sampler("gcs")
         self.server.stop()
         self._pool.close_all()
         if isinstance(self.store, PersistentStore):

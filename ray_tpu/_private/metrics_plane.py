@@ -1,0 +1,762 @@
+"""Cluster metrics plane: harvest fan-out, merge math, history, watchdog.
+
+reference parity: _private/metrics_agent.py + dashboard/modules/metrics/
+(the reference runs an OpenCensus agent per node and lets an external
+Prometheus pull-aggregate, Monarch-style). Here the GCS itself is the
+aggregation point so a cluster is observable with zero external infra:
+
+  - **harvest**: `metrics_collect` fans out GCS → node managers → each
+    node's workers in one RPC hop (plus pubsub-subscribed drivers),
+    mirroring the flight recorder's spans_collect; every process ships
+    its `util.metrics.collect_wire()` snapshot tagged with
+    node_id/proc/pid and deduped by proc uid.
+  - **merge**: ClusterAggregator folds per-process series into cluster
+    series with counter-reset detection — a restarted worker (new proc
+    uid starting at 0) or an in-place reset folds the vanished
+    contribution into a retained base, so merged counters never go
+    backwards and rates never go negative.
+  - **history**: a bounded in-memory ring of merged samples on the GCS
+    (`metrics_history`) powers `ray_tpu top` and dashboard sparklines
+    without an external Prometheus.
+  - **watchdog**: an always-on evaluator over the harvested series runs
+    invariant probes (lease-slot balance, store occupancy vs pinned
+    bytes, wait-graph edge age, drop-counter growth, executor queue
+    depth, harvest coverage) and emits HEALTH_ALERT cluster events
+    naming the offending series and process.
+
+Recording stays pull-based: hot paths pay nothing for this plane beyond
+the metrics they already increment; all aggregation cost sits on the
+GCS sampler thread at `Config.metrics_sample_interval_s` cadence.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------
+# Per-process snapshot + samplers
+# ---------------------------------------------------------------------
+
+# name -> callable run (best-effort) right before this process snapshots
+# its registry: components export point-in-time gauges (lease slots,
+# store occupancy, wait-graph size) here instead of instrumenting their
+# hot paths. Keyed by component name so a re-init replaces, not stacks.
+_SAMPLERS: Dict[str, Callable[[], None]] = {}
+_SAMPLERS_LOCK = threading.Lock()
+
+
+def register_sampler(name: str, fn: Callable[[], None]) -> None:
+    with _SAMPLERS_LOCK:
+        _SAMPLERS[name] = fn
+
+
+def unregister_sampler(name: str) -> None:
+    with _SAMPLERS_LOCK:
+        _SAMPLERS.pop(name, None)
+
+
+def snapshot_process() -> Dict[str, Any]:
+    """This process's full registry in wire format, identity-tagged for
+    the harvest (proc uid for dedupe, label/node/pid for exposition)."""
+    from ray_tpu._private import spans as spans_lib
+    from ray_tpu.util import metrics as metrics_mod
+    with _SAMPLERS_LOCK:
+        samplers = list(_SAMPLERS.values())
+    for fn in samplers:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - a dead component's sampler
+            pass           # must not break the whole snapshot
+    return {
+        "proc_uid": spans_lib.PROC_UID,
+        "pid": os.getpid(),
+        "proc": spans_lib.process_label(),
+        "node_id": spans_lib.process_node_id(),
+        "wall_time": time.time(),
+        "metrics": metrics_mod.collect_wire(),
+    }
+
+
+# ---------------------------------------------------------------------
+# Cross-process merge math
+# ---------------------------------------------------------------------
+
+
+def _series_key(name: str, tags: Dict[str, str]) -> str:
+    if not tags:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+    return f"{name}{{{inner}}}"
+
+
+def merge_histograms(entries: List[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """Merge same-tag histogram contributions from several processes:
+    per-bucket counts sum elementwise when boundaries agree; differing
+    boundary sets merge onto their sorted union, each source bucket's
+    count landing in the union bucket whose upper edge equals the
+    source bucket's upper edge. Cumulative counts are exact at every
+    edge shared by ALL sources — in particular everywhere in the
+    normal case of one binary, one boundary config — and at +Inf. At
+    an edge some source lacks, that source's mass sits at its own
+    next-higher edge (its overflow mass at +Inf), so the merged
+    cumulative there is a LOWER bound and quantile estimates over
+    heterogeneous configs bias conservatively HIGH, never low. Each
+    entry: {"boundaries": [...], "buckets": [...], "sum": s,
+    "count": n}."""
+    if not entries:
+        return None
+    union: List[float] = sorted({b for e in entries
+                                 for b in e["boundaries"]})
+    buckets = [0] * (len(union) + 1)
+    total_sum = 0.0
+    total_count = 0
+    for e in entries:
+        idx = {b: union.index(b) for b in e["boundaries"]}
+        for i, count in enumerate(e["buckets"]):
+            if i < len(e["boundaries"]):
+                buckets[idx[e["boundaries"][i]]] += count
+            else:
+                buckets[-1] += count  # overflow (+Inf) bucket
+        total_sum += e["sum"]
+        total_count += e["count"]
+    return {"boundaries": union, "buckets": buckets,
+            "sum": total_sum, "count": total_count}
+
+
+class ClusterAggregator:
+    """Stateful merge of successive harvests into cluster series.
+
+    Counter-reset handling: each proc's last-seen contribution is
+    remembered per series. When a series vanishes from a harvest —
+    its whole proc gone (worker died / unreachable), or just that
+    series gone from a still-reporting proc (util.metrics.clear()
+    removes series outright rather than zeroing them) — its last
+    value folds into a retained base, so the merged cumulative total
+    holds steady instead of dropping. The fold is decided reversible
+    PER SERIES on reappearance: back at >= its folded value means the
+    counter actually continued (a transient blip) and the fold
+    reverses to avoid double-counting; back below it means a real
+    reset and the base stays. A counter that goes BACKWARDS under an
+    unchanged proc uid without vanishing (in-place reset) folds its
+    previous value the same way. Gauges are point-in-time: summed
+    over live procs only, no retention."""
+
+    # Harvest rounds a proc uid may stay absent before its fold
+    # records become permanent and are dropped. A dead worker's
+    # restart arrives under a NEW uid, so its records can never
+    # unfold — without eviction the always-on GCS would grow one
+    # record per series per worker EVER started. A uid that does
+    # return later than this is treated as a fresh proc: its counts
+    # stack on the retained base (a one-time overcount by the folded
+    # amount, never a drop — monotonicity holds either way).
+    FOLD_EVICT_ROUNDS = 30
+
+    def __init__(self) -> None:
+        # (uid, series_key) -> last counter value seen from that proc
+        self._last: Dict[Tuple[str, str], float] = {}
+        # series_key -> folded-in base from vanished/reset contributions
+        self._retained: Dict[str, float] = {}
+        # (uid, series_key) -> value folded when the series vanished
+        # (blip vs reset is decided if/when it reappears)
+        self._series_folded: Dict[Tuple[str, str], float] = {}
+        # uid -> consecutive rounds absent from the harvest (fold
+        # eviction clock; reset the round the uid reappears)
+        self._uid_absent_rounds: Dict[str, int] = {}
+
+    def update(self, snaps: List[Dict[str, Any]]) -> Dict[str, float]:
+        """Ingest one harvest; returns the merged flat series map
+        {series_key: value}. Histograms contribute `<name>_sum` and
+        `<name>_count` series (cumulative, retained like counters)."""
+        live: Dict[Tuple[str, str], float] = {}
+        gauges: Dict[str, float] = {}
+        uids = set()
+        for snap in snaps:
+            uid = snap["proc_uid"]
+            uids.add(uid)
+            for m in snap.get("metrics", ()):
+                for s in m["series"]:
+                    key = _series_key(m["name"], s["tags"])
+                    if m["kind"] == "gauge":
+                        gauges[key] = gauges.get(key, 0.0) + s["value"]
+                    elif m["kind"] == "histogram":
+                        for suffix, v in (("_sum", s["sum"]),
+                                          ("_count", float(s["count"]))):
+                            k2 = _series_key(m["name"] + suffix,
+                                             s["tags"])
+                            live[(uid, k2)] = \
+                                live.get((uid, k2), 0.0) + v
+                    else:
+                        live[(uid, key)] = \
+                            live.get((uid, key), 0.0) + s["value"]
+        # vanished series — proc gone from the harvest OR the series
+        # gone from a live proc's snapshot — fold into the retained
+        # base so the merged total holds instead of dropping
+        for (uid, key) in list(self._last):
+            if (uid, key) not in live:
+                v = self._last.pop((uid, key))
+                self._retained[key] = self._retained.get(key, 0.0) + v
+                self._series_folded[(uid, key)] = \
+                    self._series_folded.get((uid, key), 0.0) + v
+        # in-place resets: value regressed under the same uid
+        out: Dict[str, float] = {}
+        for (uid, key), v in live.items():
+            folded = self._series_folded.pop((uid, key), None)
+            if folded is not None and v >= folded:
+                # the counter continued past its folded value — a
+                # transient blip, not a reset: unfold it
+                self._retained[key] = \
+                    self._retained.get(key, 0.0) - folded
+            prev = self._last.get((uid, key))
+            if prev is not None and v < prev:
+                self._retained[key] = self._retained.get(key, 0.0) + prev
+            self._last[(uid, key)] = v
+            out[key] = out.get(key, 0.0) + v
+        # age out fold records of long-gone procs so the always-on GCS
+        # stays bounded under worker churn (their values remain in
+        # _retained — only the per-uid unfold bookkeeping is dropped)
+        folded_uids = {uid for (uid, _k) in self._series_folded}
+        for uid in list(self._uid_absent_rounds):
+            if uid in uids or uid not in folded_uids:
+                del self._uid_absent_rounds[uid]
+        for uid in folded_uids - uids:
+            rounds = self._uid_absent_rounds.get(uid, 0) + 1
+            if rounds >= self.FOLD_EVICT_ROUNDS:
+                self._uid_absent_rounds.pop(uid, None)
+                for fk in [fk for fk in self._series_folded
+                           if fk[0] == uid]:
+                    del self._series_folded[fk]
+            else:
+                self._uid_absent_rounds[uid] = rounds
+        for key, base in self._retained.items():
+            if base:
+                out[key] = out.get(key, 0.0) + base
+        out.update(gauges)
+        return out
+
+    def merged_wire(self, snaps: List[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+        """Cluster-merged wire metrics (tags preserved, procs summed) —
+        the JSON payload behind /api/metrics `merged`. Stateless: reset
+        retention only applies to the flat series from update()."""
+        merged: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        hist_parts: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+        for snap in snaps:
+            for m in snap.get("metrics", ()):
+                for s in m["series"]:
+                    gk = (m["name"],
+                          _series_key(m["name"], s["tags"]))
+                    rec = merged.setdefault(gk, {
+                        "name": m["name"], "kind": m["kind"],
+                        "description": m.get("description", ""),
+                        "tags": s["tags"]})
+                    if m["kind"] == "histogram":
+                        hist_parts.setdefault(gk, []).append(
+                            {"boundaries": m["boundaries"],
+                             "buckets": s["buckets"], "sum": s["sum"],
+                             "count": s["count"]})
+                    else:
+                        rec["value"] = rec.get("value", 0.0) + s["value"]
+        for gk, parts in hist_parts.items():
+            merged[gk].update(merge_histograms(parts))
+        return list(merged.values())
+
+
+# ---------------------------------------------------------------------
+# History ring
+# ---------------------------------------------------------------------
+
+
+class SeriesHistory:
+    """Bounded ring of (wall_ts, merged flat series) samples."""
+
+    def __init__(self, max_samples: int) -> None:
+        self._samples: "deque" = deque(maxlen=max(2, int(max_samples)))
+        self._lock = threading.Lock()
+
+    def append(self, ts: float, series: Dict[str, float]) -> None:
+        with self._lock:
+            self._samples.append((ts, series))
+
+    def query(self, names: Optional[List[str]] = None,
+              limit: Optional[int] = None) -> List[Tuple[float, Dict]]:
+        with self._lock:
+            samples = list(self._samples)
+        if limit is not None:
+            samples = samples[-limit:]
+        if names:
+            # prefix match so "ray_tpu_tasks" selects every tagged
+            # variant of the family
+            samples = [
+                (ts, {k: v for k, v in sample.items()
+                      if any(k.startswith(n) for n in names)})
+                for ts, sample in samples]
+        return samples
+
+
+# ---------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------
+
+
+class Watchdog:
+    """Invariant probes over successive harvests. Each probe returns
+    alert dicts {key, message, severity, **fields}; emission is
+    cooldown-deduped per (probe, key) so a persistent violation alerts
+    once per cooldown window, not once per harvest."""
+
+    # minimum stuck window for the lease probe's backlog variant
+    # (leaked slots WITH queued work) — must outlive the owner's NM
+    # connection-retry transient, which holds a slot un-parked for up
+    # to ~10s of backoff
+    LEASE_BACKLOG_FLOOR_S = 15.0
+
+    def __init__(self, emit: Callable[..., None],
+                 cooldown_s: float, wait_edge_age_s: float,
+                 store_occupancy_frac: float, queue_depth: int) -> None:
+        self._emit = emit
+        self.cooldown_s = cooldown_s
+        self.wait_edge_age_s = wait_edge_age_s
+        self.store_occupancy_frac = store_occupancy_frac
+        self.queue_depth = queue_depth
+        self._last_alert: Dict[Tuple[str, str], float] = {}
+        # lease probe: uid -> (leaked-slot count, monotonic ts it was
+        # first seen stuck at that value)
+        self._lease_stuck: Dict[str, Tuple[float, float]] = {}
+        self._prev_series: Dict[str, float] = {}
+        self.alerts_total = 0
+
+    # -- helpers ------------------------------------------------------
+
+    @staticmethod
+    def _gauge(snap: Dict[str, Any], name: str) -> Optional[float]:
+        for m in snap.get("metrics", ()):
+            if m["name"] == name and m["series"]:
+                return sum(s["value"] for s in m["series"])
+        return None
+
+    def _alert(self, probe: str, key: str, message: str,
+               severity: str = "WARNING", **fields: Any) -> None:
+        now = time.monotonic()
+        last = self._last_alert.get((probe, key))
+        if last is not None and now - last < self.cooldown_s:
+            return
+        self._last_alert[(probe, key)] = now
+        # expired records no longer dedupe anything — drop them, or the
+        # always-on GCS accrues one per (probe, proc-uid) ever alerted
+        if len(self._last_alert) > 256:
+            self._last_alert = {
+                k: t for k, t in self._last_alert.items()
+                if now - t < self.cooldown_s}
+        self.alerts_total += 1
+        logger.warning("watchdog %s: %s", probe, message)
+        self._emit("HEALTH_ALERT", message, severity=severity,
+                   probe=probe, series=key, **fields)
+
+    # -- probes -------------------------------------------------------
+
+    def _probe_lease_slots(self, snaps: List[Dict[str, Any]],
+                           interval_s: float) -> None:
+        """A proc holding lease request slots that are not parked at an
+        NM awaiting a grant has leaked them — after
+        MAX_PENDING_LEASE_REQUESTS leaks that key never schedules again
+        (core_worker ~1203). Alerted in two variants: with an EMPTY
+        queue after two harvest intervals (unambiguous — nothing is
+        driving the slots), and with QUEUED work after a longer floor
+        (the stalled-with-backlog case, worse for the user but
+        transiently indistinguishable from an actively-placing
+        request). A slot PARKED at a saturated NM with a drained queue
+        is a legitimate steady state (the granted lease absorbed every
+        queued task) and never alarms, however long the NM stays full.
+        Stuck windows are wall-time, not round counts, so back-to-back
+        forced harvests can't fake persistence."""
+        window = 2.0 * max(interval_s, 0.05)
+        # With queued work, in_flight > parked is ALSO the normal shape
+        # of an actively-placing request (slot claimed, "queued" reply
+        # pending) and of the NM connection-retry loop, which holds a
+        # slot un-parked for up to ~10s (core_worker conn_failures x
+        # 0.2s backoff) — so the backlog variant needs a floor long
+        # enough to outlive both. It matters MORE than the empty-queue
+        # one: leaked slots with tasks queued is a key starving user
+        # work (once MAX_PENDING_LEASE_REQUESTS slots leak it never
+        # requests again), and any churn — a grant, a park, a new
+        # request — changes `leaked` and restarts the clock, so only a
+        # genuinely frozen key rides out the floor.
+        backlog_window = max(window, self.LEASE_BACKLOG_FLOOR_S)
+        now = time.monotonic()
+        seen = set()
+        for snap in snaps:
+            uid = snap["proc_uid"]
+            in_flight = self._gauge(snap,
+                                    "ray_tpu_lease_requests_in_flight")
+            queued = self._gauge(snap, "ray_tpu_lease_queued_tasks")
+            if in_flight is None or queued is None:
+                continue
+            parked = self._gauge(
+                snap, "ray_tpu_lease_requests_parked") or 0.0
+            seen.add(uid)
+            leaked = in_flight - parked
+            if leaked <= 0:
+                self._lease_stuck.pop(uid, None)
+                continue
+            prev, since = self._lease_stuck.get(uid, (None, now))
+            if prev != leaked:
+                since = now
+            self._lease_stuck[uid] = (leaked, since)
+            if now - since < (window if queued == 0 else backlog_window):
+                continue
+            if queued == 0:
+                msg = (f"{snap['proc']}: {leaked:g} lease request "
+                       f"slot(s) held {now - since:.1f}s with no "
+                       f"queued tasks and no request parked at a "
+                       f"node manager — leaked requests_in_flight "
+                       f"stalls that scheduling key permanently")
+            else:
+                msg = (f"{snap['proc']}: {leaked:g} lease request "
+                       f"slot(s) held {now - since:.1f}s not parked "
+                       f"at any node manager while {queued:g} task(s) "
+                       f"sit queued — leaked slots are starving "
+                       f"queued work of lease requests")
+            self._alert("lease_slot_balance", uid, msg,
+                        severity="ERROR", proc=snap["proc"],
+                        node_id=snap.get("node_id"), value=leaked)
+        for uid in list(self._lease_stuck):
+            if uid not in seen:
+                del self._lease_stuck[uid]
+
+    def _probe_store_occupancy(self, snaps: List[Dict[str, Any]]) -> None:
+        for snap in snaps:
+            used = self._gauge(snap, "ray_tpu_object_store_used_bytes")
+            cap = self._gauge(snap,
+                              "ray_tpu_object_store_capacity_bytes")
+            pinned = self._gauge(snap,
+                                 "ray_tpu_object_store_pinned_bytes")
+            if used is None or not cap:
+                continue
+            node = snap.get("node_id")
+            if pinned is not None and pinned > used:
+                self._alert(
+                    "store_pin_accounting", snap["proc_uid"],
+                    f"node {str(node)[:12]}: pinned bytes "
+                    f"({pinned:g}) exceed used bytes ({used:g}) — "
+                    f"pin/lease accounting leak", severity="ERROR",
+                    node_id=node, value=pinned)
+            elif used / cap > self.store_occupancy_frac:
+                self._alert(
+                    "store_occupancy", snap["proc_uid"],
+                    f"node {str(node)[:12]}: object store "
+                    f"{100.0 * used / cap:.0f}% full "
+                    f"({used:g}/{cap:g} bytes; pinned {pinned or 0:g})",
+                    node_id=node, value=used)
+
+    def _probe_wait_edge_age(self, snaps: List[Dict[str, Any]]) -> None:
+        for snap in snaps:
+            age = self._gauge(snap,
+                              "ray_tpu_wait_graph_max_edge_age_seconds")
+            if age is not None and age > self.wait_edge_age_s:
+                self._alert(
+                    "wait_edge_age", "gcs",
+                    f"oldest actor wait edge is {age:.0f}s old "
+                    f"(> {self.wait_edge_age_s:g}s) — a blocking get "
+                    f"may be stuck short of a detectable cycle",
+                    value=age)
+
+    # Task-event drops only: losing task events loses real cluster
+    # state. ray_tpu_spans_dropped_total is deliberately NOT here —
+    # the span ring is drop-oldest BY DESIGN (always-on recording
+    # wraps in steady state), so its growth is normal operation and
+    # alerting on it would train operators to ignore HEALTH_ALERTs.
+    _DROP_COUNTERS = ("ray_tpu_task_events_dropped_total",)
+
+    def _probe_drop_growth(self, series: Dict[str, float]) -> None:
+        for name in self._DROP_COUNTERS:
+            cur = series.get(name)
+            prev = self._prev_series.get(name)
+            if cur is not None and prev is not None and cur > prev:
+                self._alert(
+                    "drop_growth", name,
+                    f"{name} grew by {cur - prev:g} since the last "
+                    f"harvest (total {cur:g}) — telemetry is being "
+                    f"shed under load", value=cur)
+
+    def _probe_queue_depth(self, snaps: List[Dict[str, Any]]) -> None:
+        for snap in snaps:
+            depth = self._gauge(snap, "ray_tpu_executor_queue_depth")
+            if depth is not None and depth > self.queue_depth:
+                self._alert(
+                    "executor_queue_depth", snap["proc_uid"],
+                    f"{snap['proc']}: executor queue depth {depth:g} "
+                    f"exceeds {self.queue_depth} — replica/actor is "
+                    f"saturated and calls are piling up",
+                    proc=snap["proc"], node_id=snap.get("node_id"),
+                    value=depth)
+
+    def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
+        for node in unreachable:
+            self._alert(
+                "harvest_unreachable", node,
+                f"metrics harvest could not reach node "
+                f"{node[:12]} — its series are stale this round",
+                node_id=node)
+
+    def evaluate(self, snaps: List[Dict[str, Any]],
+                 series: Dict[str, float],
+                 unreachable_nodes: List[str],
+                 interval_s: float = 2.0) -> None:
+        for probe in (lambda: self._probe_lease_slots(snaps, interval_s),
+                      lambda: self._probe_store_occupancy(snaps),
+                      lambda: self._probe_wait_edge_age(snaps),
+                      lambda: self._probe_drop_growth(series),
+                      lambda: self._probe_queue_depth(snaps),
+                      lambda: self._probe_harvest_coverage(
+                          unreachable_nodes)):
+            try:
+                probe()
+            except Exception:  # noqa: BLE001 - one broken probe must
+                logger.exception("watchdog probe failed")  # not kill the rest
+        self._prev_series = series
+
+
+# ---------------------------------------------------------------------
+# GCS-hosted plane
+# ---------------------------------------------------------------------
+
+
+class MetricsPlane:
+    """Owns the sampler thread, harvest fan-out, aggregator, history
+    ring, and watchdog. Hosted by the GcsServer; its RPC surface is
+    registered there (metrics_collect / metrics_prometheus /
+    metrics_history / metrics_merged / metrics_configure)."""
+
+    COLLECT_TIMEOUT_S = 5.0
+
+    def __init__(self, gcs: Any) -> None:
+        from ray_tpu._private.config import Config
+        from ray_tpu.util.metrics import (Gauge, Histogram,
+                                          get_or_create)
+        self._gcs = gcs
+        self.interval_s = Config.metrics_sample_interval_s
+        self.history = SeriesHistory(Config.metrics_history_samples)
+        self.aggregator = ClusterAggregator()
+        self.watchdog = Watchdog(
+            emit=gcs._emit,
+            cooldown_s=Config.watchdog_cooldown_s,
+            wait_edge_age_s=Config.watchdog_wait_edge_age_s,
+            store_occupancy_frac=Config.watchdog_store_occupancy_frac,
+            queue_depth=Config.watchdog_queue_depth)
+        self._harvest_hist = get_or_create(
+            Histogram, "ray_tpu_metrics_harvest_seconds",
+            description="wall time of one cluster metrics harvest "
+                        "(fan-out + merge + watchdog)",
+            boundaries=[0.001, 0.005, 0.02, 0.1, 0.5, 2.0])
+        self._procs_gauge = get_or_create(
+            Gauge, "ray_tpu_metrics_harvest_procs",
+            description="processes covered by the last metrics harvest")
+        self._lock = threading.Lock()
+        # serializes full rounds: the sampler loop and on-demand callers
+        # (scrapes, dumps) never harvest concurrently
+        self._round_lock = threading.Lock()
+        self._last_snaps: List[Dict[str, Any]] = []
+        self._last_series: Dict[str, float] = {}
+        self._last_harvest_mono = 0.0
+        self._last_history_mono = 0.0
+        self._wake = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._sample_loop,
+                                        daemon=True, name="gcs-metrics")
+        self._thread.start()
+
+    # -- harvest fan-out ----------------------------------------------
+
+    def collect(self) -> List[Dict[str, Any]]:
+        """The `metrics_collect` RPC: an explicit harvest-NOW — callers
+        asking for this want a guaranteed-fresh gather (tests inducing a
+        state then asserting on the snapshot; operators debugging)."""
+        return self._run_round(force=True)
+
+    def _harvest(self) -> Tuple[List[Dict[str, Any]], List[str]]:
+        """Two-phase gather mirroring gcs.spans_collect: node managers
+        first (each ships its own + its workers' snapshots and names the
+        worker addresses it covered), then the remaining pubsub
+        subscribers — drivers, and workers whose NM dropped out."""
+        from ray_tpu._private import spans as spans_lib
+        own = snapshot_process()
+        nm_replies, cw_replies, unreachable = \
+            spans_lib.gather_cluster_snapshots(
+                self._gcs, "nm_metrics_snapshot", "cw_metrics_snapshot",
+                timeout=self.COLLECT_TIMEOUT_S)
+        gathered: List[Dict[str, Any]] = []
+        for _addr, reply, _t0, _t1 in nm_replies:
+            gathered.extend(reply["snapshots"])
+        gathered.extend(snap for _a, snap, _t0, _t1 in cw_replies)
+        return spans_lib.dedupe_by_uid([own] + gathered), unreachable
+
+    def _run_round(self, force: bool = False) -> List[Dict[str, Any]]:
+        """One full round — fan-out, aggregate, history sample, watchdog
+        — shared by the sampler loop and on-demand callers (/metrics
+        scrapes, dumps; with interval 0 the plane runs PURELY on demand,
+        and every scrape still advances the aggregator/history/watchdog
+        state). A non-forced caller arriving while the last round is
+        fresh gets its cached snapshots instead of re-fanning out —
+        and never stalls behind an in-progress harvest (which can hold
+        _round_lock for the full collect timeout when a node is
+        unreachable): if the cache is stale because a slow round is
+        mid-flight, the scrape gets the last COMPLETED round rather
+        than blocking, so /metrics stays responsive exactly when a
+        node outage makes rounds slow."""
+        freshness = max(self.interval_s, 1.0)
+
+        def _cached():
+            with self._lock:
+                age = time.monotonic() - self._last_harvest_mono
+                snaps = self._last_snaps
+            return snaps if snaps and age < freshness else None
+
+        if not force:
+            snaps = _cached()
+            if snaps is not None:
+                return snaps
+            # cache stale AND a round in progress (it holds _round_lock
+            # for up to two collect timeouts when a node is down): a
+            # scrape must not stall behind the fan-out — serve the last
+            # COMPLETED round, however stale, and let the in-progress
+            # one refresh the cache for the next caller. Only when no
+            # round ever completed (cold start) is waiting the better
+            # trade.
+            if not self._round_lock.acquire(blocking=False):
+                with self._lock:
+                    stale = self._last_snaps
+                if stale:
+                    return stale
+                self._round_lock.acquire()
+        else:
+            self._round_lock.acquire()
+        try:
+            if not force:
+                # a round finished while we waited for the lock
+                snaps = _cached()
+                if snaps is not None:
+                    return snaps
+            t0 = time.monotonic()
+            snaps, unreachable = self._harvest()
+            series = self.aggregator.update(snaps)
+            # the ring's retention contract is samples x interval_s:
+            # forced rounds (collects, dumps) between sampler ticks
+            # must not shrink that window, so appends are time-gated
+            if (self.interval_s <= 0
+                    or t0 - self._last_history_mono
+                    >= 0.9 * self.interval_s):
+                self.history.append(time.time(), series)
+                self._last_history_mono = t0
+            self.watchdog.evaluate(snaps, series, unreachable,
+                                   interval_s=self.interval_s)
+            self._procs_gauge.set(float(len(snaps)))
+            self._harvest_hist.observe(time.monotonic() - t0)
+            with self._lock:
+                self._last_snaps = snaps
+                self._last_series = series
+                self._last_harvest_mono = time.monotonic()
+            return snaps
+        finally:
+            self._round_lock.release()
+
+    # -- sampler loop -------------------------------------------------
+
+    def _sample_loop(self) -> None:
+        while not self._stopped:
+            interval = self.interval_s
+            if interval <= 0:
+                self._wake.wait(timeout=0.5)
+                self._wake.clear()
+                continue
+            self._wake.wait(timeout=interval)
+            self._wake.clear()
+            if self._stopped:
+                return
+            try:
+                self._run_round(force=True)
+            except Exception:  # noqa: BLE001
+                logger.exception("metrics harvest round failed")
+
+    # -- RPC surface (registered by GcsServer) ------------------------
+
+    def prometheus(self, force: bool = False) -> str:
+        """Cluster-merged Prometheus exposition: every harvested series
+        labeled by proc + node (histogram buckets cumulative per
+        series; HELP/TYPE once per metric name). A scrape serves the
+        sampler's last round while it is fresh (< one interval old),
+        so an external scraper — however fast — adds no fan-out load
+        on top of the sampler cadence; `force=True` (CLI dumps, tests
+        inducing a state then asserting on it) harvests NOW."""
+        from ray_tpu.util.metrics import render_prometheus
+        flat: List[Dict[str, Any]] = []
+        for snap in self._run_round(force=force):
+            extra = {"proc": snap["proc"]}
+            if snap.get("node_id"):
+                extra["node"] = str(snap["node_id"])[:12]
+            for m in snap["metrics"]:
+                flat.append({**m, "extra_tags": extra})
+        return render_prometheus(flat)
+
+    def merged(self, fresh: bool = False) -> Dict[str, Any]:
+        """One consistent view of the last round: the per-proc
+        snapshots, the flat merged series, and the tag-preserving
+        merged wire metrics all come from the SAME harvest (served from
+        cache while fresh — the dashboard's JSON poll loop does not
+        re-fan-out the cluster per request). `fresh=True` harvests NOW,
+        matching the text dump's force= semantics."""
+        self._run_round(force=fresh)
+        # snaps and series are stored together under _lock at the end
+        # of every round — reading both under one acquisition keeps the
+        # payload's views from straddling two rounds
+        with self._lock:
+            snaps = self._last_snaps
+            series = dict(self._last_series)
+        return {"ts": time.time(),
+                "interval_s": self.interval_s,
+                "procs": snaps,
+                "series": series,
+                "merged": self.aggregator.merged_wire(snaps)}
+
+    def query_history(self, names: Optional[List[str]] = None,
+                      limit: Optional[int] = None) -> Dict[str, Any]:
+        return {"interval_s": self.interval_s,
+                "samples": self.history.query(names=names, limit=limit)}
+
+    def configure(self, interval_s: Optional[float] = None,
+                  cooldown_s: Optional[float] = None,
+                  wait_edge_age_s: Optional[float] = None,
+                  store_occupancy_frac: Optional[float] = None,
+                  queue_depth: Optional[int] = None) -> Dict[str, Any]:
+        """Runtime tuning (ops + tests): adjust the sample interval and
+        watchdog thresholds without restarting the GCS."""
+        if interval_s is not None:
+            self.interval_s = float(interval_s)
+            self._wake.set()
+        if cooldown_s is not None:
+            self.watchdog.cooldown_s = float(cooldown_s)
+        if wait_edge_age_s is not None:
+            self.watchdog.wait_edge_age_s = float(wait_edge_age_s)
+        if store_occupancy_frac is not None:
+            self.watchdog.store_occupancy_frac = \
+                float(store_occupancy_frac)
+        if queue_depth is not None:
+            self.watchdog.queue_depth = int(queue_depth)
+        return {"interval_s": self.interval_s,
+                "cooldown_s": self.watchdog.cooldown_s,
+                "wait_edge_age_s": self.watchdog.wait_edge_age_s,
+                "store_occupancy_frac":
+                    self.watchdog.store_occupancy_frac,
+                "queue_depth": self.watchdog.queue_depth}
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._wake.set()

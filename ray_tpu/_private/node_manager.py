@@ -172,6 +172,7 @@ class NodeManager:
             "nm_get_info": self.get_info,
             "nm_list_workers": self.list_workers,
             "nm_spans_snapshot": self.spans_snapshot,
+            "nm_metrics_snapshot": self.metrics_snapshot,
             "nm_profile_worker": self.profile_worker,
             "nm_drain": self.drain,
         }, host=host)
@@ -180,6 +181,11 @@ class NodeManager:
         from ray_tpu._private import spans as _spans_lib
         _spans_lib.set_process_label(f"raylet-{self.node_id.hex()[:8]}",
                                      node_id=self.node_id.hex())
+        # node-level gauges (store occupancy, worker pool, lease queue)
+        # exported at metrics-harvest time (_private/metrics_plane.py)
+        from ray_tpu._private import metrics_plane as _metrics_plane
+        _metrics_plane.register_sampler("node_manager",
+                                        self._sample_metric_gauges)
         self.info = NodeInfo(
             node_id=self.node_id, address=self.address,
             store_address=self.store.address,
@@ -350,7 +356,11 @@ class NodeManager:
             try:
                 self._pool.get(pl.reply_to).call(
                     "cw_lease_respill", task_id=pl.spec.task_id,
-                    nm_address=nodes[chosen])
+                    nm_address=nodes[chosen],
+                    # name ourselves so the owner unparks its request
+                    # slot from the RIGHT node manager (entry state may
+                    # have moved on if another grant picked the task up)
+                    from_address=self.address)
             except Exception:  # noqa: BLE001
                 with self._lock:
                     self.pending.append(pl)
@@ -1090,40 +1100,80 @@ class NodeManager:
         reply_wall = time.time()
         own = spans_lib.snapshot()
         own["clock_offset_s"] = 0.0
-        snapshots: List[Dict[str, Any]] = [own]
         with self._lock:
             worker_addrs = [h.address for h in self.workers.values()
                             if h.registered and h.address is not None]
-        lock = threading.Lock()
-
-        pulled_addrs: List = []
-
-        def _pull(addr) -> None:
-            got = spans_lib.pull_snapshot(
-                addr, "cw_spans_snapshot",
-                timeout=self.SPANS_WORKER_TIMEOUT_S)
-            if got is None:
-                return
-            snap, t0, t1 = got
+        pulled = spans_lib.pull_snapshots(
+            worker_addrs, "cw_spans_snapshot",
+            timeout=self.SPANS_WORKER_TIMEOUT_S)
+        snapshots: List[Dict[str, Any]] = [own]
+        for _addr, snap, t0, t1 in pulled:
             snap["clock_offset_s"] = snap["wall_time"] - (t0 + t1) / 2.0
-            with lock:
-                snapshots.append(snap)
-                pulled_addrs.append(addr)
-
-        threads = [threading.Thread(target=_pull, args=(a,), daemon=True)
-                   for a in worker_addrs]
-        for t in threads:
-            t.start()
-        deadline = time.monotonic() + self.SPANS_WORKER_TIMEOUT_S + 1.0
-        for t in threads:
-            t.join(timeout=max(0.1, deadline - time.monotonic()))
+            snapshots.append(snap)
         # worker_addrs lets the GCS skip its direct-subscriber pull for
         # workers this reply already covers (they also subscribe to
         # pubsub, so without this every worker ring would ship twice).
         # Only successfully-pulled workers count: one the NM couldn't
         # reach may still be reachable from the GCS directly.
         return {"wall_time": reply_wall, "snapshots": snapshots,
-                "worker_addrs": [list(a) for a in pulled_addrs]}
+                "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
+
+    def _sample_metric_gauges(self) -> None:
+        """Node-level gauges for the metrics harvest: object-store
+        occupancy (incl. eviction-exempt pinned/leased bytes — the
+        watchdog's store probes), worker-pool size, and queued leases.
+        The gauge names match the Grafana panel exprs shipped by
+        dashboard/metrics.py."""
+        from ray_tpu.util.metrics import Gauge, get_or_create
+        stats = self.store.stats()
+        for name, desc, value in (
+                ("ray_tpu_object_store_used_bytes",
+                 "bytes resident in this node's object store",
+                 stats["used"]),
+                ("ray_tpu_object_store_capacity_bytes",
+                 "this node's object store capacity",
+                 stats["capacity"]),
+                ("ray_tpu_object_store_pinned_bytes",
+                 "eviction-exempt bytes (owner pins + reader leases)",
+                 stats["pinned_bytes"]),
+                ("ray_tpu_object_store_objects",
+                 "objects resident in this node's store",
+                 stats["num_objects"])):
+            get_or_create(Gauge, name, description=desc).set(float(value))
+        with self._lock:
+            num_workers = len(self.workers)
+            pending = len(self.pending)
+        get_or_create(
+            Gauge, "ray_tpu_num_workers",
+            description="worker processes on this node"
+        ).set(float(num_workers))
+        get_or_create(
+            Gauge, "ray_tpu_pending_leases",
+            description="lease requests queued at this node manager"
+        ).set(float(pending))
+
+    METRICS_WORKER_TIMEOUT_S = 3.0
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Metrics-plane gather for this node: the daemon's own registry
+        snapshot plus every registered worker's, one RPC hop below the
+        GCS fan-out (structure mirrors spans_snapshot; metrics carry
+        their own wall_time so no clock-offset chaining is needed)."""
+        from ray_tpu._private import metrics_plane as _metrics_plane
+        from ray_tpu._private import spans as spans_lib
+        with self._lock:
+            worker_addrs = [h.address for h in self.workers.values()
+                            if h.registered and h.address is not None]
+        pulled = spans_lib.pull_snapshots(
+            worker_addrs, "cw_metrics_snapshot",
+            timeout=self.METRICS_WORKER_TIMEOUT_S)
+        snapshots = [_metrics_plane.snapshot_process()]
+        snapshots.extend(snap for _a, snap, _t0, _t1 in pulled)
+        # worker_addrs lets the GCS skip its direct-subscriber pull for
+        # workers this reply already covers (only successfully-pulled
+        # ones: a worker the NM missed may answer the GCS directly)
+        return {"snapshots": snapshots,
+                "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
 
     def list_workers(self) -> List[Dict[str, Any]]:
         """Worker-level metadata for the state API (`ray list workers`)."""
@@ -1146,6 +1196,8 @@ class NodeManager:
         if self._dead:
             return
         self._dead = True
+        from ray_tpu._private import metrics_plane as _metrics_plane
+        _metrics_plane.unregister_sampler("node_manager")
         try:
             self.memory_monitor.stop()
         except AttributeError:

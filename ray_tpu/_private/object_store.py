@@ -651,6 +651,14 @@ class StoreServer:
                     "num_objects": len(self._objects),
                     "num_leased": sum(1 for e in self._objects.values()
                                       if e.leases > 0),
+                    # eviction-exempt bytes (pins + reader leases): the
+                    # watchdog's occupancy probe compares these against
+                    # used/capacity — pinned > used means the pin/lease
+                    # accounting leaked
+                    "pinned_bytes": sum(
+                        e.size for e in self._objects.values()
+                        if (e.pinned > 0 or e.leases > 0)
+                        and not e.spilled),
                     "num_spilled": self.num_spilled,
                     "num_restored": self.num_restored,
                     "native_arena": self.arena is not None}

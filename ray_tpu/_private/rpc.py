@@ -213,13 +213,19 @@ class RpcServer:
 _IDEMPOTENT_PREFIXES = ("get_", "list_", "kv_get", "kv_keys", "nm_get",
                         "nm_list", "cl_get", "cl_list",
                         # token-keyed add/remove + snapshot reads
-                        "wait_graph_")
+                        "wait_graph_",
+                        # metrics plane: harvest/exposition/history
+                        # reads and last-writer-wins tuning
+                        "metrics_")
 _IDEMPOTENT_METHODS = frozenset({
     "ping", "nm_ping", "report_resources", "register_node", "subscribe",
     "next_job_id", "cluster_resources", "available_resources",
     # object-store reads (store_wait is excluded: pin=True takes a
     # lease, and a blind resend would double-count it)
     "store_contains", "store_stats", "store_list", "store_arena_info",
+    # metrics-plane snapshot reads (registry reads; samplers only
+    # overwrite gauges, so a retried snapshot is harmless)
+    "cw_metrics_snapshot", "nm_metrics_snapshot",
 })
 
 

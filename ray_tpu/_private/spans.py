@@ -151,6 +151,16 @@ def set_process_label(label: str, node_id: Optional[str] = None) -> None:
         _node_id = node_id
 
 
+def process_label() -> str:
+    """This process's trace-row name (also the metrics plane's `proc`
+    label — one identity per process across both planes)."""
+    return _process_label or f"proc-{os.getpid()}"
+
+
+def process_node_id() -> Optional[str]:
+    return _node_id
+
+
 def set_current_trace(trace_id: Optional[str]) -> None:
     """Mirror of the core worker's trace TLS (kept here so recording
     never imports the worker stack)."""
@@ -294,6 +304,96 @@ def pull_snapshot(addr, method: str, timeout: float):
     except Exception:  # noqa: BLE001 - peer gone mid-collect
         return None
     return reply, t0, t1
+
+
+def pull_snapshots(addrs, method: str, timeout: float,
+                   grace_s: float = 1.0) -> List[tuple]:
+    """pull_snapshot fanned out to many peers on daemon threads under
+    one shared deadline (per-RPC timeout + grace for the joins).
+    Returns [(addr, reply, t0_wall, t1_wall)] for the peers that
+    answered; unreachable peers just drop out. Every gather point (NM
+    worker gathers, GCS span and metrics collects) goes through here so
+    the deadline/join semantics can't silently diverge between planes."""
+    from time import monotonic
+    lock = threading.Lock()
+    out: List[tuple] = []
+
+    def _pull(addr) -> None:
+        got = pull_snapshot(addr, method, timeout=timeout)
+        if got is None:
+            return
+        reply, t0, t1 = got
+        with lock:
+            out.append((tuple(addr), reply, t0, t1))
+
+    threads = [threading.Thread(target=_pull, args=(a,), daemon=True)
+               for a in addrs]
+    for t in threads:
+        t.start()
+    deadline = monotonic() + timeout + grace_s
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - monotonic()))
+    return out
+
+
+def gather_cluster_snapshots(gcs, nm_method: str, cw_method: str,
+                             timeout: float, grace_s: float = 1.0):
+    """The two-phase cluster gather both telemetry planes share:
+    enumerate alive node managers + pubsub subscribers under the GCS
+    lock, pull `nm_method` from every NM (each ships its own snapshot
+    plus its workers' and names the worker addresses it covered), then
+    pull `cw_method` from the remaining subscribers — drivers, and
+    workers whose NM dropped out mid-collect. Returns
+    (nm_replies, cw_replies, unreachable_node_ids) with replies in
+    pull_snapshots' (addr, reply, t0, t1) form; per-snapshot
+    annotation (clock offsets, tags) stays with the caller. One
+    topology for spans_collect and metrics_collect, so a scheduling
+    change (e.g. excluding draining nodes) can't silently diverge the
+    planes. BOTH phases run under one overall deadline of
+    timeout + grace_s: when unreachable NMs burn phase 1's budget, the
+    subscriber phase gets only the remainder — an outage must not
+    double the collect's worst case (the metrics sampler holds its
+    round lock for this long against a 2s interval)."""
+    from time import monotonic
+    deadline = monotonic() + timeout + grace_s
+    with gcs._lock:
+        nm_targets = [(nid, tuple(n.address))
+                      for nid, n in gcs.nodes.items() if n.alive]
+        sub_addrs = {tuple(addr)
+                     for subs in gcs.subscribers.values()
+                     for addr, _tok in subs}
+    sub_addrs -= {a for _nid, a in nm_targets}  # NMs answer nm_*, not cw_*
+
+    nm_replies = pull_snapshots([a for _nid, a in nm_targets], nm_method,
+                                timeout=timeout, grace_s=grace_s)
+    answered = {addr for addr, _r, _t0, _t1 in nm_replies}
+    unreachable = [nid for nid, a in nm_targets if a not in answered]
+    covered: set = set()
+    for _addr, reply, _t0, _t1 in nm_replies:
+        covered.update(tuple(a) for a in reply.get("worker_addrs", ()))
+    # healthy phase 1 leaves the full timeout + grace; a slow one
+    # shrinks phase 2 down to a 0.5s floor
+    remaining = max(0.5, deadline - monotonic())
+    t2 = min(timeout, remaining)
+    cw_replies = pull_snapshots(sorted(sub_addrs - covered), cw_method,
+                                timeout=t2,
+                                grace_s=min(grace_s, remaining - t2))
+    return nm_replies, cw_replies, unreachable
+
+
+def dedupe_by_uid(snaps) -> List[Dict[str, Any]]:
+    """First occurrence wins — callers order the concatenation by
+    preference (own snapshot first, then the estimation-quality order
+    that matters to them)."""
+    seen: set = set()
+    unique: List[Dict[str, Any]] = []
+    for snap in snaps:
+        uid = snap.get("proc_uid")
+        if uid in seen:
+            continue
+        seen.add(uid)
+        unique.append(snap)
+    return unique
 
 
 def snapshot() -> Dict[str, Any]:

@@ -30,6 +30,7 @@ re-running it on retry is also safe.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 
@@ -42,8 +43,9 @@ class WaitGraph:
         self._lock = threading.Lock()
         # waiter hex -> {target hex: outstanding edge count}
         self._edges: Dict[str, Dict[str, int]] = {}
-        # token -> (waiter hex, target hex) for every recorded edge
-        self._tokens: Dict[str, Tuple[str, str]] = {}
+        # token -> (waiter hex, target hex, registered_at monotonic) —
+        # the age feeds the metrics watchdog's stuck-wait probe
+        self._tokens: Dict[str, Tuple[str, str, float]] = {}
         self.deadlocks_detected = 0
 
     def add(self, waiter: str, target: str,
@@ -64,7 +66,7 @@ class WaitGraph:
                 return [waiter] + path
             targets = self._edges.setdefault(waiter, {})
             targets[target] = targets.get(target, 0) + 1
-            self._tokens[token] = (waiter, target)
+            self._tokens[token] = (waiter, target, time.monotonic())
         return None
 
     def remove(self, token: str) -> None:
@@ -72,7 +74,7 @@ class WaitGraph:
             edge = self._tokens.pop(token, None)
             if edge is None:
                 return  # unknown/already-removed token: idempotent
-            self._drop_edge_locked(*edge)
+            self._drop_edge_locked(edge[0], edge[1])
 
     def _drop_edge_locked(self, waiter: str, target: str) -> None:
         targets = self._edges.get(waiter)
@@ -93,9 +95,9 @@ class WaitGraph:
             self._edges.pop(actor, None)
             for targets in self._edges.values():
                 targets.pop(actor, None)
-            self._tokens = {tok: (w, t)
-                            for tok, (w, t) in self._tokens.items()
-                            if w != actor and t != actor}
+            self._tokens = {tok: rec
+                            for tok, rec in self._tokens.items()
+                            if rec[0] != actor and rec[1] != actor}
 
     def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
         """DFS path src -> dst following edges; None if unreachable.
@@ -114,10 +116,18 @@ class WaitGraph:
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
-            edges = [{"waiter": w, "target": t, "count": c}
+            now = time.monotonic()
+            oldest: Dict[Tuple[str, str], float] = {}
+            for w, t, t0 in self._tokens.values():
+                age = now - t0
+                if age > oldest.get((w, t), -1.0):
+                    oldest[(w, t)] = age
+            edges = [{"waiter": w, "target": t, "count": c,
+                      "age_s": oldest.get((w, t), 0.0)}
                      for w, targets in self._edges.items()
                      for t, c in targets.items()]
             return {"edges": edges,
+                    "max_edge_age_s": max(oldest.values(), default=0.0),
                     "deadlocks_detected": self.deadlocks_detected}
 
 

@@ -12,7 +12,14 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
     GET /api/objects  — state.list_objects() + store stats
     GET /api/jobs     — job table from the GCS KV
     GET /api/summary  — task-state counts
-    GET /metrics      — Prometheus exposition of this process's metrics
+    GET /metrics      — Prometheus exposition of the CLUSTER-merged
+                        registry (every process's metrics harvested via
+                        the GCS fan-out, labeled by proc/node; see
+                        _private/metrics_plane.py). Falls back to this
+                        process's own registry if the GCS is down.
+    GET /api/metrics  — the same harvest as JSON: per-proc snapshots +
+                        merged series (?history=1 → the GCS's in-memory
+                        time-series ring instead)
 """
 
 from __future__ import annotations
@@ -112,27 +119,6 @@ class _NoRoute(Exception):
     must surface as 500s, not 404s)."""
 
 
-_WG_GAUGES = None
-
-
-def _refresh_wait_graph_metrics() -> None:
-    """Mirror the GCS wait-graph snapshot into this process's metrics
-    registry so the Grafana panels (dashboard/metrics.py) have a real
-    series to scrape. Called per /metrics scrape; best-effort."""
-    global _WG_GAUGES
-    from ray_tpu.util import state
-    from ray_tpu.util.metrics import Gauge
-    if _WG_GAUGES is None:
-        _WG_GAUGES = (
-            Gauge("ray_tpu_wait_graph_edges",
-                  "live actor waits-for edges (blocking gets)"),
-            Gauge("ray_tpu_deadlocks_detected",
-                  "waits-for cycles detected since cluster start"))
-    snap = state.wait_graph()
-    _WG_GAUGES[0].set(float(len(snap["edges"])))
-    _WG_GAUGES[1].set(float(snap["deadlocks_detected"]))
-
-
 class DashboardHead:
     """Runs inside any process connected to the cluster (typically an
     actor started by start_dashboard)."""
@@ -158,12 +144,20 @@ class DashboardHead:
                 route = parsed.path.rstrip("/") or "/"
                 try:
                     if route == "/metrics":
-                        from ray_tpu.util.metrics import prometheus_text
+                        # cluster-merged exposition (the GCS-harvested
+                        # registry of every process); the GCS's native
+                        # wait-graph gauges replaced the per-scrape
+                        # mirror that used to live here. A GCS blip
+                        # degrades to this process's own registry
+                        # rather than failing the scrape.
                         try:
-                            _refresh_wait_graph_metrics()
-                        except Exception:  # noqa: BLE001 — GCS blip must
-                            pass           # not break the whole scrape
-                        body = prometheus_text().encode()
+                            from ray_tpu.util import state
+                            text = state.cluster_metrics_text()
+                        except Exception:  # noqa: BLE001
+                            from ray_tpu.util.metrics import \
+                                prometheus_text
+                            text = prometheus_text()
+                        body = text.encode()
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "text/plain; version=0.0.4")
@@ -247,6 +241,15 @@ class DashboardHead:
             if "worker_id" in params:
                 return s.profile_worker_stack(params["worker_id"])
             return s.profile_all_worker_stacks()
+        if route == "/api/metrics":
+            # harvested snapshots + merged series as JSON;
+            # ?history=1 returns the GCS's in-memory time-series ring
+            # (optionally ?names=prefix1,prefix2)
+            if params.get("history") in ("1", "true"):
+                names = [n for n in
+                         params.get("names", "").split(",") if n]
+                return s.metrics_history(names=names or None)
+            return s.cluster_metrics()
         if route == "/api/metrics/config":
             from ray_tpu.dashboard.metrics import write_metrics_configs
             return write_metrics_configs()

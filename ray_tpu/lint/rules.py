@@ -1,6 +1,6 @@
-"""graftlint rule set: 8 framework-aware checks.
+"""graftlint rule set: 11 framework-aware checks.
 
-Each rule has a stable id (RT001..RT008), a one-line rationale, and a
+Each rule has a stable id (RT001..RT011), a one-line rationale, and a
 `check(ctx)` generator yielding Findings. Rules are deliberately
 conservative: a finding should be actionable, and intentional
 exceptions are silenced in-place with `# graftlint: disable=RTxxx`
@@ -575,11 +575,124 @@ class WallClockDuration(Rule):
                     "deadlines) or time.perf_counter() (for timings)")
 
 
+class MetricNameConvention(Rule):
+    id = "RT011"
+    name = "metric-name-convention"
+    rationale = ("Prometheus-convention metric names keep the merged "
+                 "cluster endpoint queryable: counters end in _total, "
+                 "timing/size histograms carry _seconds/_bytes units, "
+                 "and per-entity id tag keys explode series cardinality")
+
+    _METRIC_MODULES = ("ray_tpu.util.metrics.", "ray.util.metrics.")
+    _KINDS = {"Counter", "Gauge", "Histogram"}
+    # spellings of units that have one canonical suffix
+    _BAD_UNIT_SUFFIXES = ("_ms", "_us", "_msec", "_usec", "_sec",
+                          "_secs", "_time", "_kb", "_mb", "_gb",
+                          "_size")
+    _GOOD_HIST_SUFFIXES = ("_seconds", "_bytes")
+    # tag keys whose value space grows with cluster activity: one series
+    # per object/task would melt any scrape backend
+    _HIGH_CARDINALITY_KEYS = {"object_id", "task_id", "actor_id",
+                              "worker_id", "lease_id", "trace_id",
+                              "oid", "ref", "object_ref", "pid"}
+
+    def _metric_kind(self, ctx: ModuleContext,
+                     node: ast.Call) -> Optional[str]:
+        """'Counter'/'Gauge'/'Histogram' when this call constructs a
+        ray_tpu metric (direct constructor or get_or_create(Cls, ...)),
+        resolved through import aliases so unrelated locally-defined
+        classes that happen to share a name are not flagged."""
+        name = ctx.call_name(node)
+        if name is None:
+            return None
+        if name.split(".")[-1] == "get_or_create":
+            if not node.args:
+                return None
+            cls = ctx.dotted(node.args[0])
+        else:
+            cls = name
+        if cls is None:
+            return None
+        qualified = any(s.startswith(self._METRIC_MODULES)
+                        for s in (name, cls))
+        if not qualified:
+            return None
+        kind = cls.split(".")[-1]
+        return kind if kind in self._KINDS else None
+
+    @staticmethod
+    def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return None
+
+    def _call_arg(self, node: ast.Call, pos: int,
+                  kw: str) -> Optional[ast.AST]:
+        for k in node.keywords:
+            if k.arg == kw:
+                return k.value
+        return node.args[pos] if len(node.args) > pos else None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._metric_kind(ctx, node)
+            if kind is None:
+                continue
+            is_factory = (ctx.call_name(node) or "").endswith(
+                "get_or_create")
+            name_pos = 1 if is_factory else 0
+            name = self._const_str(
+                self._call_arg(node, name_pos, "name"))
+            if name is not None:
+                if kind == "Counter" and not name.endswith("_total"):
+                    yield self.finding(
+                        ctx, node,
+                        f"counter {name!r} must end in '_total' "
+                        f"(Prometheus counter convention; rate() "
+                        f"queries key on it)")
+                if kind != "Counter" and name.endswith("_total"):
+                    yield self.finding(
+                        ctx, node,
+                        f"{kind.lower()} {name!r} ends in '_total', "
+                        f"which marks counters; pick a point-in-time "
+                        f"name")
+                if name.endswith(self._BAD_UNIT_SUFFIXES):
+                    yield self.finding(
+                        ctx, node,
+                        f"metric {name!r} uses a non-canonical unit "
+                        f"suffix; use base units '_seconds' / '_bytes'")
+                elif kind == "Histogram" and not name.endswith(
+                        self._GOOD_HIST_SUFFIXES):
+                    yield self.finding(
+                        ctx, node,
+                        f"histogram {name!r} should name its unit with "
+                        f"a '_seconds' or '_bytes' suffix (histograms "
+                        f"measure durations or sizes)")
+            # tag_keys position in the constructors: Counter/Gauge
+            # (name, description, tag_keys), Histogram adds boundaries
+            # before it; get_or_create passes them as kwargs only
+            pos = 99 if is_factory else (3 if kind == "Histogram" else 2)
+            tag_keys = self._call_arg(node, pos, "tag_keys")
+            if isinstance(tag_keys, (ast.Tuple, ast.List)):
+                for elt in tag_keys.elts:
+                    key = self._const_str(elt)
+                    if key is not None and \
+                            key in self._HIGH_CARDINALITY_KEYS:
+                        yield self.finding(
+                            ctx, elt,
+                            f"tag key {key!r} is per-entity: one "
+                            f"series per {key} makes cardinality grow "
+                            f"with cluster activity; aggregate or put "
+                            f"the id in logs/events instead")
+
+
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
     ClosureMutationInJit(), ActorCallWithoutRemote(), LeakedObjectRef(),
     DictOrderPytree(), SwallowedException(), StoreViewCopy(),
-    WallClockDuration(),
+    WallClockDuration(), MetricNameConvention(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

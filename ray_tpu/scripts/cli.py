@@ -324,6 +324,86 @@ def cmd_chaos(args) -> int:
     raise SystemExit(f"unknown chaos command {args.chaos_cmd!r}")
 
 
+def cmd_metrics(args) -> int:
+    """Cluster metrics plane (see README "Cluster metrics"): dump the
+    merged registry (text exposition or JSON harvest), or print the
+    watchdog's recent HEALTH_ALERT events."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    if args.metrics_cmd == "dump":
+        # an operator dumping wants the cluster as of NOW, not the
+        # sampler's last round
+        if args.format == "json":
+            print(json.dumps(s.cluster_metrics(fresh=True),
+                             default=str))
+        else:
+            print(s.cluster_metrics_text(fresh=True), end="")
+        return 0
+    if args.metrics_cmd == "alerts":
+        alerts = s.health_alerts()
+        if args.format == "json":
+            print(json.dumps(alerts, default=str))
+            return 0
+        _print_table(
+            [{**a, "ts": f"{a.get('ts', 0):.0f}"} for a in alerts],
+            ["ts", "severity", "probe", "series", "message"])
+        return 0
+    raise SystemExit(f"unknown metrics command {args.metrics_cmd!r}")
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values) -> str:
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        " " if v is None else
+        _SPARK_BLOCKS[int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))]
+        for v in values)
+
+
+def cmd_top(args) -> int:
+    """Curses-free cluster watch over the GCS's in-memory time-series
+    ring: last value, rate over the sample window, and a sparkline of
+    recent history per series — no external Prometheus needed."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    for i in range(args.iterations):
+        if i:
+            time.sleep(args.interval)
+        hist = s.metrics_history(
+            names=[args.filter] if args.filter else None)
+        samples = hist["samples"]
+        if not samples:
+            print("(no samples yet — the GCS harvests every "
+                  f"{hist['interval_s']:g}s)")
+            continue
+        if sys.stdout.isatty() and args.iterations != 1:
+            print("\x1b[2J\x1b[H", end="")
+        ts, latest = samples[-1]
+        keys = sorted(latest)
+        window = samples[-30:]
+        rows = []
+        for k in keys:
+            vals = [smp.get(k) for _t, smp in window]
+            rate = ""
+            if len(samples) >= 2:
+                (t0, prev), (t1, cur) = samples[-2], samples[-1]
+                if k in prev and k in cur and t1 > t0:
+                    rate = f"{(cur[k] - prev[k]) / (t1 - t0):+.1f}/s"
+            rows.append({"series": k, "value": f"{latest[k]:g}",
+                         "rate": rate,
+                         "history": _sparkline(vals)})
+        print(f"== ray_tpu top · {len(keys)} series · "
+              f"sample interval {hist['interval_s']:g}s")
+        _print_table(rows, ["series", "value", "rate", "history"])
+    return 0
+
+
 def cmd_lint(args) -> int:
     """graftlint passthrough (same engine as `python -m ray_tpu.lint`)."""
     from ray_tpu.lint.__main__ import main as lint_main
@@ -391,6 +471,24 @@ def main(argv=None) -> int:
     p.add_argument("--select", default=None, help="rule ids to run")
     p.add_argument("--ignore", default=None, help="rule ids to skip")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("metrics", help="cluster metrics plane: dump the "
+                                       "merged registry / watchdog alerts")
+    p.add_argument("metrics_cmd", choices=["dump", "alerts"])
+    p.add_argument("--address", default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("top", help="watch cluster series (rates + "
+                                   "sparklines from the GCS history ring)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period between iterations")
+    p.add_argument("--iterations", type=int, default=1,
+                   help="refresh count (use a large value to watch)")
+    p.add_argument("--filter", default="ray_tpu_",
+                   help="series name prefix ('' for everything)")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("chaos", help="fault injection: list/inject/clear "
                                      "chaos rules (see README)")

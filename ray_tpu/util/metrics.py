@@ -147,6 +147,28 @@ def collect() -> List[Dict]:
     return [m.snapshot() for m in metrics]
 
 
+def _to_wire(snap: Dict) -> Dict:
+    """Convert one snapshot() record to the JSON-safe wire format the
+    cluster metrics plane ships over RPC: tag tuples become plain dicts
+    so snapshots survive json.dumps on the dashboard routes."""
+    out = {"name": snap["name"], "kind": snap["kind"],
+           "description": snap.get("description", "")}
+    if snap["kind"] == "histogram":
+        out["boundaries"] = list(snap["boundaries"])
+        out["series"] = [{"tags": dict(k), "buckets": list(b),
+                          "sum": snap["sum"][k], "count": snap["count"][k]}
+                         for k, b in snap["buckets"].items()]
+    else:
+        out["series"] = [{"tags": dict(k), "value": v}
+                         for k, v in snap["values"].items()]
+    return out
+
+
+def collect_wire() -> List[Dict]:
+    """collect() in wire format (see _to_wire)."""
+    return [_to_wire(s) for s in collect()]
+
+
 def _esc_label(v: str) -> str:
     """Prometheus exposition label escaping (\\ " and newline): one bad
     label value would otherwise abort the entire scrape."""
@@ -161,42 +183,68 @@ def _fmt_tags(key: Tuple[Tuple[str, str], ...]) -> str:
     return "{" + inner + "}"
 
 
+def render_prometheus(metrics: List[Dict]) -> str:
+    """Prometheus exposition text from wire-format metric snapshots
+    (collect_wire()-shaped). The cluster metrics plane concatenates many
+    processes' snapshots, each carrying an ``extra_tags`` dict (proc/node
+    labels), so HELP/TYPE are emitted once per metric NAME while series
+    of the same name from different processes stay adjacent — Prometheus
+    rejects exposition with a repeated TYPE line for one metric."""
+    by_name: Dict[str, List[Dict]] = {}
+    order: List[str] = []
+    for m in metrics:
+        if m["name"] not in by_name:
+            order.append(m["name"])
+        by_name.setdefault(m["name"], []).append(m)
+    lines: List[str] = []
+    for name in order:
+        group = by_name[name]
+        kind = group[0]["kind"]
+        desc = next((g["description"] for g in group
+                     if g.get("description")), "")
+        if desc:
+            desc = str(desc).replace("\n", " ")
+            lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {kind}")
+
+        def bucket_line(tags: Dict[str, str], le: str, cum: int) -> str:
+            key = tuple(sorted({**tags, "le": le}.items()))
+            return f"{name}_bucket{_fmt_tags(key)} {cum}"
+
+        for m in group:
+            if m["kind"] != kind:
+                continue  # conflicting registration; first kind wins
+            extra = m.get("extra_tags") or {}
+            if kind == "histogram":
+                for s in m["series"]:
+                    tags = {**s["tags"], **extra}
+                    base = tuple(sorted(tags.items()))
+                    cum = 0
+                    for bound, count in zip(m["boundaries"],
+                                            s["buckets"]):
+                        cum += count
+                        lines.append(bucket_line(tags, str(bound), cum))
+                    cum += s["buckets"][-1]
+                    lines.append(bucket_line(tags, "+Inf", cum))
+                    lines.append(f"{name}_sum{_fmt_tags(base)} "
+                                 f"{s['sum']}")
+                    lines.append(f"{name}_count{_fmt_tags(base)} "
+                                 f"{s['count']}")
+            else:
+                for s in m["series"]:
+                    tags = tuple(sorted({**s["tags"], **extra}.items()))
+                    lines.append(f"{name}{_fmt_tags(tags)} {s['value']}")
+    return "\n".join(lines) + "\n"
+
+
 def prometheus_text() -> str:
     """This process's metrics in Prometheus exposition format (reference:
     the per-node metrics agent exporting to Prometheus,
-    _private/metrics_agent.py + prometheus_exporter.py)."""
-    lines: List[str] = []
-    for snap in collect():
-        name = snap["name"]
-        if snap.get("description"):
-            desc = str(snap["description"]).replace("\n", " ")
-            lines.append(f"# HELP {name} {desc}")
-        if snap["kind"] == "histogram":
-            lines.append(f"# TYPE {name} histogram")
-            for key, buckets in snap["buckets"].items():
-                cum = 0
-                for bound, count in zip(snap["boundaries"], buckets):
-                    cum += count
-                    tags = dict(key)
-                    tags["le"] = str(bound)
-                    lines.append(
-                        f"{name}_bucket"
-                        f"{_fmt_tags(tuple(sorted(tags.items())))} {cum}")
-                cum += buckets[-1]
-                tags = dict(key)
-                tags["le"] = "+Inf"
-                lines.append(
-                    f"{name}_bucket"
-                    f"{_fmt_tags(tuple(sorted(tags.items())))} {cum}")
-                lines.append(f"{name}_sum{_fmt_tags(key)} "
-                             f"{snap['sum'][key]}")
-                lines.append(f"{name}_count{_fmt_tags(key)} "
-                             f"{snap['count'][key]}")
-            continue
-        lines.append(f"# TYPE {name} {snap['kind']}")
-        for key, value in snap["values"].items():
-            lines.append(f"{name}{_fmt_tags(key)} {value}")
-    return "\n".join(lines) + "\n"
+    _private/metrics_agent.py + prometheus_exporter.py). The cluster-wide
+    equivalent is the dashboard /metrics endpoint, which serves the
+    harvested-and-merged registry of every process (_private/
+    metrics_plane.py)."""
+    return render_prometheus(collect_wire())
 
 
 def clear() -> None:

@@ -174,6 +174,42 @@ def chaos_rules() -> Dict[str, Any]:
     return _gcs().call("chaos_list")
 
 
+def cluster_metrics(fresh: bool = False) -> Dict[str, Any]:
+    """Cluster-wide metrics: per-process registry snapshots (harvested
+    GCS → node managers → workers, plus drivers) and the cluster-merged
+    series/wire views (_private/metrics_plane.py), all from ONE harvest
+    round so the views are mutually consistent. Backs the dashboard
+    /api/metrics route and `ray_tpu metrics dump --format=json`;
+    `fresh=True` forces a harvest-NOW fan-out first, like
+    cluster_metrics_text(fresh=True)."""
+    return _gcs().call("metrics_merged", fresh=fresh)
+
+
+def cluster_metrics_text(fresh: bool = False) -> str:
+    """The cluster-merged registry in Prometheus exposition format —
+    what the dashboard /metrics endpoint serves: every harvested series
+    labeled by proc + node, histogram buckets cumulative. Scrapes ride
+    the GCS sampler's last round (at most one sample interval stale);
+    `fresh=True` forces a harvest-NOW fan-out first — for operators
+    and tests that just induced the state they want to see."""
+    return _gcs().call("metrics_prometheus", force=fresh)
+
+
+def metrics_history(names: Optional[List[str]] = None,
+                    limit: Optional[int] = None) -> Dict[str, Any]:
+    """Recent samples from the GCS's in-memory time-series ring
+    ({"interval_s", "samples": [(wall_ts, {series: value}), ...]}) —
+    rates/deltas/sparklines for `ray_tpu top` without an external
+    Prometheus."""
+    return _gcs().call("metrics_history", names=names, limit=limit)
+
+
+def health_alerts(limit: int = 100) -> List[Dict[str, Any]]:
+    """HEALTH_ALERT events the metrics watchdog emitted (invariant
+    probes over the harvested series; see _private/metrics_plane.py)."""
+    return list_cluster_events(event_type="HEALTH_ALERT", limit=limit)
+
+
 def emit_event(event_type: str, message: str = "",
                severity: str = "INFO", **fields: Any) -> None:
     """Application-level structured event into the cluster event table

@@ -54,8 +54,13 @@ def test_wait_graph_remove_and_counts():
     assert g.add("b", "a", "t3") is not None  # still cyclic
     g.remove("t2")
     assert g.add("b", "a", "t4") is None      # edge fully released
-    assert g.snapshot()["edges"] == [
+    snap = g.snapshot()
+    assert [{"waiter": e["waiter"], "target": e["target"],
+             "count": e["count"]} for e in snap["edges"]] == [
         {"waiter": "b", "target": "a", "count": 1}]
+    # edges carry their age for the metrics watchdog's stuck-wait probe
+    assert snap["edges"][0]["age_s"] >= 0.0
+    assert snap["max_edge_age_s"] >= snap["edges"][0]["age_s"]
 
 
 def test_wait_graph_token_idempotency():
@@ -63,7 +68,8 @@ def test_wait_graph_token_idempotency():
     g = WaitGraph()
     assert g.add("a", "b", "t1") is None
     assert g.add("a", "b", "t1") is None  # retry of the same add
-    assert g.snapshot()["edges"] == [
+    assert [{"waiter": e["waiter"], "target": e["target"],
+             "count": e["count"]} for e in g.snapshot()["edges"]] == [
         {"waiter": "a", "target": "b", "count": 1}]
     g.remove("t1")
     g.remove("t1")  # retry of the same remove
@@ -243,11 +249,12 @@ def test_multi_ref_get_releases_resolved_edges(ray_start):
 
 
 def test_wait_graph_metrics_exported(ray_start):
-    """The Grafana panels' series exist: the dashboard scrape mirrors
-    the GCS wait-graph snapshot into prometheus gauges."""
-    from ray_tpu.dashboard.head import _refresh_wait_graph_metrics
-    from ray_tpu.util.metrics import prometheus_text
-    _refresh_wait_graph_metrics()
-    text = prometheus_text()
+    """The Grafana panels' series exist: the GCS exports the wait-graph
+    gauges natively and the cluster metrics harvest carries them onto
+    the merged /metrics exposition (the per-scrape dashboard mirror is
+    gone — see _private/metrics_plane.py)."""
+    from ray_tpu.util import state
+    text = state.cluster_metrics_text()
     assert "ray_tpu_wait_graph_edges" in text
     assert "ray_tpu_deadlocks_detected" in text
+    assert "ray_tpu_wait_graph_max_edge_age_seconds" in text

@@ -703,9 +703,111 @@ def test_rt010_timestamp_without_arithmetic_fine():
     assert "RT010" not in rules_hit(src)
 
 
+# ---- RT011 metric-name conventions ----------------------------------------
+
+RT011_POS_COUNTER = """
+    from ray_tpu.util.metrics import Counter
+
+    faults = Counter("chaos_faults_injected", "fired faults")
+"""
+
+RT011_POS_HISTOGRAM_UNIT = """
+    from ray_tpu.util.metrics import Histogram
+
+    lat = Histogram("request_latency_ms", "latency")
+"""
+
+RT011_POS_HISTOGRAM_NO_UNIT = """
+    from ray_tpu.util.metrics import Histogram
+
+    lat = Histogram("request_latency", "latency")
+"""
+
+RT011_POS_GAUGE_TOTAL = """
+    from ray_tpu.util.metrics import Gauge
+
+    depth = Gauge("queue_depth_total", "queued calls")
+"""
+
+RT011_POS_HIGH_CARDINALITY = """
+    from ray_tpu.util.metrics import Counter
+
+    pulls = Counter("object_pulls_total", "pulls",
+                    tag_keys=("site", "object_id"))
+"""
+
+RT011_POS_FACTORY = """
+    from ray_tpu.util.metrics import Counter, get_or_create
+
+    def count(n):
+        get_or_create(Counter, "bytes_copied", description="x").inc(n)
+"""
+
+RT011_SUPPRESSED = """
+    from ray_tpu.util.metrics import Counter
+
+    faults = Counter("chaos_faults_injected", "f")  # graftlint: disable=RT011
+"""
+
+RT011_NEG_CLEAN = """
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    c = Counter("requests_total", "requests", tag_keys=("route",))
+    g = Gauge("queue_depth", "queued calls")
+    h = Histogram("request_seconds", "latency", boundaries=[0.1, 1.0])
+    hb = Histogram("payload_bytes", "sizes")
+"""
+
+RT011_NEG_UNRELATED_CLASS = """
+    class Counter:
+        def __init__(self, name):
+            self.name = name
+
+    c = Counter("not_a_metric")
+"""
+
+
+def test_rt011_counter_must_end_total():
+    assert "RT011" in rules_hit(RT011_POS_COUNTER)
+
+
+def test_rt011_bad_unit_suffix():
+    assert "RT011" in rules_hit(RT011_POS_HISTOGRAM_UNIT)
+
+
+def test_rt011_histogram_needs_unit():
+    assert "RT011" in rules_hit(RT011_POS_HISTOGRAM_NO_UNIT)
+
+
+def test_rt011_gauge_must_not_end_total():
+    assert "RT011" in rules_hit(RT011_POS_GAUGE_TOTAL)
+
+
+def test_rt011_high_cardinality_tag_key():
+    fs = [f for f in findings(RT011_POS_HIGH_CARDINALITY)
+          if f.rule_id == "RT011"]
+    assert fs and "object_id" in fs[0].message
+
+
+def test_rt011_get_or_create_factory_checked():
+    assert "RT011" in rules_hit(RT011_POS_FACTORY)
+
+
+def test_rt011_suppressed():
+    assert "RT011" not in rules_hit(RT011_SUPPRESSED)
+
+
+def test_rt011_clean_names_pass():
+    assert "RT011" not in rules_hit(RT011_NEG_CLEAN)
+
+
+def test_rt011_unrelated_local_class_not_flagged():
+    assert "RT011" not in rules_hit(RT011_NEG_UNRELATED_CLASS)
+
+
 def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
-    assert ids == [f"RT00{i}" for i in range(1, 10)] + ["RT010"]
+    assert ids == [f"RT00{i}" for i in range(1, 10)] + ["RT010", "RT011"]
     assert all(r.rationale for r in ALL_RULES)
 
 
