@@ -160,6 +160,10 @@ class ChaosClient:
         self.gcs_address: Optional[Tuple[str, int]] = None
         # NM-registered actuator: fn(actor_class_glob) -> None
         self._kill_actuator: Optional[Callable[[str], None]] = None
+        # worker-registered black-box hook: fn(reason) runs just before
+        # a chaos self-kill so the dying process can persist its flight
+        # dump (log_plane.write_flight_dump)
+        self._predeath_hook: Optional[Callable[[str], Any]] = None
         self._tls = threading.local()
         self._counter = None  # lazy prometheus counter
         self._report_client = None
@@ -196,6 +200,7 @@ class ChaosClient:
             self.is_worker = False
             self.gcs_address = None
             self._kill_actuator = None
+            self._predeath_hook = None
             self._version = -1
             self._rules = [st for st in self._rules
                            if st.rule.rule_id == "env-rpc-delay"]
@@ -211,6 +216,11 @@ class ChaosClient:
         """Node manager registers how kill_worker rules targeting its
         node take effect (kill a matching local worker process)."""
         self._kill_actuator = fn
+
+    def set_predeath_hook(self, fn: Callable[[str], Any]) -> None:
+        """Worker registers its black-box flight-dump writer, run just
+        before a self-kill fault exits the process."""
+        self._predeath_hook = fn
 
     # ---- policy install ----------------------------------------------
 
@@ -443,6 +453,14 @@ class ChaosClient:
             logger.warning("chaos: rule %s killing this worker (%s)",
                            kill.rule.rule_id, self.actor_class or "task")
             try:
+                if self._predeath_hook is not None:
+                    # persist the span-ring tail + log tail so the node
+                    # manager's postmortem bundle can explain this death
+                    try:
+                        self._predeath_hook(
+                            f"chaos rule {kill.rule.rule_id} kill_worker")
+                    except Exception:  # noqa: BLE001 - dying anyway
+                        pass
                 self._flush_report()
             finally:
                 os._exit(1)

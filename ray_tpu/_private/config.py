@@ -72,6 +72,19 @@ class Config:
     watchdog_store_occupancy_frac = _define(
         "watchdog_store_occupancy_frac", 0.95, float)
     watchdog_queue_depth = _define("watchdog_queue_depth", 256, int)
+    # Debug plane (_private/log_plane.py + log_monitor.py): per-worker
+    # in-memory tail index depth, driver-stream flood control (per-source
+    # token bucket), and crash-postmortem bundle sizes.
+    log_tail_lines = _define("log_tail_lines", 2000, int)
+    log_stream_rate_lps = _define("log_stream_rate_lps", 500.0, float)
+    log_stream_burst = _define("log_stream_burst", 1000, int)
+    postmortem_log_lines = _define("postmortem_log_lines", 100, int)
+    postmortem_span_tail = _define("postmortem_span_tail", 200, int)
+    postmortems_max = _define("postmortems_max", 256, int)
+    # Transit pins on ObjectRefs embedded in task results: fallback TTL
+    # used only when the owner's ack never arrives (the normal path
+    # releases on ack — see _Executor._report_done).
+    transit_pin_ttl_s = _define("transit_pin_ttl_s", 30.0, float)
 
 
 if Config.testing_rpc_delay_us:

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import log_plane as _log_plane
 from ray_tpu._private import metrics_plane as _metrics_plane
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import serialization as ser
@@ -265,6 +266,10 @@ class CoreWorker:
             # metrics-plane gather point (dashboard /metrics,
             # `ray_tpu metrics dump`; see _private/metrics_plane.py)
             "cw_metrics_snapshot": _metrics_plane.snapshot_process,
+            # debug-plane gather point (`ray_tpu logs`; see
+            # _private/log_plane.py) — drivers live outside any node
+            # manager's log dir, so the GCS pulls their tails directly
+            "cw_logs_snapshot": _log_plane.snapshot,
         }
         self.executor: Optional[_Executor] = None
         if mode == "worker":
@@ -276,6 +281,14 @@ class CoreWorker:
         # one trace row per process in the merged timeline
         _spans.set_process_label(f"{mode}-{self.worker_id.hex()[:8]}",
                                  node_id=node_id_hex)
+        # debug plane: log-line stamps read the current task/actor/trace
+        # from this worker's TLS; drivers additionally capture their own
+        # `logging` output into the in-process tail ring so `ray_tpu
+        # logs` answers for them too (workers already stamp via the
+        # worker_main stream redirection)
+        _log_plane.set_context_provider(self._log_context)
+        if mode == "driver":
+            _log_plane.install_capture("driver")
         # lease/executor gauges exported at harvest time (pull-based:
         # the submission hot path never touches the registry); the
         # watchdog's lease_slot_balance probe reads exactly these
@@ -302,6 +315,12 @@ class CoreWorker:
         chaos_lib.client().set_context(
             node_id=node_id_hex, is_worker=(mode == "worker"),
             gcs_address=self.gcs_address)
+        if mode == "worker":
+            # black-box flight dump: a chaos self-kill writes this
+            # worker's span-ring tail + recent log records to a sidecar
+            # the node manager folds into the crash postmortem
+            chaos_lib.client().set_predeath_hook(
+                _log_plane.write_flight_dump)
         chaos_lib.fetch_policy(self._gcs.call)
         try:
             self.subscribe("chaos", chaos_lib.on_policy_message)
@@ -357,6 +376,16 @@ class CoreWorker:
 
     def current_task_id(self) -> TaskID:
         return getattr(self._tls, "task_id", None) or self._root_task_id
+
+    def _log_context(self) -> Tuple[Optional[str], Optional[str],
+                                    Optional[str]]:
+        """(task, actor, trace) for the debug plane's line stamps —
+        read on every stamped write, so: TLS lookups only."""
+        tid = getattr(self._tls, "task_id", None)
+        aid = self.executor.actor_id if self.executor is not None else None
+        return (tid.hex() if tid is not None else None,
+                aid.hex() if aid is not None else None,
+                getattr(self._tls, "trace_id", None))
 
     def set_current_task(self, task_id: Optional[TaskID]) -> None:
         self._tls.task_id = task_id
@@ -557,14 +586,15 @@ class CoreWorker:
             except Exception:  # noqa: BLE001 - owner gone; nothing to free
                 pass
 
-    def pin_refs_with_ttl(self, refs: List[Any],
-                          ttl_s: float = 30.0) -> None:
-        """Keep objects alive across a result/report hand-off window:
-        pin now (locally for objects we own, one-way borrower-pin at the
-        remote owner otherwise), release after ttl_s — by then the
-        consumer has registered its eager nested borrow. Expiry rides
-        the borrow-release loop (≤10s granularity) rather than one
-        timer thread per result."""
+    def pin_refs(self, refs: List[Any]) -> Tuple[List[str], List[Tuple]]:
+        """Pin objects across a result/report hand-off window: locally
+        (arg_pins) for objects we own, one-way borrower-pin at the
+        remote owner otherwise. Returns a (local hexes, remote keys)
+        handle for release_pins_now / release_pins_after. A remote key
+        is recorded ONLY when its cw_add_ref send succeeded — recording
+        a failed send would make the later release emit an unmatched
+        cw_remove_ref that decrements a pin some OTHER borrower
+        legitimately holds, freeing a live object (ADVICE r5)."""
         local: List[str] = []
         remote_keys: List[Tuple] = []
         for ref in refs:
@@ -575,15 +605,51 @@ class CoreWorker:
         with self._lock:
             for h in local:
                 self.arg_pins[h] = self.arg_pins.get(h, 0) + 1
+        remote_sent: List[Tuple] = []
         for addr, h in remote_keys:
             try:
                 self._pool.get(addr).send_oneway(
                     "cw_add_ref", oid_hex=h, borrower=self.address)
-            except Exception:  # noqa: BLE001 — owner gone
-                pass
+            except Exception:  # noqa: BLE001 — owner gone; the consumer's
+                continue      # get surfaces the loss
+            remote_sent.append((addr, h))
+        return (local, remote_sent)
+
+    def release_pins_now(self, handle: Tuple[List[str], List[Tuple]]
+                         ) -> None:
+        """Release a pin_refs handle immediately (the consumer acked:
+        its own eager borrows are registered)."""
+        local, remote_keys = handle
+        with self._lock:
+            self._release_local_pins_locked(local)
+        for addr, h in remote_keys:
+            self._borrow_release_queue.put((addr, h))
+
+    def release_pins_after(self, handle: Tuple[List[str], List[Tuple]],
+                           ttl_s: float) -> None:
+        """Schedule a pin_refs handle for TTL release (the fallback when
+        no ack will come). Expiry rides the borrow-release loop (≤10s
+        granularity) rather than one timer thread per result."""
+        local, remote_keys = handle
         with self._lock:
             self._ttl_pins.append(
                 (time.monotonic() + ttl_s, local, remote_keys))
+
+    def pin_refs_with_ttl(self, refs: List[Any],
+                          ttl_s: float = 30.0) -> None:
+        """pin_refs + TTL-scheduled release in one step (callers without
+        an ack path)."""
+        self.release_pins_after(self.pin_refs(refs), ttl_s)
+
+    def _release_local_pins_locked(self, hexes: List[str]) -> None:
+        for h in hexes:
+            n = self.arg_pins.get(h, 0) - 1
+            if n <= 0:
+                self.arg_pins.pop(h, None)
+                if self.local_refs.get(h, 0) == 0:
+                    self._maybe_free_locked(h)
+            else:
+                self.arg_pins[h] = n
 
     def _expire_ttl_pins(self) -> None:
         now = time.monotonic()
@@ -593,14 +659,7 @@ class CoreWorker:
                 return
             self._ttl_pins = [p for p in self._ttl_pins if p[0] > now]
             for _, local, _ in due:
-                for h in local:
-                    n = self.arg_pins.get(h, 0) - 1
-                    if n <= 0:
-                        self.arg_pins.pop(h, None)
-                        if self.local_refs.get(h, 0) == 0:
-                            self._maybe_free_locked(h)
-                    else:
-                        self.arg_pins[h] = n
+                self._release_local_pins_locked(local)
         for _, _, remote_keys in due:
             for addr, h in remote_keys:
                 self._borrow_release_queue.put((addr, h))
@@ -2168,12 +2227,25 @@ class CoreWorker:
             except Exception:  # noqa: BLE001
                 logger.exception("pubsub callback failed")
 
-    def subscribe(self, channel: str, callback: Any) -> None:
+    def subscribe(self, channel: str, callback: Any) -> str:
         import uuid
         token = uuid.uuid4().hex
         self._subscriptions[(channel, token)] = callback
         self._gcs.call("subscribe", channel=channel, address=self.address,
                        token=token)
+        return token
+
+    def unsubscribe(self, channel: str, token: str) -> None:
+        """Drop a subscription end to end: local callback AND the GCS's
+        (address, token) entry — a short-lived subscriber (follow-mode
+        log streaming) must not keep the publish fan-out paying for it
+        forever."""
+        self._subscriptions.pop((channel, token), None)
+        try:
+            self._gcs.call("unsubscribe", channel=channel,
+                           address=self.address, token=token)
+        except Exception:  # noqa: BLE001 - GCS gone; entry dies with it
+            pass
 
     def _on_can_exit(self) -> bool:
         """May this worker exit without stranding objects? False while
@@ -2533,21 +2605,33 @@ class _Executor:
                      for r in collected] or None)
                 all_collected.extend(collected)
             nested = None
+            pin_handle = None
             if all_collected:
                 # ObjectRefs embedded in RESULTS: their descriptors ride
                 # the done report so the task's owner registers borrows
                 # EAGERLY (released when it frees the enclosing result)
-                # — reference ReferenceCounter "contained refs". A short
-                # TTL pin bridges the report's transit, since our python
-                # refs die right after this frame.
+                # — reference ReferenceCounter "contained refs". Transit
+                # pins bridge the report: held until the owner ACKS (the
+                # report goes blocking when nested refs ride it — see
+                # _report_done), since our python refs die right after
+                # this frame. Releasing on a wall-clock TTL instead let
+                # a chaos-delayed report outlive the pins and observe
+                # freed nested objects (ADVICE r5); the TTL survives
+                # only as the no-ack fallback below.
                 nested = per_return
-                cw.pin_refs_with_ttl(all_collected, ttl_s=30.0)
+                pin_handle = cw.pin_refs(all_collected)
             # recycling decision rides the report so the owner retires
             # this worker's lease (reuse=False) atomically — a
             # post-report exit would race new leases onto a dying process
             will_exit = decide_exit()
-            self._report_done(spec, results, worker_exiting=will_exit,
-                              nested_refs=nested)
+            ok = self._report_done(spec, results, worker_exiting=will_exit,
+                                   nested_refs=nested)
+            if pin_handle is not None:
+                if ok:
+                    cw.release_pins_now(pin_handle)
+                else:
+                    cw.release_pins_after(pin_handle,
+                                          Config.transit_pin_ttl_s)
         finally:
             _spans.finish_span(_task_span)
             cw.task_events.record(spec.task_id.hex(), ts_exec_end=_ev_now())
@@ -2627,20 +2711,30 @@ class _Executor:
     def _report_done(self, spec: TaskSpec, results: List[Tuple],
                      dynamic_children: Optional[List[Tuple]] = None,
                      worker_exiting: bool = False,
-                     nested_refs: Optional[List[Tuple]] = None) -> None:
+                     nested_refs: Optional[List[Tuple]] = None) -> bool:
+        """Report completion to the owner; returns True when the owner
+        ACKED the report (blocking path) — the caller may then release
+        transit pins immediately instead of waiting out a TTL."""
         lease_id = getattr(spec, "_lease_id", None)
         try:
-            if worker_exiting:
+            if worker_exiting or nested_refs:
                 # BLOCKING when this process is about to exit (max_calls
-                # recycling): the owner must record the result before
-                # the NM's worker-death report can race in, else a task
-                # that succeeded gets retried (side effects twice)
+                # recycling: the owner must record the result before the
+                # NM's worker-death report can race in, else a task that
+                # succeeded gets retried — side effects twice) AND when
+                # ObjectRefs ride the result: the owner registers its
+                # eager nested borrows inside this call, so on return
+                # the transit pins may drop — a one-way report delayed
+                # in flight (chaos `delay` on this path) could otherwise
+                # arrive after the pins' TTL and find the nested objects
+                # freed (ADVICE r5).
                 self.cw._pool.get(spec.owner_address).call(
                     "cw_task_done", task_id=spec.task_id,
                     results=results, lease_id=lease_id,
                     dynamic_children=dynamic_children,
-                    worker_exiting=True, nested_refs=nested_refs)
-                return
+                    worker_exiting=worker_exiting,
+                    nested_refs=nested_refs)
+                return True
             # one-way: the worker moves on to its next task without
             # waiting out the owner's bookkeeping round trip (send
             # failures still raise; a dead owner is the only loss case
@@ -2649,13 +2743,46 @@ class _Executor:
                 "cw_task_done", task_id=spec.task_id, results=results,
                 lease_id=lease_id, dynamic_children=dynamic_children,
                 worker_exiting=worker_exiting, nested_refs=nested_refs)
+            return False
         except Exception:  # noqa: BLE001
             logger.warning("owner %s unreachable for task result",
                            spec.owner_address)
+            return False
 
     def _report_error(self, spec: TaskSpec, err: Exception,
                       worker_exiting: bool = False) -> None:
+        try:
+            self._emit_error_postmortem(spec, err)
+        except Exception:  # noqa: BLE001 - diagnostics never block reports
+            pass
         blob = pickle.dumps(err)
         self._report_done(spec, [(ERROR, blob)] * max(spec.num_returns, 1)
                           if spec.num_returns else [],
                           worker_exiting=worker_exiting)
+
+    def _emit_error_postmortem(self, spec: TaskSpec,
+                               err: Exception) -> None:
+        """Task-failure bundle (the worker survives, so it captures its
+        own context): traceback + recent log records + span-ring tail,
+        one-way into the GCS's bounded postmortem ring — queryable via
+        util.state.postmortems() / `ray_tpu logs --postmortem`."""
+        cw = self.cw
+        k = int(Config.postmortem_span_tail)
+        bundle = {
+            "kind": "task_error",
+            "task_id": spec.task_id.hex(),
+            "task": spec.function_name,
+            "worker_id": cw.worker_id.hex(),
+            "node_id": cw.node_id_hex,
+            "actor_id": self.actor_id.hex() if self.actor_id else None,
+            "trace_id": spec.trace_id,
+            "reason": repr(err),
+            "traceback": getattr(err, "traceback_str", "") or "",
+            "ts": time.time(),
+            "log_tail": _log_plane.tail(int(Config.postmortem_log_lines)),
+            "span_tail": [list(r) for r in
+                          _spans.ring().snapshot_records()[-k:]],
+            "gauges": {"rss_bytes": _log_plane.read_rss_bytes()},
+        }
+        cw._pool.get(cw.gcs_address).send_oneway(
+            "postmortem_report", bundle=bundle)

@@ -182,6 +182,13 @@ class GcsServer:
         # cycle-at-insert deadlock detection; see _private/wait_graph.py.
         from ray_tpu._private.wait_graph import WaitGraph
         self.wait_graph = WaitGraph()
+        # Crash postmortems (debug plane): bounded ring of black-box
+        # bundles keyed by postmortem id (node managers report worker
+        # deaths, executors report task failures; see
+        # node_manager._capture_postmortem / log_plane.py).
+        self.postmortems: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        from ray_tpu._private.config import Config as _Config
+        self.POSTMORTEMS_MAX = max(1, _Config.postmortems_max)
         # Chaos plane (see _private/chaos.py): ordered rule list + the
         # cluster-wide fired-count aggregate, distributed over pubsub.
         self.chaos_rules: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
@@ -248,6 +255,12 @@ class GcsServer:
             # flight recorder: cluster-wide span-ring gather
             # (`ray_tpu timeline --spans`, dashboard /api/timeline?spans=1)
             "spans_collect": self.spans_collect,
+            # debug plane: attributed-log fan-out + crash postmortems
+            # (`ray_tpu logs`, dashboard /api/logs + /api/postmortems)
+            "logs_query": self.logs_query,
+            "postmortem_report": self.postmortem_report,
+            "postmortem_list": self.postmortem_list,
+            "postmortem_get": self.postmortem_get,
             # structured events (reference ReportEventService)
             "add_events": self.add_events,
             "list_events": self.list_events,
@@ -263,6 +276,7 @@ class GcsServer:
             "chaos_report_fired": self.chaos_report_fired,
             # pubsub (reference InternalPubSubGcsService)
             "subscribe": self.subscribe,
+            "unsubscribe": self.unsubscribe,
             "publish": self.publish,
             "ping": lambda: "pong",
         }, host=host, port=port)
@@ -698,6 +712,75 @@ class GcsServer:
             direct.append(snap)
         return spans_lib.dedupe_by_uid([own] + direct + via_nm)
 
+    # ---- debug plane: log fan-out + postmortems (log_plane.py) ----------
+
+    LOGS_COLLECT_TIMEOUT_S = 5.0
+
+    def logs_query(self, filters: Optional[Dict[str, Any]] = None,
+                   tail: int = 500,
+                   timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Cluster log query: ONE fan-out round over the same two-phase
+        gather the span/metrics planes use (node managers first — each
+        serves its whole node's tail index, filtered server-side — then
+        remaining pubsub subscribers, i.e. drivers), all under a single
+        overall deadline so an unreachable node bounds, not doubles,
+        the query's worst case. Returns ts-merged records trimmed to
+        `tail` plus the node ids that did not answer."""
+        from ray_tpu._private import spans as spans_lib
+        t = float(timeout) if timeout else self.LOGS_COLLECT_TIMEOUT_S
+        nm_replies, cw_replies, unreachable = \
+            spans_lib.gather_cluster_snapshots(
+                self, "nm_logs_snapshot", "cw_logs_snapshot",
+                timeout=t, grace_s=1.0,
+                call_kwargs={"filters": filters, "tail": tail})
+        records: List[Dict[str, Any]] = []
+        for _addr, reply, _t0, _t1 in nm_replies:
+            records.extend(reply.get("records", ()))
+        seen: set = set()
+        for _addr, snap, _t0, _t1 in cw_replies:
+            uid = snap.get("proc_uid")
+            if uid in seen:
+                continue
+            seen.add(uid)
+            records.extend(snap.get("records", ()))
+        records.sort(key=lambda r: (r.get("ts") or 0.0, r.get("seq", 0)))
+        if tail:
+            records = records[-int(tail):]
+        return {"records": records, "unreachable": unreachable}
+
+    def postmortem_report(self, bundle: Dict[str, Any]) -> str:
+        pm_id = bundle.get("postmortem_id") or f"pm-{os.urandom(6).hex()}"
+        bundle["postmortem_id"] = pm_id
+        with self._lock:
+            self.postmortems[pm_id] = bundle
+            while len(self.postmortems) > self.POSTMORTEMS_MAX:
+                self.postmortems.popitem(last=False)
+        self._emit("POSTMORTEM_CAPTURED",
+                   f"{bundle.get('kind', 'crash')} postmortem {pm_id}: "
+                   f"{str(bundle.get('reason', ''))[:200]}",
+                   severity="WARNING", postmortem_id=pm_id,
+                   node_id=bundle.get("node_id"),
+                   worker_id=bundle.get("worker_id"))
+        return pm_id
+
+    def postmortem_list(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Newest-last summaries (without the bulky tails — fetch one
+        by id for the full bundle)."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            bundles = list(self.postmortems.values())[-limit:]
+        return [{k: v for k, v in b.items()
+                 if k not in ("log_tail", "span_tail")}
+                | {"log_lines": len(b.get("log_tail") or ()),
+                   "span_records": len(b.get("span_tail") or ())}
+                for b in bundles]
+
+    def postmortem_get(self, postmortem_id: str
+                       ) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self.postmortems.get(postmortem_id)
+
     # ---- structured events (reference util/event.h sink) ----------------
 
     def add_events(self, events: List[Dict[str, Any]]) -> None:
@@ -997,6 +1080,19 @@ class GcsServer:
             subs = self.subscribers.setdefault(channel, [])
             if (tuple(address), token) not in subs:
                 subs.append((tuple(address), token))
+
+    def unsubscribe(self, channel: str, address: Tuple[str, int],
+                    token: str) -> None:
+        """Drop one (address, token) subscription (short-lived
+        subscribers — `ray_tpu logs --follow` — must not keep receiving
+        pushes forever; idempotent so RPC retries are safe)."""
+        with self._lock:
+            subs = self.subscribers.get(channel)
+            if subs is not None:
+                try:
+                    subs.remove((tuple(address), token))
+                except ValueError:
+                    pass
 
     def publish(self, channel: str, message: Any) -> None:
         with self._lock:

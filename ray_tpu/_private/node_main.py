@@ -58,7 +58,7 @@ def main(argv=None) -> int:
         object_store_capacity=args.object_store_memory)
 
     # Handshake line for cluster_utils / operators (single line, parseable).
-    print(json.dumps({
+    print(json.dumps({  # graftlint: disable=RT012
         "node_id": nm.node_id.hex(),
         "node_manager_address": f"{nm.address[0]}:{nm.address[1]}",
         "store_address": nm.store.address,

@@ -144,6 +144,9 @@ class NodeManager:
             host=host)
 
         self.workers: Dict[str, _WorkerHandle] = {}     # worker id hex -> handle
+        # worker id hex -> pre-kill flight data (span tail, rss) captured
+        # by daemon-initiated kill paths while the victim still answers
+        self._prekill_dumps: Dict[str, Dict[str, Any]] = {}
         self.idle: Dict[str, List[str]] = {}            # runtime env key -> ids
         self.pending: List[_PendingLease] = []
         self.leases: Dict[str, str] = {}                # lease id -> worker id hex
@@ -173,6 +176,7 @@ class NodeManager:
             "nm_list_workers": self.list_workers,
             "nm_spans_snapshot": self.spans_snapshot,
             "nm_metrics_snapshot": self.metrics_snapshot,
+            "nm_logs_snapshot": self.logs_snapshot,
             "nm_profile_worker": self.profile_worker,
             "nm_drain": self.drain,
         }, host=host)
@@ -332,6 +336,7 @@ class NodeManager:
         if not candidates:
             return
         avail, totals, nodes, labels = self._cluster_view()
+        dispatch_local = False
         for pl in candidates:
             strategy = pl.spec.scheduling_strategy
             if isinstance(strategy, NodeAffinitySchedulingStrategy) \
@@ -348,6 +353,11 @@ class NodeManager:
                          chosen and chosen[:12])
             if chosen is None or chosen == self.node_id.hex() \
                     or chosen not in nodes:
+                # locally feasible again (e.g. resources appeared via a
+                # path with no dispatch trigger of its own): grant it
+                # here rather than leaving the queue to wedge
+                if chosen == self.node_id.hex():
+                    dispatch_local = True
                 continue
             with self._lock:
                 if pl not in self.pending or pl.acquired is not None:
@@ -364,6 +374,8 @@ class NodeManager:
             except Exception:  # noqa: BLE001
                 with self._lock:
                     self.pending.append(pl)
+        if dispatch_local:
+            self._dispatch()
 
     def _cluster_view(self) -> Tuple[Dict[str, Dict[str, float]],
                                      Dict[str, Dict[str, float]],
@@ -576,6 +588,22 @@ class NodeManager:
             if running is not None and not handle.blocked:
                 # blocked workers already released their resources
                 self.available.add(self._effective_resources(running))
+        # consume pre-kill flight data unconditionally: a kill of an
+        # idle worker takes no postmortem, and leaving its entry (or
+        # sidecar dump) behind would leak per kill under a recurring
+        # chaos schedule
+        from ray_tpu._private import log_plane as _log_plane
+        prekill = self._prekill_dumps.pop(wid, None) or {}
+        if running is not None or handle.is_actor:
+            # a death that loses work gets a crash postmortem (idle
+            # pool churn — reaps, clean exits — stays silent); the
+            # bundle id rides the error the owner raises so the user
+            # can pull it (`ray_tpu logs --postmortem <id>`)
+            pm_id = self._capture_postmortem(handle, reason, prekill)
+            reason = f"{reason} [postmortem {pm_id}]"
+        else:
+            _log_plane.consume_flight_dump(
+                os.path.join(self.session_dir, "logs"), wid)
         if handle.is_actor and handle.actor_id_hex:
             try:
                 self._gcs.call("report_actor_death",
@@ -925,7 +953,13 @@ class NodeManager:
             self.resources_total.add(ResourceSet(add))
             self.available.add(ResourceSet(add))
             self._committed[(pg_id_hex, bundle_index)] = (resources, add)
-            return True
+        # a lease that raced ahead of this commit (pg.ready() is
+        # submitted the moment placement_group() returns) sits queued
+        # un-acquired: its bundle resources exist only NOW, and on an
+        # otherwise-idle node no other event re-runs dispatch — without
+        # this kick it wedges until the owner's get() times out
+        self._dispatch()
+        return True
 
     def return_bundle(self, pg_id_hex: str, bundle_index: int) -> None:
         with self._lock:
@@ -974,6 +1008,9 @@ class NodeManager:
         logger.warning("chaos: killing worker %s (%s)",
                        victim.worker_id.hex()[:12],
                        actor_class or "any")
+        # the victim still answers: grab its span tail for the
+        # postmortem before the SIGKILL destroys it
+        self._capture_prekill(victim)
         try:
             victim.proc.kill()
         except OSError:
@@ -1021,6 +1058,7 @@ class NodeManager:
         logger.warning(
             "memory pressure: killing worker %s running %s",
             victim.worker_id.hex()[:12], fn)
+        self._capture_prekill(victim)
         try:
             victim.proc.kill()
         except OSError:
@@ -1174,6 +1212,121 @@ class NodeManager:
         # ones: a worker the NM missed may answer the GCS directly)
         return {"snapshots": snapshots,
                 "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
+
+    def logs_snapshot(self, filters: Optional[Dict[str, Any]] = None,
+                      tail: int = 500) -> Dict[str, Any]:
+        """Debug-plane gather for this node: a fresh scan + the filtered
+        tail index of every worker log file, one RPC hop below the GCS
+        `logs_query` fan-out. Filtering runs HERE so the fan-out ships
+        matching records, not every node's whole tail. worker_addrs lets
+        the GCS skip its direct-subscriber pull for workers this node's
+        files already cover."""
+        try:
+            self.log_monitor.scan_now()
+        except Exception:  # noqa: BLE001 - index may lag one poll tick
+            pass
+        records = self.log_monitor.query(filters, tail=tail)
+        with self._lock:
+            worker_addrs = [h.address for h in self.workers.values()
+                            if h.registered and h.address is not None]
+        return {"node_id": self.node_id.hex(),
+                "records": records,
+                "worker_addrs": [list(a) for a in worker_addrs]}
+
+    # ---- crash postmortems (debug plane; see _private/log_plane.py) -----
+
+    def _capture_prekill(self, handle: _WorkerHandle) -> None:
+        """Daemon-initiated kill paths call this while the victim still
+        answers RPCs: pull its span-ring tail + rss so the postmortem
+        can include the flight data a SIGKILL would otherwise destroy."""
+        out: Dict[str, Any] = {}
+        try:
+            from ray_tpu._private import spans as spans_lib
+            got = spans_lib.pull_snapshot(
+                handle.address, "cw_spans_snapshot", timeout=1.0)
+            if got is not None:
+                k = Config.postmortem_span_tail
+                out["span_tail"] = [list(r) for r in
+                                    got[0].get("spans", [])[-k:]]
+        except Exception:  # noqa: BLE001 - victim already unresponsive
+            pass
+        try:
+            from ray_tpu._private.log_plane import read_rss_bytes
+            if handle.proc is not None:
+                out["rss_bytes"] = read_rss_bytes(handle.proc.pid)
+        except Exception:  # noqa: BLE001
+            pass
+        self._prekill_dumps[handle.worker_id.hex()] = out
+
+    def _capture_postmortem(self, handle: _WorkerHandle, reason: str,
+                            prekill: Optional[Dict[str, Any]] = None
+                            ) -> str:
+        """Bundle a dead worker's black box: last log lines (after a
+        final synchronous scan so lines written just before death are
+        indexed), span-ring tail (from the daemon's pre-kill pull or
+        the worker's own flight dump), and node gauges. Ships to the
+        GCS's bounded postmortem ring off-thread on a dedicated client
+        (the shared GCS client serializes calls; a slow control plane
+        must not stall worker-death handling)."""
+        from ray_tpu._private import log_plane
+        pm_id = f"pm-{uuid.uuid4().hex[:12]}"
+        wid = handle.worker_id.hex()
+        prekill = prekill or self._prekill_dumps.pop(wid, None) or {}
+        log_dir = os.path.join(self.session_dir, "logs")
+        flight = log_plane.consume_flight_dump(log_dir, wid) or {}
+        log_tail: List[Dict[str, Any]] = []
+        try:
+            self.log_monitor.scan_now()
+            log_tail = self.log_monitor.tail_records(
+                f"worker-{wid[:12]}", Config.postmortem_log_lines)
+        except Exception:  # noqa: BLE001
+            pass
+        if not log_tail:
+            log_tail = flight.get("log_tail") or []
+        stats: Dict[str, Any] = {}
+        try:
+            stats = self.store.stats()
+        except Exception:  # noqa: BLE001
+            pass
+        with self._lock:
+            num_workers = len(self.workers)
+        bundle = {
+            "postmortem_id": pm_id,
+            "kind": "worker_death",
+            "worker_id": wid,
+            "node_id": self.node_id.hex(),
+            "is_actor": handle.is_actor,
+            "actor_id": handle.actor_id_hex,
+            "task": (handle.current_task.function_name
+                     if handle.current_task is not None else None),
+            "reason": reason,
+            "flight_reason": flight.get("reason"),
+            "ts": time.time(),
+            "log_tail": log_tail,
+            "span_tail": (prekill.get("span_tail")
+                          or flight.get("span_tail") or []),
+            "gauges": {
+                "rss_bytes": (prekill.get("rss_bytes")
+                              or flight.get("rss_bytes")),
+                "store_used_bytes": stats.get("used"),
+                "store_capacity_bytes": stats.get("capacity"),
+                "store_pinned_bytes": stats.get("pinned_bytes"),
+                "num_workers": num_workers,
+            },
+        }
+
+        def _send() -> None:
+            client = rpc_lib.RpcClient(self.gcs_address, timeout=10)
+            try:
+                client.call("postmortem_report", bundle=bundle)
+            except Exception:  # noqa: BLE001 - GCS away; bundle lost
+                logger.debug("postmortem report failed", exc_info=True)
+            finally:
+                client.close()
+
+        threading.Thread(target=_send, daemon=True,
+                         name="postmortem-report").start()
+        return pm_id
 
     def list_workers(self) -> List[Dict[str, Any]]:
         """Worker-level metadata for the state API (`ray list workers`)."""

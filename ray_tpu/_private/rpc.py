@@ -219,6 +219,7 @@ _IDEMPOTENT_PREFIXES = ("get_", "list_", "kv_get", "kv_keys", "nm_get",
                         "metrics_")
 _IDEMPOTENT_METHODS = frozenset({
     "ping", "nm_ping", "report_resources", "register_node", "subscribe",
+    "unsubscribe",
     "next_job_id", "cluster_resources", "available_resources",
     # object-store reads (store_wait is excluded: pin=True takes a
     # lease, and a blind resend would double-count it)
@@ -226,6 +227,9 @@ _IDEMPOTENT_METHODS = frozenset({
     # metrics-plane snapshot reads (registry reads; samplers only
     # overwrite gauges, so a retried snapshot is harmless)
     "cw_metrics_snapshot", "nm_metrics_snapshot",
+    # debug-plane reads (tail-index/postmortem-ring queries)
+    "logs_query", "nm_logs_snapshot", "cw_logs_snapshot",
+    "postmortem_list", "postmortem_get",
 })
 
 

@@ -62,8 +62,30 @@ _write_tick = itertools.count()
 _read_tick = itertools.count()
 
 
+_mp_main_registered: set = set()
+
+
+def _ensure_mp_main_by_value() -> None:
+    """multiprocessing-spawn drivers load the user script as
+    `__mp_main__` (aliased to `__main__` only inside spawn CHILDREN):
+    cloudpickle special-cases just `__main__` as unimportable, so
+    without this registration it pickles `__mp_main__` functions BY
+    REFERENCE — and workers, whose `__main__` is worker_main and which
+    have no `__mp_main__` at all, cannot resolve the reference."""
+    import sys
+    mod = sys.modules.get("__mp_main__")
+    if mod is None or id(mod) in _mp_main_registered:
+        return
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+    except Exception:  # noqa: BLE001 - odd module shape; fall through
+        return
+    _mp_main_registered.add(id(mod))
+
+
 def dumps_function(fn: Any) -> bytes:
     """Serialize a function/class by value (for export to the GCS fn table)."""
+    _ensure_mp_main_by_value()
     return cloudpickle.dumps(fn)
 
 
@@ -89,11 +111,17 @@ def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
     try:
         blob = pickle.dumps(value, protocol=5,
                             buffer_callback=buffers.append)
-        if b"__main__" not in blob:
+        # __mp_main__ is __main__'s alias under multiprocessing-spawn
+        # drivers (and NOT a substring of "__main__", so it needs its
+        # own check): a by-reference __mp_main__ function deserializes
+        # only in processes spawned from the same parent — workers
+        # aren't, so such blobs must route through cloudpickle too.
+        if b"__main__" not in blob and b"__mp_main__" not in blob:
             return b"P" + blob, buffers
     except Exception:  # noqa: BLE001 — unpicklable by plain pickle
         pass
     buffers = []
+    _ensure_mp_main_by_value()
     f = io.BytesIO()
     cloudpickle.CloudPickler(
         f, protocol=5, buffer_callback=buffers.append).dump(value)

@@ -288,17 +288,19 @@ def instant(name: str, /, **attrs: Any) -> None:
 # ---------------------------------------------------------------------
 
 
-def pull_snapshot(addr, method: str, timeout: float):
+def pull_snapshot(addr, method: str, timeout: float,
+                  call_kwargs: Optional[Dict[str, Any]] = None):
     """One snapshot RPC with the wall-clock stamps every collector's
     offset estimate needs (peer_wall - our_wall, from the RPC midpoint
     or entry point — the caller picks the reference). Returns
     (reply, t0_wall, t1_wall) or None when the peer is unreachable —
-    dead processes just drop out of the trace."""
+    dead processes just drop out of the trace. `call_kwargs` rides the
+    RPC verbatim (the log plane pushes its filters server-side)."""
     from ray_tpu._private import rpc as rpc_lib
     try:
         client = rpc_lib.RpcClient(tuple(addr), timeout=timeout)
         t0 = _wall_time()
-        reply = client.call(method)
+        reply = client.call(method, **(call_kwargs or {}))
         t1 = _wall_time()
         client.close()
     except Exception:  # noqa: BLE001 - peer gone mid-collect
@@ -307,7 +309,9 @@ def pull_snapshot(addr, method: str, timeout: float):
 
 
 def pull_snapshots(addrs, method: str, timeout: float,
-                   grace_s: float = 1.0) -> List[tuple]:
+                   grace_s: float = 1.0,
+                   call_kwargs: Optional[Dict[str, Any]] = None
+                   ) -> List[tuple]:
     """pull_snapshot fanned out to many peers on daemon threads under
     one shared deadline (per-RPC timeout + grace for the joins).
     Returns [(addr, reply, t0_wall, t1_wall)] for the peers that
@@ -319,7 +323,8 @@ def pull_snapshots(addrs, method: str, timeout: float,
     out: List[tuple] = []
 
     def _pull(addr) -> None:
-        got = pull_snapshot(addr, method, timeout=timeout)
+        got = pull_snapshot(addr, method, timeout=timeout,
+                            call_kwargs=call_kwargs)
         if got is None:
             return
         reply, t0, t1 = got
@@ -337,7 +342,8 @@ def pull_snapshots(addrs, method: str, timeout: float,
 
 
 def gather_cluster_snapshots(gcs, nm_method: str, cw_method: str,
-                             timeout: float, grace_s: float = 1.0):
+                             timeout: float, grace_s: float = 1.0,
+                             call_kwargs: Optional[Dict[str, Any]] = None):
     """The two-phase cluster gather both telemetry planes share:
     enumerate alive node managers + pubsub subscribers under the GCS
     lock, pull `nm_method` from every NM (each ships its own snapshot
@@ -365,7 +371,8 @@ def gather_cluster_snapshots(gcs, nm_method: str, cw_method: str,
     sub_addrs -= {a for _nid, a in nm_targets}  # NMs answer nm_*, not cw_*
 
     nm_replies = pull_snapshots([a for _nid, a in nm_targets], nm_method,
-                                timeout=timeout, grace_s=grace_s)
+                                timeout=timeout, grace_s=grace_s,
+                                call_kwargs=call_kwargs)
     answered = {addr for addr, _r, _t0, _t1 in nm_replies}
     unreachable = [nid for nid, a in nm_targets if a not in answered]
     covered: set = set()
@@ -377,7 +384,8 @@ def gather_cluster_snapshots(gcs, nm_method: str, cw_method: str,
     t2 = min(timeout, remaining)
     cw_replies = pull_snapshots(sorted(sub_addrs - covered), cw_method,
                                 timeout=t2,
-                                grace_s=min(grace_s, remaining - t2))
+                                grace_s=min(grace_s, remaining - t2),
+                                call_kwargs=call_kwargs)
     return nm_replies, cw_replies, unreachable
 
 

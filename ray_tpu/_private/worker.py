@@ -107,15 +107,59 @@ class HeadNode:
         shutil.rmtree(self.session_dir, ignore_errors=True)
 
 
+# actor id prefix -> display name (resolved once per actor via the GCS)
+_actor_name_cache: Dict[str, str] = {}
+
+
+def _actor_label(actor_prefix: str) -> str:
+    label = _actor_name_cache.get(actor_prefix)
+    if label is not None:
+        return label
+    label = f"actor-{actor_prefix[:8]}"
+    try:
+        w = global_worker_or_none()
+        if w is not None:
+            for info in w.core_worker._gcs.call("list_actors"):
+                if info.actor_id.hex().startswith(actor_prefix):
+                    label = info.name or \
+                        f"{info.class_name}-{actor_prefix[:8]}"
+                    break
+    except Exception:  # noqa: BLE001 - GCS away; keep the id label
+        pass
+    _actor_name_cache[actor_prefix] = label
+    return label
+
+
 def _print_worker_logs(msg) -> None:
     """reference worker.py:1823 print_to_stdstream — driver-side sink
     for the worker_logs pubsub channel. stderr, so drivers that emit
-    machine-readable stdout (bench JSON) stay parseable."""
+    machine-readable stdout (bench JSON) stay parseable. Attributed
+    records print with an (actor_name, node) prefix; the log monitor's
+    per-source flood control reports shed lines via `dropped` and the
+    notice keeps the count honest (`ray_tpu logs` still has them —
+    only the live stream sheds)."""
     import sys
     try:
-        prefix = f"({msg['worker']}, node={msg['node_id'][:8]})"
-        for line in msg["lines"]:
-            print(f"{prefix} {line}", file=sys.stderr)
+        node = msg["node_id"][:8]
+        records = msg.get("records")
+        if records:
+            for rec in records:
+                src = (_actor_label(rec["actor_id"]) if rec.get("actor_id")
+                       else msg["worker"])
+                # the driver's terminal IS the debug plane's sink here
+                print(f"({src}, node={node}) "  # graftlint: disable=RT012
+                      f"{rec.get('msg', '')}", file=sys.stderr)
+        else:
+            prefix = f"({msg['worker']}, node={node})"
+            for line in msg["lines"]:
+                print(f"{prefix} {line}",  # graftlint: disable=RT012
+                      file=sys.stderr)
+        if msg.get("dropped"):
+            # the shed-line notice is itself terminal output
+            print(f"({msg['worker']}, node={node}) "  # graftlint: disable=RT012
+                  f"... flood control dropped {msg['dropped']} lines "
+                  f"from this stream ({msg.get('dropped_total', 0)} "
+                  f"total; `ray_tpu logs` has them)", file=sys.stderr)
     except Exception:  # noqa: BLE001
         pass
 

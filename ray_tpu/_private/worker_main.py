@@ -7,7 +7,6 @@ serves task pushes until killed.
 
 from __future__ import annotations
 
-import logging
 import os
 import signal
 import sys
@@ -15,19 +14,19 @@ import threading
 
 
 def main() -> None:
-    # stdout/stderr land in the per-worker log file (a pipe, so python
-    # would block-buffer): line-buffer so the log monitor can tail
-    # prints as they happen
-    try:
-        sys.stdout.reconfigure(line_buffering=True)
-        sys.stderr.reconfigure(line_buffering=True)
-    except Exception:  # noqa: BLE001
-        pass
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s worker %(name)s: %(message)s")
+    # stdout/stderr land in the per-worker log file: route every line
+    # (prints, logging, native chatter) through the debug plane's
+    # attribution stamper so the log monitor can index it by
+    # task/actor/trace id (see _private/log_plane.py); the wrapper
+    # flushes per complete line so tails stay live
+    from ray_tpu._private import log_plane
+    log_plane.init_worker_io("worker")
     import faulthandler
-    faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # the raw fd, not the stamping wrapper: faulthandler runs in a
+    # signal context and needs a real file (its dump lines parse as
+    # RAW records)
+    faulthandler.register(signal.SIGUSR1, file=log_plane.raw_stderr(),
+                          all_threads=True)
 
     def parse_addr(s: str):
         host, port = s.rsplit(":", 1)

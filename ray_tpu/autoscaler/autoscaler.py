@@ -212,17 +212,22 @@ class GKETPUNodeProvider(NodeProvider):
             cluster, zone, "ct5p-hightpu-4t", self._topology_for)
         self._nodes: Dict[str, ProviderNode] = {}
 
+    # TensorCores per chip by TPU generation: the accelerator-type
+    # suffix counts CORES for v2-v5p (so "v5p-16" is 8 chips) but CHIPS
+    # for the single-core-per-chip generations (v5e/v5litepod, v6e).
+    # Sizing pools off the raw suffix doubled every v5p node pool and
+    # its --tpu-topology (ADVICE r5).
+    CORES_PER_CHIP = {"v2": 2, "v3": 2, "v4": 2, "v5p": 2,
+                      "v5e": 1, "v5litepod": 1, "v6e": 1}
+
     @property
     def slice_chips(self) -> int:
-        # accelerator_type "v5p-16" -> 16 chip-cores -> 8 chips... the
-        # accelerator manager's convention (accelerators/tpu.py): the
-        # suffix is the core count, chips = cores / 2 for v5p; for the
-        # provider we treat the suffix as the CHIP count directly, as
-        # the fake-chip ladder does.
         try:
-            return int(self.accelerator_type.rsplit("-", 1)[1])
+            gen, suffix = self.accelerator_type.rsplit("-", 1)
+            n = int(suffix)
         except (IndexError, ValueError):
             return self.CHIPS_PER_HOST
+        return max(1, n // self.CORES_PER_CHIP.get(gen.lower(), 1))
 
     def _host_resources(self, pool: str) -> List[Dict[str, float]]:
         n_hosts = max(1, self.slice_chips // self.CHIPS_PER_HOST)

@@ -237,7 +237,8 @@ def serve_forever(gcs_address: str, host: str = "127.0.0.1",
                   port: int = 10001) -> None:
     import time
     proxy = ClientProxyServer(gcs_address, host=host, port=port)
-    print(f"client proxy listening on "
+    # operator handshake on stdout: scripts scrape the ray:// address
+    print(f"client proxy listening on "  # graftlint: disable=RT012
           f"ray://{proxy.address[0]}:{proxy.address[1]}", flush=True)
     try:
         while True:

@@ -20,6 +20,11 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
     GET /api/metrics  — the same harvest as JSON: per-proc snapshots +
                         merged series (?history=1 → the GCS's in-memory
                         time-series ring instead)
+    GET /api/logs     — attributed cluster logs (one logs_query fan-out;
+                        filters: node_id/worker_id/actor/task_id/
+                        trace_id/level/match/tail/timeout)
+    GET /api/postmortems — crash-postmortem summaries (?id=pm-... for
+                        one full bundle)
 """
 
 from __future__ import annotations
@@ -253,6 +258,25 @@ class DashboardHead:
         if route == "/api/metrics/config":
             from ray_tpu.dashboard.metrics import write_metrics_configs
             return write_metrics_configs()
+        if route == "/api/logs":
+            # debug plane: one logs_query fan-out with server-side
+            # filters (mirrors `ray_tpu logs`; see _private/log_plane.py)
+            return s.logs(
+                node_id=params.get("node_id"),
+                worker_id=params.get("worker_id"),
+                actor=params.get("actor"),
+                task_id=params.get("task_id"),
+                trace_id=params.get("trace_id"),
+                level=params.get("level"),
+                match=params.get("match"),
+                tail=int(params.get("tail", 500)),
+                timeout=(float(params["timeout"])
+                         if "timeout" in params else None))
+        if route == "/api/postmortems":
+            # ?id=pm-... returns one full bundle; otherwise summaries
+            if "id" in params:
+                return s.get_postmortem(params["id"])
+            return s.postmortems(limit=int(params.get("limit", 50)))
         if route == "/api/wait_graph":
             # live actor waits-for edges + deadlocks-detected counter
             # (runtime counterpart of graftlint RT001)

@@ -1,6 +1,6 @@
-"""graftlint rule set: 11 framework-aware checks.
+"""graftlint rule set: 12 framework-aware checks.
 
-Each rule has a stable id (RT001..RT011), a one-line rationale, and a
+Each rule has a stable id (RT001..RT012), a one-line rationale, and a
 `check(ctx)` generator yielding Findings. Rules are deliberately
 conservative: a finding should be actionable, and intentional
 exceptions are silenced in-place with `# graftlint: disable=RTxxx`
@@ -10,6 +10,8 @@ comments that double as documentation.
 from __future__ import annotations
 
 import ast
+import os
+import re
 from typing import Iterator, List, Optional, Set
 
 from ray_tpu.lint.engine import Finding, ModuleContext
@@ -688,11 +690,49 @@ class MetricNameConvention(Rule):
                             f"the id in logs/events instead")
 
 
+class BarePrintInFramework(Rule):
+    id = "RT012"
+    name = "bare-print-in-framework"
+    rationale = ("framework diagnostics must go through `logging` so "
+                 "they enter the log plane (attribution-stamped, "
+                 "tail-indexed, flood-controlled — see "
+                 "_private/log_plane.py); a bare print() line is "
+                 "unstamped and invisible to `ray_tpu logs` filters")
+
+    # Paths whose whole PURPOSE is writing to a terminal: tests, dev
+    # tools, examples, CLI entry points. Everything else in the
+    # framework tree is daemon/library code whose output lands in (or
+    # should land in) worker log files.
+    _EXEMPT_DIR_PARTS = frozenset(
+        {"tests", "test", "tools", "examples", "benchmarks", "scripts"})
+
+    def _exempt(self, path: str) -> bool:
+        parts = [p for p in re.split(r"[\\/]", path) if p]
+        if set(parts) & self._EXEMPT_DIR_PARTS:
+            return True
+        base = os.path.basename(path)
+        return base == "__main__.py" or base.startswith("test_")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._exempt(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and ctx.call_name(node) == "print":
+                yield self.finding(
+                    ctx, node,
+                    "bare print() in framework code: route diagnostics "
+                    "through `logging` so the line enters the log "
+                    "plane with task/actor/trace attribution (stdout "
+                    "sinks — CLIs, machine-readable handshakes — "
+                    "suppress with `# graftlint: disable=RT012`)")
+
+
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
     ClosureMutationInJit(), ActorCallWithoutRemote(), LeakedObjectRef(),
     DictOrderPytree(), SwallowedException(), StoreViewCopy(),
-    WallClockDuration(), MetricNameConvention(),
+    WallClockDuration(), MetricNameConvention(), BarePrintInFramework(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -324,6 +324,79 @@ def cmd_chaos(args) -> int:
     raise SystemExit(f"unknown chaos command {args.chaos_cmd!r}")
 
 
+def _fmt_log_record(rec: Dict[str, Any]) -> str:
+    ids = " ".join(x for x in (
+        f"n:{rec['node_id'][:8]}" if rec.get("node_id") else "",
+        f"w:{rec['worker_id'][:8]}" if rec.get("worker_id") else "",
+        f"t:{rec['task_id'][:8]}" if rec.get("task_id") else "",
+        f"a:{rec['actor_id'][:8]}" if rec.get("actor_id") else "",
+        f"tr:{rec['trace_id']}" if rec.get("trace_id") else "",
+    ) if x)
+    return f"[{ids}] {rec.get('level', '?')} {rec.get('msg', '')}"
+
+
+def cmd_logs(args) -> int:
+    """Debug plane (see README "Debug plane"): query the cluster's
+    attributed log tails (one GCS fan-out round, server-side filters),
+    follow the live stream, or fetch crash postmortems."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    if args.postmortem:
+        bundle = s.get_postmortem(args.postmortem)
+        if bundle is None:
+            raise SystemExit(f"no postmortem {args.postmortem!r} "
+                             f"(aged out of the ring?)")
+        if args.format == "json":
+            print(json.dumps(bundle, default=str))
+            return 0
+        for k in ("postmortem_id", "kind", "worker_id", "node_id",
+                  "actor_id", "task", "reason", "gauges"):
+            print(f"{k}: {bundle.get(k)}")
+        print(f"-- last {len(bundle.get('log_tail') or ())} log lines:")
+        for rec in bundle.get("log_tail") or ():
+            print(_fmt_log_record(rec))
+        print(f"-- span-ring tail "
+              f"({len(bundle.get('span_tail') or ())} records):")
+        for sp in (bundle.get("span_tail") or ())[-40:]:
+            print(f"  {sp}")
+        return 0
+    if args.postmortems:
+        rows = s.postmortems()
+        if args.format == "json":
+            print(json.dumps(rows, default=str))
+            return 0
+        _print_table(
+            [{**r, "worker_id": (r.get("worker_id") or "")[:12],
+              "node_id": (r.get("node_id") or "")[:12],
+              "reason": str(r.get("reason", ""))[:60]} for r in rows],
+            ["postmortem_id", "kind", "worker_id", "node_id", "task",
+             "reason", "log_lines", "span_records"])
+        return 0
+    kwargs = dict(node_id=args.node_id, worker_id=args.worker_id,
+                  actor=args.actor, task_id=args.task_id,
+                  trace_id=args.trace_id, level=args.level,
+                  match=args.match)
+    if args.follow:
+        try:
+            for rec in s.follow_logs(**kwargs):
+                print(_fmt_log_record(rec), flush=True)
+        except KeyboardInterrupt:
+            pass
+        return 0
+    out = s.logs(tail=args.tail, timeout=args.timeout, **kwargs)
+    if args.format == "json":
+        print(json.dumps(out, default=str))
+        return 0
+    for rec in out["records"]:
+        print(_fmt_log_record(rec))
+    if out.get("unreachable"):
+        print(f"(warning: {len(out['unreachable'])} node(s) unreachable "
+              f"within the deadline: "
+              f"{[n[:12] for n in out['unreachable']]})",
+              file=sys.stderr)
+    return 0
+
+
 def cmd_metrics(args) -> int:
     """Cluster metrics plane (see README "Cluster metrics"): dump the
     merged registry (text exposition or JSON harvest), or print the
@@ -471,6 +544,33 @@ def main(argv=None) -> int:
     p.add_argument("--select", default=None, help="rule ids to run")
     p.add_argument("--ignore", default=None, help="rule ids to skip")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("logs", help="query/follow attributed cluster "
+                                    "logs and crash postmortems "
+                                    "(debug plane; see README)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--node-id", default=None, help="node id prefix")
+    p.add_argument("--worker-id", default=None, help="worker id prefix")
+    p.add_argument("--actor", default=None,
+                   help="actor NAME or actor id prefix")
+    p.add_argument("--task-id", default=None, help="task id prefix")
+    p.add_argument("--trace-id", default=None,
+                   help="util.tracing trace id (lines stamp it)")
+    p.add_argument("--level", default=None,
+                   help="OUT|ERR|INFO|WARNING|ERROR|RAW")
+    p.add_argument("--match", default=None, help="regex over messages")
+    p.add_argument("--tail", type=int, default=500,
+                   help="last N records across the cluster")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="overall fan-out deadline (seconds)")
+    p.add_argument("--follow", action="store_true",
+                   help="stream new records (pubsub) until ^C")
+    p.add_argument("--postmortem", default=None,
+                   help="print one crash bundle by id (pm-...)")
+    p.add_argument("--postmortems", action="store_true",
+                   help="list recent crash postmortems")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("metrics", help="cluster metrics plane: dump the "
                                        "merged registry / watchdog alerts")

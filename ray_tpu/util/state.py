@@ -168,6 +168,118 @@ def spans_snapshots() -> List[Dict[str, Any]]:
     return _gcs().call("spans_collect")
 
 
+def _resolve_actor_filter(actor: Optional[str]) -> Optional[str]:
+    """`ray_tpu logs --actor` accepts a name or an id (prefix): names
+    resolve through the GCS actor directory (newest matching actor
+    wins — restarts keep the id, re-creations get the newest)."""
+    if not actor:
+        return None
+    for a in reversed(list_actors()):
+        if a["name"] == actor:
+            return a["actor_id"]
+    return actor  # treat as an id (prefix)
+
+
+def logs(node_id: Optional[str] = None, worker_id: Optional[str] = None,
+         actor: Optional[str] = None, actor_id: Optional[str] = None,
+         task_id: Optional[str] = None, trace_id: Optional[str] = None,
+         level: Optional[str] = None, match: Optional[str] = None,
+         tail: int = 500, timeout: Optional[float] = None
+         ) -> Dict[str, Any]:
+    """Cluster log query (`ray_tpu logs`, dashboard /api/logs): ONE GCS
+    fan-out round — node managers serve their filtered tail indexes,
+    drivers their in-process rings — under a single overall deadline.
+    Filters run server-side; `actor` takes a name or id. Returns
+    {"records": [...], "unreachable": [node ids]}; each record carries
+    node/worker/task/actor ids + trace id + level (log_plane.py)."""
+    filters: Dict[str, Any] = {}
+    if node_id:
+        filters["node_id"] = node_id
+    if worker_id:
+        filters["worker_id"] = worker_id
+    resolved = _resolve_actor_filter(actor) or actor_id
+    if resolved:
+        filters["actor_id"] = resolved
+    if task_id:
+        filters["task_id"] = task_id
+    if trace_id:
+        filters["trace_id"] = trace_id
+    if level:
+        filters["level"] = level
+    if match:
+        filters["match"] = match
+    return _gcs().call("logs_query", filters=filters or None, tail=tail,
+                       timeout=timeout)
+
+
+def follow_logs(node_id: Optional[str] = None,
+                worker_id: Optional[str] = None,
+                actor: Optional[str] = None,
+                actor_id: Optional[str] = None,
+                task_id: Optional[str] = None,
+                trace_id: Optional[str] = None,
+                level: Optional[str] = None, match: Optional[str] = None,
+                duration: Optional[float] = None,
+                poll_timeout: float = 0.5):
+    """Generator over NEW log records as they stream off the cluster's
+    `worker_logs` pubsub channel (the same feed `log_to_driver`
+    prints), filtered client-side with the query plane's filter set.
+    Runs until `duration` elapses (forever when None — the CLI's
+    --follow mode, ended by ^C)."""
+    import queue as _queue
+    import time as _time
+
+    from ray_tpu._private import log_plane
+    filters: Dict[str, Any] = {}
+    for k, v in (("node_id", node_id), ("worker_id", worker_id),
+                 ("actor_id", _resolve_actor_filter(actor) or actor_id),
+                 ("task_id", task_id), ("trace_id", trace_id),
+                 ("level", level), ("match", match)):
+        if v:
+            filters[k] = v
+    q: "_queue.Queue" = _queue.Queue()
+    live = [True]
+
+    def _on_msg(msg):
+        if live[0]:
+            q.put(msg)
+
+    cw = worker_mod.global_worker().core_worker
+    token = cw.subscribe("worker_logs", _on_msg)
+    deadline = None if duration is None else _time.monotonic() + duration
+    try:
+        while deadline is None or _time.monotonic() < deadline:
+            try:
+                msg = q.get(timeout=poll_timeout)
+            except _queue.Empty:
+                continue
+            for rec in log_plane.filter_records(
+                    msg.get("records") or (), filters):
+                yield rec
+    finally:
+        live[0] = False
+        # tear the subscription down end to end (callback + the GCS
+        # entry) so repeated follows don't multiply the publish fan-out
+        try:
+            cw.unsubscribe("worker_logs", token)
+        except Exception:  # noqa: BLE001 - cluster gone mid-follow
+            pass
+
+
+def postmortems(limit: int = 50) -> List[Dict[str, Any]]:
+    """Crash-postmortem summaries from the GCS's bounded ring, newest
+    last (worker/actor deaths bundled by the node manager, task
+    failures by the executor). Fetch one bundle — last log lines, span
+    tail, gauges — with get_postmortem(id)."""
+    return _gcs().call("postmortem_list", limit=limit)
+
+
+def get_postmortem(postmortem_id: str) -> Optional[Dict[str, Any]]:
+    """One full postmortem bundle (log_tail + span_tail included), or
+    None if it aged out of the ring."""
+    return _gcs().call("postmortem_get", postmortem_id=postmortem_id)
+
+
 def chaos_rules() -> Dict[str, Any]:
     """Installed chaos rules + cluster-wide fired counts (the runtime
     view behind `ray_tpu chaos list` and the dashboard /api/chaos)."""

@@ -215,22 +215,22 @@ class TestGKETPUSliceScaleUp:
                                                    GKETPUNodeProvider)
         from ray_tpu.autoscaler.v2 import AutoscalerV2
         backend = FakeSliceBackend()
-        provider = GKETPUNodeProvider(accelerator_type="v5p-16",
+        provider = GKETPUNodeProvider(accelerator_type="v5p-32",
                                       backend=backend)
         reader = self._FakeReader()
         scaler = AutoscalerV2(
             reader, provider,
-            [NodeType("tpu-v5p-16-slice",
-                      {"TPU-v5p-16-head": 1, "TPU": 16})],
+            [NodeType("tpu-v5p-32-slice",
+                      {"TPU-v5p-32-head": 1, "TPU": 16})],
             max_nodes=2, idle_timeout_s=60.0)
         return scaler, provider, backend, reader
 
     def test_head_demand_materializes_four_host_slice(self):
         from ray_tpu.autoscaler.v2 import ALLOCATED, RAY_RUNNING
         scaler, provider, backend, reader = self._slice_scaler()
-        # the demand a PG for a v5p-16 gang produces: one slice-head
+        # the demand a PG for a v5p-32 gang produces: one slice-head
         # bundle (reference tpu.py pod-slice head resource)
-        reader.status.pending_demands = [{"TPU-v5p-16-head": 1}]
+        reader.status.pending_demands = [{"TPU-v5p-32-head": 1}]
         scaler.run_once()
         insts = list(scaler.im.instances.values())
         assert len(insts) == 1 and insts[0].status == ALLOCATED
@@ -241,7 +241,7 @@ class TestGKETPUSliceScaleUp:
         hosts = backend.hosts_by_pool[pools[0]]
         assert len(hosts) == 4
         heads = [h for h in hosts
-                 if "TPU-v5p-16-head" in h["resources"]]
+                 if "TPU-v5p-32-head" in h["resources"]]
         assert len(heads) == 1  # exactly one jax-coordinator host
         for h in hosts:
             assert h["resources"]["TPU"] == 4.0
@@ -256,7 +256,7 @@ class TestGKETPUSliceScaleUp:
 
     def test_booting_slice_absorbs_demand_no_double_launch(self):
         scaler, provider, backend, reader = self._slice_scaler()
-        reader.status.pending_demands = [{"TPU-v5p-16-head": 1}]
+        reader.status.pending_demands = [{"TPU-v5p-32-head": 1}]
         scaler.run_once()
         assert len(backend.hosts_by_pool) == 1
         # demand still visible while the slice boots: must NOT launch
@@ -266,9 +266,39 @@ class TestGKETPUSliceScaleUp:
 
     def test_terminate_deletes_the_pool(self):
         scaler, provider, backend, reader = self._slice_scaler()
-        reader.status.pending_demands = [{"TPU-v5p-16-head": 1}]
+        reader.status.pending_demands = [{"TPU-v5p-32-head": 1}]
         scaler.run_once()
         inst = next(iter(scaler.im.instances.values()))
         scaler.im.terminate(inst)
         assert backend.hosts_by_pool == {}
         assert provider.non_terminated_nodes() == []
+
+
+def test_slice_chips_generation_table():
+    """The accelerator-type suffix counts TensorCores for v2-v5p (2 per
+    chip) but chips for the single-core generations: sizing node pools
+    off the raw suffix doubled every v5p pool and its --tpu-topology
+    (ISSUE 7 satellite)."""
+    from ray_tpu.autoscaler.autoscaler import (FakeSliceBackend,
+                                               GKETPUNodeProvider)
+    cases = {
+        "v2-8": 4, "v3-8": 4, "v4-8": 4,
+        "v5p-8": 4, "v5p-16": 8, "v5p-32": 16,
+        "v5litepod-8": 8, "v5e-4": 4, "v6e-8": 8,
+    }
+    for acc, chips in cases.items():
+        p = GKETPUNodeProvider(accelerator_type=acc,
+                               backend=FakeSliceBackend())
+        assert p.slice_chips == chips, (acc, p.slice_chips)
+    # a v5p-16 slice is 8 chips -> 2 hosts of 4 chips, head on host 0
+    p = GKETPUNodeProvider(accelerator_type="v5p-16",
+                           backend=FakeSliceBackend())
+    hosts = p._host_resources("pool-x")
+    assert len(hosts) == 2
+    assert all(h["TPU"] == 4.0 for h in hosts)
+    assert "TPU-v5p-16-head" in hosts[0]
+    # and the topology matches the CHIP count (2 hosts -> 2x2x2)
+    assert p._topology_for(p.slice_chips) == "2x2x2"
+    # malformed suffixes fall back instead of raising
+    assert GKETPUNodeProvider(accelerator_type="weird",
+                              backend=FakeSliceBackend()).slice_chips == 4

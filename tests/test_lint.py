@@ -805,9 +805,53 @@ def test_rt011_unrelated_local_class_not_flagged():
     assert "RT011" not in rules_hit(RT011_NEG_UNRELATED_CLASS)
 
 
+# ---- RT012 bare print in framework code -----------------------------------
+
+RT012_POS = """
+    def handle_death(reason):
+        print("worker died:", reason)
+"""
+
+RT012_SUPPRESSED = """
+    def handshake(info):
+        print(info)  # graftlint: disable=RT012
+"""
+
+RT012_NEG_LOGGING = """
+    import logging
+    logger = logging.getLogger(__name__)
+
+    def handle_death(reason):
+        logger.warning("worker died: %s", reason)
+"""
+
+
+def test_rt012_bare_print_flagged():
+    assert "RT012" in rules_hit(RT012_POS)
+
+
+def test_rt012_suppressed():
+    assert "RT012" not in rules_hit(RT012_SUPPRESSED)
+
+
+def test_rt012_logging_fine():
+    assert "RT012" not in rules_hit(RT012_NEG_LOGGING)
+
+
+@pytest.mark.parametrize("path", [
+    "tools/bench.py", "examples/demo.py", "tests/test_x.py",
+    "ray_tpu/scripts/cli.py", "ray_tpu/lint/__main__.py",
+])
+def test_rt012_terminal_facing_paths_exempt(path):
+    import textwrap as _tw
+    fs = lint_source(_tw.dedent(RT012_POS), path)
+    assert not any(f.rule_id == "RT012" for f in fs), path
+
+
 def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
-    assert ids == [f"RT00{i}" for i in range(1, 10)] + ["RT010", "RT011"]
+    assert ids == [f"RT00{i}" for i in range(1, 10)] + \
+        ["RT010", "RT011", "RT012"]
     assert all(r.rationale for r in ALL_RULES)
 
 

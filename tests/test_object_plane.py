@@ -184,3 +184,62 @@ def test_borrowed_ref_released_frees_object(ray_start):
     assert loc is not None and loc[0] == "freed", \
         f"object not freed after borrow release: {loc}"
     ray_tpu.kill(holder)
+
+
+# ---- transit-pin races (ADVICE r5 / ISSUE 7 satellites) -------------------
+
+
+def test_ttl_pin_not_recorded_when_add_ref_send_fails(ray_start):
+    """core_worker.pin_refs must only record a remote transit pin when
+    its one-way cw_add_ref send actually left this process: recording a
+    failed send would later emit an unmatched cw_remove_ref at the
+    owner, decrementing a pin some OTHER borrower legitimately holds
+    (freeing a live object)."""
+    from ray_tpu._private.object_ref import ObjectRef
+    w = ray_tpu._private.worker.global_worker()
+    cw = w.core_worker
+    real = ray_tpu.put(1)
+    # same object id, but an owner address nothing listens on: the
+    # one-way send must fail and the pin must NOT be recorded
+    fake = ObjectRef(real.id, ("127.0.0.1", 1), _register=False)
+    local, remote = cw.pin_refs([fake])
+    assert local == [] and remote == []
+    # scheduling + expiring the (empty) handle emits no removals
+    cw.release_pins_after((local, remote), 0.0)
+    cw._expire_ttl_pins()
+    # a successfully-pinned OWN ref records locally and releases cleanly
+    local2, remote2 = cw.pin_refs([real])
+    assert local2 == [real.hex()] and remote2 == []
+    assert cw.arg_pins.get(real.hex(), 0) >= 1
+    before = cw.arg_pins.get(real.hex(), 0)
+    cw.release_pins_now((local2, remote2))
+    assert cw.arg_pins.get(real.hex(), 0) == before - 1
+
+
+def test_nested_ref_survives_delayed_done_report(ray_start):
+    """A chaos `delay` on the cw_task_done path must not let the owner
+    observe freed nested objects: with refs embedded in the result the
+    report goes BLOCKING and the producer's transit pins release only
+    on the owner's ack — never on a wall-clock TTL racing the report.
+    The tiny RAY_TPU_TRANSIT_PIN_TTL_S (worker env) makes the old
+    TTL-release behavior lose this race deterministically."""
+    from ray_tpu import chaos
+    rid = chaos.inject("delay", method="cw_task_done", delay_ms=1000,
+                       max_fires=1)
+    try:
+        @ray_tpu.remote
+        def produce():
+            import numpy as _np
+
+            import ray_tpu as rt
+            return {"inner": rt.put(_np.ones(300_000))}
+
+        # NB lowercase: Config env overrides are RAY_TPU_<name> with the
+        # attribute's exact (lowercase) name
+        out = ray_tpu.get(produce.options(runtime_env={
+            "env_vars": {"RAY_TPU_transit_pin_ttl_s": "0.2"}}).remote(),
+            timeout=180)
+        val = ray_tpu.get(out["inner"], timeout=60)
+        assert float(val.sum()) == 300_000.0
+    finally:
+        chaos.clear([rid])

@@ -325,3 +325,41 @@ def test_batched_get_keeps_per_ref_wait_edges(ray_start):
     while _edges(rt) and time.time() < deadline:
         time.sleep(0.1)
     assert _edges(rt) == set()
+
+
+def test_mp_main_functions_route_through_cloudpickle():
+    """Plain pickle serializes __mp_main__ (multiprocessing-spawn
+    driver) functions BY REFERENCE without error; the reference only
+    breaks later inside a worker whose __main__ is worker_main. The
+    fast path must detect the __mp_main__ marker (NOT a substring of
+    "__main__") and route through cloudpickle, which pickles the
+    module by value (ISSUE 7 satellite)."""
+    import pickle as _pickle
+    import sys
+    import types
+
+    from ray_tpu._private import serialization as ser
+
+    mod = types.ModuleType("__mp_main__")
+
+    def f():
+        return 42
+
+    f.__module__ = "__mp_main__"
+    f.__qualname__ = "f"
+    mod.f = f
+    sys.modules["__mp_main__"] = mod
+    try:
+        # sanity: the plain-pickle blob carries the __mp_main__ marker
+        # but NOT "__main__" — the old check passed it through as "P"
+        blob = _pickle.dumps(f, protocol=5)
+        assert b"__mp_main__" in blob and b"__main__" not in blob
+        meta, _bufs = ser.serialize(f)
+        assert bytes(meta[:1]) == b"C", \
+            "__mp_main__ function took the plain-pickle fast path"
+        packed = ser.pack(f)
+    finally:
+        del sys.modules["__mp_main__"]
+    # round-trips in a process WITHOUT __mp_main__ (what a worker sees)
+    g = ser.unpack(memoryview(packed))
+    assert g() == 42
