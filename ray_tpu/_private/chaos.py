@@ -209,7 +209,7 @@ class ChaosClient:
         if report_client is not None:
             try:
                 report_client.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - old report client; already severed
                 pass
 
     def set_kill_actuator(self, fn: Callable[[str], None]) -> None:

@@ -85,6 +85,24 @@ class Config:
     # used only when the owner's ack never arrives (the normal path
     # releases on ack — see _Executor._report_done).
     transit_pin_ttl_s = _define("transit_pin_ttl_s", 30.0, float)
+    # Profiling plane (_private/profiler.py): default sampling rate for
+    # `ray_tpu profile` and the cap on DISTINCT folded stacks one
+    # sampler session aggregates (beyond it samples are counted into
+    # the drop counter, never allocated — memory stays O(cap), not
+    # O(duration)).
+    profile_default_hz = _define("profile_default_hz", 100.0, float)
+    profile_max_stacks = _define("profile_max_stacks", 2000, int)
+    # Memory attribution plane (_private/memory_plane.py): per-snapshot
+    # object cap for the full `ray_tpu memory` gather and the (smaller)
+    # digest cap riding every metrics harvest. Callsite capture records
+    # the put()/.remote() source line that created each owned object —
+    # one stack walk per object creation (~a few µs), so it is opt-in.
+    memory_callsite_capture = _define(
+        "memory_callsite_capture", False, _bool)
+    memory_snapshot_max_objects = _define(
+        "memory_snapshot_max_objects", 4096, int)
+    memory_digest_max_objects = _define(
+        "memory_digest_max_objects", 512, int)
 
 
 if Config.testing_rpc_delay_us:

@@ -26,7 +26,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import log_plane as _log_plane
+from ray_tpu._private import memory_plane as _memory_plane
 from ray_tpu._private import metrics_plane as _metrics_plane
+from ray_tpu._private import profiler as _profiler
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import serialization as ser
 from ray_tpu._private import spans as _spans
@@ -41,6 +43,25 @@ logger = logging.getLogger(__name__)
 
 # Object location tags (owner's object directory entries)
 INLINE, STORE, ERROR, PENDING, FREED = "inline", "store", "error", "pending", "freed"
+
+# the package root, for callsite capture: the creation site reported by
+# `ray_tpu memory --group-by callsite` is the first frame OUTSIDE the
+# framework (the user's put()/.remote() line, not our plumbing)
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _capture_callsite() -> Optional[str]:
+    import sys as _sys
+    try:
+        f = _sys._getframe(2)
+    except ValueError:
+        return None
+    while f is not None:
+        path = f.f_code.co_filename
+        if not path.startswith(_PKG_ROOT):
+            return f"{path}:{f.f_lineno} in {f.f_code.co_name}"
+        f = f.f_back
+    return None
 
 # Sentinel: materialization must be retried after in-flight recovery.
 _RETRY = object()
@@ -67,7 +88,7 @@ def _count_task_outcome(outcome: str) -> None:
         _TASK_COUNTERS[outcome] = c
     try:
         c.inc()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - metrics are best-effort
         pass
 
 
@@ -89,7 +110,7 @@ def _transport_bytes(n: int, site: str) -> None:
         _TRANSPORT_COUNTER = c
     try:
         c.inc(n, tags={"site": site})
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - metrics are best-effort
         pass
 
 
@@ -232,6 +253,14 @@ class CoreWorker:
         self._actor_pending: Dict[str, int] = {}
         self._store_map_cache = (0.0, {})
         self._put_index = 0
+        # memory attribution (memory_plane.py): creation callsites of
+        # owned objects (opt-in, Config.memory_callsite_capture) and a
+        # short ring of store-resident objects this owner freed — the
+        # refcount-vs-residency leak probe's "should be gone" list
+        self._callsites: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._recently_freed: "collections.deque" = \
+            collections.deque(maxlen=256)
         self._fn_cache: Dict[str, Any] = {}
         self._subscriptions: Dict[Tuple[str, str], Any] = {}
         self._tls = threading.local()
@@ -270,6 +299,24 @@ class CoreWorker:
             # _private/log_plane.py) — drivers live outside any node
             # manager's log dir, so the GCS pulls their tails directly
             "cw_logs_snapshot": _log_plane.snapshot,
+            # profiling plane (_private/profiler.py): sampler control,
+            # one-shot collect (start→sleep→snapshot, singleflight so
+            # the concurrent NM+GCS fan-out never double-samples), and
+            # device-side xplane traces
+            "cw_profile_start":
+                lambda hz=100.0: _profiler.sampler().start(hz),
+            "cw_profile_stop": lambda: _profiler.sampler().stop(),
+            "cw_profile_snapshot":
+                lambda reset=False: _profiler.sampler().snapshot(
+                    reset=reset),
+            "cw_profile_collect":
+                lambda duration_s=5.0, hz=100.0, device=False:
+                (_profiler.device_profile(duration_s) if device
+                 else _profiler.collect_local(duration_s, hz)),
+            "cw_device_profile": _profiler.device_profile,
+            # memory attribution plane (_private/memory_plane.py):
+            # owner-side reference-table dump for `ray_tpu memory`
+            "cw_memory_snapshot": self.memory_snapshot,
         }
         self.executor: Optional[_Executor] = None
         if mode == "worker":
@@ -281,6 +328,10 @@ class CoreWorker:
         # one trace row per process in the merged timeline
         _spans.set_process_label(f"{mode}-{self.worker_id.hex()[:8]}",
                                  node_id=node_id_hex)
+        # full worker identity for the profiling plane (`ray_tpu
+        # profile --worker` matches by id prefix; labels only carry 8
+        # hex chars)
+        _profiler.set_process_worker(self.worker_id.hex())
         # debug plane: log-line stamps read the current task/actor/trace
         # from this worker's TLS; drivers additionally capture their own
         # `logging` output into the in-process tail ring so `ray_tpu
@@ -294,6 +345,12 @@ class CoreWorker:
         # watchdog's lease_slot_balance probe reads exactly these
         _metrics_plane.register_sampler("core_worker",
                                         self._sample_metric_gauges)
+        # compact memory digest on every metrics harvest: the input the
+        # watchdog's leak probes compare store residency against, so a
+        # leaked pin alerts within two harvest intervals with no extra
+        # fan-out (memory_plane.py)
+        _metrics_plane.register_snapshot_extra(
+            _memory_plane.PROC_DIGEST_KEY, self._memory_digest)
         # Owner-side node-failure detection (reference: the raylet notifies
         # owners via the object directory / lease failures; here the GCS
         # node channel is the death signal). Without it, tasks in flight
@@ -324,7 +381,7 @@ class CoreWorker:
         chaos_lib.fetch_policy(self._gcs.call)
         try:
             self.subscribe("chaos", chaos_lib.on_policy_message)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - degrades to fetched policy
             pass
 
     # ------------------------------------------------------------------
@@ -389,6 +446,11 @@ class CoreWorker:
 
     def set_current_task(self, task_id: Optional[TaskID]) -> None:
         self._tls.task_id = task_id
+        # mirror into the profiler's cross-thread context registry:
+        # threading.local is invisible to the sampler thread, a plain
+        # dict write is not (and costs ~100ns per task transition)
+        _profiler.set_thread_task(task_id.hex()
+                                  if task_id is not None else None)
 
     # ---- tracing (reference tracing_helper.py context propagation) ---
 
@@ -402,8 +464,10 @@ class CoreWorker:
                           name: Optional[str] = None) -> None:
         self._tls.trace_id = trace_id
         self._tls.trace_name = name
-        # mirror into the flight recorder so span records carry the trace
+        # mirror into the flight recorder so span records carry the
+        # trace, and into the profiler so samples do too
         _spans.set_current_trace(trace_id)
+        _profiler.set_thread_trace(trace_id)
 
     def _attach_trace(self, spec: TaskSpec) -> None:
         """Child tasks inherit the caller's trace; a driver-side submit
@@ -421,6 +485,129 @@ class CoreWorker:
         with self._lock:
             self._put_index += 1
             return self._put_index
+
+    # ------------------------------------------------------------------
+    # Memory attribution (memory_plane.py)
+    # ------------------------------------------------------------------
+
+    def _note_callsite(self, oid_hexes: List[str]) -> None:
+        """Record the user-code line that created these objects (put /
+        .remote()); only called when Config.memory_callsite_capture is
+        on — a stack walk per creation is real cost on the put path."""
+        site = _capture_callsite()
+        if site is None:
+            return
+        with self._lock:
+            for h in oid_hexes:
+                self._callsites[h] = site
+            while len(self._callsites) > 8192:
+                self._callsites.popitem(last=False)
+
+    def memory_snapshot(self, max_objects: Optional[int] = None
+                        ) -> Dict[str, Any]:
+        """This process's reference table, wire form: everything that
+        holds an object alive from here — local refs, submitted-arg
+        pins, borrows held (we pinned at a remote owner), borrower pins
+        granted (remote processes pinned with us), reader leases on
+        pulled replicas, transit pins — plus owned objects' recorded
+        location and (opt-in) creation callsite. The GCS joins these
+        with store residency into the cluster object table."""
+        cap = int(Config.memory_snapshot_max_objects
+                  if max_objects is None else max_objects)
+        executor = self.executor
+        actor_id = executor.actor_id.hex() \
+            if executor is not None and executor.actor_id is not None \
+            else None
+        with self._lock:
+            oids = (set(self.objects) | set(self.local_refs)
+                    | set(self.arg_pins) | set(self.borrowed)
+                    | set(self._replica_leases) | set(self.borrower_pins))
+            transit_pins = sum(len(p[1]) + len(p[2])
+                               for p in self._ttl_pins)
+            records: Dict[str, Dict[str, Any]] = {}
+            for h in oids:
+                loc = self.objects.get(h)
+                tag = loc[0] if loc is not None else None
+                if tag == STORE:
+                    size: Optional[int] = int(loc[2])
+                elif tag in (INLINE, ERROR):
+                    size = len(loc[1])
+                else:
+                    size = None
+                records[h] = {
+                    "owned": loc is not None and h not in self.borrowed,
+                    "loc": tag,
+                    "store_addr": (list(loc[1]) if tag == STORE
+                                   else None),
+                    "size": size,
+                    "local_refs": self.local_refs.get(h, 0),
+                    "arg_pins": self.arg_pins.get(h, 0),
+                    "borrowed_from": (list(self.borrowed[h])
+                                      if h in self.borrowed else None),
+                    "replica_leases": self._replica_leases.get(h, 0),
+                    "borrower_pins": {
+                        f"{a[0]}:{a[1]}": n for a, n in
+                        self.borrower_pins.get(h, {}).items()},
+                    "callsite": self._callsites.get(h),
+                }
+            dropped = 0
+            if len(records) > cap:
+                # bounded: keep the held-alive end (store-resident,
+                # pinned, borrowed, leased) and count the rest out
+                def _weight(item):
+                    r = item[1]
+                    return ((r["loc"] == STORE) * 4
+                            + bool(r["borrower_pins"])
+                            + bool(r["replica_leases"])
+                            + bool(r["arg_pins"]),
+                            r["size"] or 0)
+                kept = sorted(records.items(), key=_weight,
+                              reverse=True)[:cap]
+                dropped = len(records) - cap
+                records = dict(kept)
+            freed = [oid for oid, _t in self._recently_freed]
+        return {
+            "proc_uid": _spans.PROC_UID,
+            "pid": os.getpid(),
+            "label": _spans.process_label(),
+            "node_id": self.node_id_hex,
+            "worker_id": self.worker_id.hex(),
+            "actor_id": actor_id,
+            "mode": self.mode,
+            "wall_time": time.time(),
+            "objects": records,
+            "transit_pins": transit_pins,
+            "recently_freed": freed,
+            "objects_dropped": dropped,
+        }
+
+    def _memory_digest(self) -> Dict[str, Any]:
+        """Compact form riding every metrics harvest (the leak probes'
+        view of who claims what; see memory_plane.py). Computed
+        directly from the held-alive sets — NOT via memory_snapshot(),
+        whose full record build over the whole object directory
+        (including long-dead FREED entries) is too heavy for a 2s
+        cadence and would trip the digest cap on long-lived drivers,
+        silently disabling the probes."""
+        cap = int(Config.memory_digest_max_objects)
+        now = time.monotonic()
+        with self._lock:
+            owned_store = [h for h, loc in self.objects.items()
+                           if loc[0] == STORE and h not in self.borrowed]
+            leases = dict(self._replica_leases)
+            # hold a just-freed object back until its queued remote
+            # delete has had time to drain (it rides the borrow-release
+            # drainer) — reporting it instantly would race the delete
+            # into a false residency-mismatch alert
+            freed = [oid for oid, t in self._recently_freed
+                     if now - t >= self.FREED_REPORT_GRACE_S]
+        return {"kind": self.mode,
+                "owned_store": owned_store[:cap],
+                "leases": leases,
+                "freed": freed,
+                "dropped": max(0, len(owned_store) - cap)}
+
+    FREED_REPORT_GRACE_S = 3.0
 
     # ------------------------------------------------------------------
     # Reference counting
@@ -483,10 +670,31 @@ class CoreWorker:
         if loc is None or loc[0] == PENDING:
             return  # task in flight; keep until completion
         if loc[0] == STORE:
+            # the delete must reach the store that HOLDS the primary:
+            # a task result created pinned in the executing worker's
+            # node store used to be freed only from the OWNER's local
+            # store, leaking the remote primary forever (found by the
+            # memory plane's residency-mismatch probe). Queued onto the
+            # borrow-release drainer, NOT sent here — a connect to a
+            # dead node can block for the pool's full timeout, and this
+            # runs under self._lock (loss just means the probe flags
+            # the stranded copy).
+            primary_addr = tuple(loc[1])
+            if primary_addr != self.store.address:
+                self._borrow_release_queue.put(
+                    ("store_delete", primary_addr, oid_hex))
             try:
+                # local copy (the primary, or a pulled replica) + the
+                # client-side mmap release either way
                 self.store.delete([oid_hex])
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - store gone; probe flags leftovers
                 pass
+            # residency-mismatch probe input: this object SHOULD now be
+            # gone from every store. Timestamped so the digest can hold
+            # a just-freed object back while the queued remote delete
+            # drains (memory_plane.py)
+            self._recently_freed.append((oid_hex, time.monotonic()))
+        self._callsites.pop(oid_hex, None)
         self.objects[oid_hex] = (FREED,)
         # release eager borrows on refs nested inside this result (see
         # _register_nested_borrows): remote owners via the async release
@@ -578,6 +786,16 @@ class CoreWorker:
                 continue
             if item is None:
                 return
+            if len(item) == 3 and item[0] == "store_delete":
+                # remote-primary free queued by _maybe_free_locked (the
+                # connect must happen OFF the CoreWorker lock)
+                _tag, store_addr, oid_hex = item
+                try:
+                    self._pool.get(store_addr).send_oneway(
+                        "store_delete", object_ids=[oid_hex])
+                except Exception:  # noqa: BLE001 - node gone; the leak
+                    pass           # probe flags any stranded copy
+                continue
             owner_addr, oid_hex = item
             try:
                 self._pool.get(owner_addr).call("cw_remove_ref",
@@ -688,6 +906,8 @@ class CoreWorker:
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id(), self.next_put_index())
         h = oid.hex()
+        if Config.memory_callsite_capture:
+            self._note_callsite([h])
         loc = self.store_value(h, value)
         with self._lock:
             self.objects[h] = loc
@@ -764,7 +984,7 @@ class CoreWorker:
                     try:
                         self._nm.call("nm_worker_blocked",
                                       worker_id_hex=self.worker_id.hex())
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 - blocked hint is advisory only
                         pass
                 # may raise DeadlockError instead of blocking forever
                 edge = self._register_wait_edge(ref) if need_wait else None
@@ -788,7 +1008,7 @@ class CoreWorker:
                 try:
                     self._nm.call("nm_worker_unblocked",
                                   worker_id_hex=self.worker_id.hex())
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 - unblock hint is advisory only
                     pass
 
     def _materialize_many(self, refs: List[ObjectRef], hexes: List[str],
@@ -1218,6 +1438,8 @@ class CoreWorker:
                 self.objects[oid.hex()] = (PENDING,)
                 self.object_events[oid.hex()] = threading.Event()
             self.tasks[spec.task_id.hex()] = entry
+        if Config.memory_callsite_capture and return_ids:
+            self._note_callsite([oid.hex() for oid in return_ids])
         self._attach_trace(spec)
         self.task_events.record(
             spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
@@ -1618,7 +1840,7 @@ class CoreWorker:
             self._pool.get(nm_addr).send_oneway("nm_return_worker",
                                                 lease_id=lease_id,
                                                 reuse=reuse)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - NM gone; its leases died with it
             pass
 
     def _on_task_done(self, task_id: TaskID, results: List[Tuple],
@@ -1880,6 +2102,8 @@ class CoreWorker:
         self._attach_trace(spec)
         return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
                       for i in range(num_returns)]
+        if Config.memory_callsite_capture and return_ids:
+            self._note_callsite([oid.hex() for oid in return_ids])
         with self._lock:
             state = self.actors.get(actor_id.hex())
             if state is None:
@@ -2265,6 +2489,9 @@ class CoreWorker:
     def shutdown(self) -> None:
         self._shutdown = True
         _metrics_plane.unregister_sampler("core_worker")
+        _metrics_plane.unregister_snapshot_extra(
+            _memory_plane.PROC_DIGEST_KEY)
+        _profiler.sampler().stop()
         # Drain queued borrow releases before tearing the process down so a
         # clean exit doesn't strand pins at owners.
         while True:
@@ -2274,10 +2501,15 @@ class CoreWorker:
                 break
             if item is None:
                 continue
-            owner_addr, oid_hex = item
             try:
-                self._pool.get(owner_addr).call(
-                    "cw_remove_ref", oid_hex=oid_hex, borrower=self.address)
+                if len(item) == 3 and item[0] == "store_delete":
+                    self._pool.get(item[1]).send_oneway(
+                        "store_delete", object_ids=[item[2]])
+                else:
+                    owner_addr, oid_hex = item
+                    self._pool.get(owner_addr).call(
+                        "cw_remove_ref", oid_hex=oid_hex,
+                        borrower=self.address)
             # best-effort release during shutdown: the owner may already
             # be gone, and there is nothing left to free on our side
             except Exception:  # noqa: BLE001  graftlint: disable=RT008
@@ -2297,7 +2529,7 @@ class CoreWorker:
                 pass
         try:
             self.task_events.stop()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - teardown; event sink may be gone
             pass
         self.server.stop()
         self.store.close()
@@ -2526,6 +2758,9 @@ class _Executor:
                     # during a slow constructor already match
                     from ray_tpu._private import chaos as chaos_lib
                     chaos_lib.client().set_actor_class(spec.function_name)
+                    # profiler samples carry the actor identity
+                    # (process-wide: one actor instance per worker)
+                    _profiler.set_process_actor(spec.actor_id.hex())
                     self.actor_instance = cls(*args, **kwargs)
                     self.actor_id = spec.actor_id
                     cw._gcs.call("report_actor_alive",
@@ -2579,7 +2814,7 @@ class _Executor:
                             "report_actor_death",
                             actor_id_hex=spec.actor_id.hex(),
                             reason=f"creation failed: {e}", restart=False)
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 - NM death report covers it
                         pass
                 will_exit = decide_exit()
                 self._report_error(
@@ -2644,7 +2879,7 @@ class _Executor:
                             spec.max_calls, spec.function_name)
                 try:
                     cw.task_events.flush()
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 - exiting either way
                     pass
                 os._exit(0)
 

@@ -255,6 +255,12 @@ class GcsServer:
             # flight recorder: cluster-wide span-ring gather
             # (`ray_tpu timeline --spans`, dashboard /api/timeline?spans=1)
             "spans_collect": self.spans_collect,
+            # profiling plane: cluster flamegraph collect (`ray_tpu
+            # profile`, dashboard /api/profile; _private/profiler.py)
+            "profile_collect": self.profile_collect,
+            # memory attribution plane: cluster object table (`ray_tpu
+            # memory`, dashboard /api/memory; _private/memory_plane.py)
+            "memory_collect": self.memory_collect,
             # debug plane: attributed-log fan-out + crash postmortems
             # (`ray_tpu logs`, dashboard /api/logs + /api/postmortems)
             "logs_query": self.logs_query,
@@ -624,7 +630,7 @@ class GcsServer:
         if addr is not None:
             try:
                 self._pool.get(addr).call("cw_kill_self")
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - death report below still lands
                 pass
         self.report_actor_death(actor_id_hex, "ray.kill", restart=not no_restart)
 
@@ -711,6 +717,95 @@ class GcsServer:
             snap["clock_offset_s"] = snap["wall_time"] - (t0 + t1) / 2.0
             direct.append(snap)
         return spans_lib.dedupe_by_uid([own] + direct + via_nm)
+
+    # ---- profiling plane (see _private/profiler.py) ---------------------
+
+    PROFILE_COLLECT_GRACE_S = 8.0
+
+    def profile_collect(self, duration_s: float = 5.0, hz: float = 100.0,
+                        device: bool = False) -> Dict[str, Any]:
+        """Cluster profile: start→sleep→snapshot on every process —
+        node managers (each covers its workers one hop below) and
+        pubsub-subscribed drivers — CONCURRENTLY under one overall
+        deadline, so every process samples the same window and an
+        unreachable node bounds, not doubles, the collect. A process
+        reached twice (NM gather + direct subscriber pull) runs ONE
+        sampling session (profiler.collect_local singleflight) and is
+        deduped by proc uid here. The merge downstream is clock-free:
+        folded-stack counts, never timestamps."""
+        from ray_tpu._private import profiler as profiler_lib
+        from ray_tpu._private import spans as spans_lib
+        duration_s = min(120.0, max(0.05, float(duration_s)))
+        own_box: List[Optional[Dict[str, Any]]] = [None]
+
+        def _own() -> None:
+            try:
+                own_box[0] = profiler_lib.collect_local(duration_s, hz)
+            except Exception:  # noqa: BLE001 - the control plane's own
+                pass           # profile is optional in the merge
+
+        own_thread = None
+        if not device:
+            # sample this process too (in-process head: GCS + NM +
+            # driver share it; the singleflight collapses the sessions)
+            own_thread = threading.Thread(target=_own, daemon=True,
+                                          name="gcs-profile-own")
+            own_thread.start()
+        nm_replies, cw_replies, unreachable = \
+            spans_lib.gather_cluster_snapshots(
+                self, "nm_profile_collect", "cw_profile_collect",
+                timeout=duration_s + self.PROFILE_COLLECT_GRACE_S,
+                grace_s=2.0, concurrent=True,
+                call_kwargs={"duration_s": duration_s, "hz": hz,
+                             "device": device})
+        profiles: List[Dict[str, Any]] = []
+        for _addr, reply, _t0, _t1 in nm_replies:
+            profiles.extend(reply.get("profiles", ()))
+        profiles.extend(snap for _a, snap, _t0, _t1 in cw_replies)
+        if own_thread is not None:
+            own_thread.join(timeout=duration_s + 5.0)
+        if own_box[0] is not None:
+            profiles.insert(0, own_box[0])
+        profiles = spans_lib.dedupe_by_uid([p for p in profiles if p])
+        return {"ts": time.time(), "duration_s": duration_s, "hz": hz,
+                "device": device, "profiles": profiles,
+                "unreachable": unreachable}
+
+    # ---- memory attribution plane (see _private/memory_plane.py) --------
+
+    MEMORY_COLLECT_TIMEOUT_S = 5.0
+
+    def memory_collect(self, max_objects: Optional[int] = None,
+                       timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Cluster object table: every core worker's reference-table
+        snapshot joined with every node's store residency under one
+        overall deadline (memory_plane.build_object_table). Reply names
+        the nodes that did not answer — absence of a row is only
+        meaningful when coverage was complete."""
+        from ray_tpu._private import memory_plane as memory_plane_lib
+        from ray_tpu._private import spans as spans_lib
+        t = float(timeout) if timeout else self.MEMORY_COLLECT_TIMEOUT_S
+        call_kwargs = {"max_objects": max_objects} \
+            if max_objects is not None else None
+        nm_replies, cw_replies, unreachable = \
+            spans_lib.gather_cluster_snapshots(
+                self, "nm_memory_snapshot", "cw_memory_snapshot",
+                timeout=t, grace_s=1.0, call_kwargs=call_kwargs)
+        proc_snaps: List[Dict[str, Any]] = []
+        node_snaps: List[Dict[str, Any]] = []
+        for _addr, reply, _t0, _t1 in nm_replies:
+            node_snaps.append(reply)
+            proc_snaps.extend(reply.get("worker_snaps", ()))
+        proc_snaps.extend(snap for _a, snap, _t0, _t1 in cw_replies)
+        proc_snaps = spans_lib.dedupe_by_uid(proc_snaps)
+        rows = memory_plane_lib.build_object_table(proc_snaps,
+                                                   node_snaps)
+        return {"ts": time.time(), "objects": rows,
+                "procs": len(proc_snaps),
+                "objects_dropped": sum(
+                    int(s.get("objects_dropped") or 0)
+                    for s in proc_snaps),
+                "unreachable": unreachable}
 
     # ---- debug plane: log fan-out + postmortems (log_plane.py) ----------
 
@@ -994,7 +1089,7 @@ class GcsServer:
                         self._pool.get(node.address).call(
                             "nm_return_bundle", pg_id_hex=pg_id_hex,
                             bundle_index=idx)
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 - node died; bundles died with it
                         pass
                 time.sleep(0.1)
                 continue
@@ -1005,8 +1100,14 @@ class GcsServer:
                     self._pool.get(node.address).call(
                         "nm_commit_bundle", pg_id_hex=pg_id_hex,
                         bundle_index=idx)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 - prepare already
+                    # reserved the resources; a node dying between
+                    # prepare and commit surfaces through its NODE_DEAD
+                    # sweep, but the skipped commit must be on record
+                    logger.warning(
+                        "placement group %s: commit_bundle %d on node "
+                        "%s failed", pg_id_hex[:12], idx,
+                        node.node_id.hex()[:12], exc_info=True)
             with self._lock:
                 # remove_placement_group may have raced us between the
                 # top-of-loop check and the commit: it saw PENDING and
@@ -1024,7 +1125,7 @@ class GcsServer:
                         self._pool.get(node.address).call(
                             "nm_return_bundle", pg_id_hex=pg_id_hex,
                             bundle_index=idx)
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 - node gone; nothing to return
                         pass
                 return
             self.publish("placement_group", ("CREATED", info))
@@ -1050,7 +1151,7 @@ class GcsServer:
         for aid in doomed:
             try:
                 self.kill_actor(aid, no_restart=True)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - actor already dead
                 pass
         if prev_state == "CREATED":
             for idx, nid in enumerate(info.bundle_nodes):
@@ -1061,7 +1162,7 @@ class GcsServer:
                     self._pool.get(node.address).call(
                         "nm_return_bundle", pg_id_hex=pg_id_hex,
                         bundle_index=idx)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 - node gone; nothing to return
                     pass
         self.publish("placement_group", ("REMOVED", info))
         return True

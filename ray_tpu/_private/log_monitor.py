@@ -233,9 +233,9 @@ class LogMonitor:
         try:
             self._scan_once()
             self._drain_publish()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - final drain is best-effort
             pass
         try:
             self._gcs.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - closing an already-dead client
             pass

@@ -203,7 +203,7 @@ class AttributedStream(io.TextIOBase):
     def flush(self) -> None:
         try:
             self._raw.flush()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - sink closed mid-flush
             pass
 
     def fileno(self) -> int:
@@ -258,7 +258,7 @@ class _RingCaptureHandler(logging.Handler):
         try:
             _, rec = format_line(record.getMessage(), record.levelname)
             _tail_ring.append(rec)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - must not throw into logging
             pass
 
 
@@ -274,7 +274,7 @@ def init_worker_io(kind: str = "worker") -> None:
     for s in (raw_out, raw_err):
         try:
             s.reconfigure(line_buffering=True)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - no reconfigure; default buffering
             pass
     _raw_stderr = raw_err
     sys.stdout = AttributedStream(raw_out, "OUT")

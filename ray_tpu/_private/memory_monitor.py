@@ -59,7 +59,7 @@ class MemoryMonitor:
         while not self._stop.wait(self.period_s):
             try:
                 usage = self._usage()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - probe raced an exit; retry next tick
                 continue
             if usage < self.threshold:
                 continue

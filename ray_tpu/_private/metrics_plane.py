@@ -63,6 +63,24 @@ def unregister_sampler(name: str) -> None:
         _SAMPLERS.pop(name, None)
 
 
+# key -> provider returning a JSON-able value attached to this process's
+# harvest snapshot under that key. Non-metric payloads that must ride
+# the SAME round as the gauges they are judged against (the memory
+# plane's leak-probe digests) register here; keyed so a re-init
+# replaces. Providers must be small — this ships every harvest.
+_SNAPSHOT_EXTRAS: Dict[str, Callable[[], Any]] = {}
+
+
+def register_snapshot_extra(key: str, fn: Callable[[], Any]) -> None:
+    with _SAMPLERS_LOCK:
+        _SNAPSHOT_EXTRAS[key] = fn
+
+
+def unregister_snapshot_extra(key: str) -> None:
+    with _SAMPLERS_LOCK:
+        _SNAPSHOT_EXTRAS.pop(key, None)
+
+
 def snapshot_process() -> Dict[str, Any]:
     """This process's full registry in wire format, identity-tagged for
     the harvest (proc uid for dedupe, label/node/pid for exposition)."""
@@ -70,12 +88,13 @@ def snapshot_process() -> Dict[str, Any]:
     from ray_tpu.util import metrics as metrics_mod
     with _SAMPLERS_LOCK:
         samplers = list(_SAMPLERS.values())
+        extras = list(_SNAPSHOT_EXTRAS.items())
     for fn in samplers:
         try:
             fn()
         except Exception:  # noqa: BLE001 - a dead component's sampler
             pass           # must not break the whole snapshot
-    return {
+    snap = {
         "proc_uid": spans_lib.PROC_UID,
         "pid": os.getpid(),
         "proc": spans_lib.process_label(),
@@ -83,6 +102,12 @@ def snapshot_process() -> Dict[str, Any]:
         "wall_time": time.time(),
         "metrics": metrics_mod.collect_wire(),
     }
+    for key, fn in extras:
+        try:
+            snap[key] = fn()
+        except Exception:  # noqa: BLE001 - one broken provider must not
+            pass           # blank the whole snapshot
+    return snap
 
 
 # ---------------------------------------------------------------------
@@ -331,6 +356,10 @@ class Watchdog:
         # lease probe: uid -> (leaked-slot count, monotonic ts it was
         # first seen stuck at that value)
         self._lease_stuck: Dict[str, Tuple[float, float]] = {}
+        # memory-plane leak probes: (kind, node, oid) -> monotonic ts
+        # first seen suspect (a suspect must survive a full harvest
+        # interval before alerting — absence races are one-round long)
+        self._mem_suspect: Dict[Tuple[str, str, str], float] = {}
         self._prev_series: Dict[str, float] = {}
         self.alerts_total = 0
 
@@ -499,6 +528,134 @@ class Watchdog:
                     proc=snap["proc"], node_id=snap.get("node_id"),
                     value=depth)
 
+    def _probe_memory(self, snaps: List[Dict[str, Any]],
+                      interval_s: float,
+                      unreachable: List[str]) -> None:
+        """Memory-plane leak probes over the harvest's digests
+        (memory_plane.py: each core worker ships what it claims holds
+        objects alive; each node manager ships its store's held-alive
+        entries). Three invariants:
+
+          - every PINNED store object is claimed by a live owner
+            (violation: the owner died without releasing — the classic
+            leak `ray_tpu memory` exists for);
+          - every store reader LEASE is accounted by a live process's
+            replica-lease table (violation: a leased view leaked, the
+            block can never be evicted);
+          - an object the owner already FREED is not still store-
+            resident (violation: refcount vs residency mismatch).
+
+        A suspect must persist a full harvest interval before alerting
+        (creation/free races are one-round long), so a real leak alerts
+        within two harvest intervals. Absence of a claim is only
+        evidence when coverage was complete, so skipped rounds —
+        unreachable nodes, truncated/capped digests, or a node whose
+        harvest carried fewer worker digests than its node manager has
+        registered workers (one stalled worker must not read as a dead
+        owner) — also RESET the suspect clocks rather than letting
+        them age through unverified rounds."""
+        from ray_tpu._private import memory_plane as memory_plane_lib
+        if unreachable:
+            self._mem_suspect.clear()
+            return
+        claimed: set = set()
+        freed: set = set()
+        # reader-lease claims are per NODE: a proc's replica leases are
+        # held on its OWN node's store, and a cluster-wide sum would
+        # let a legitimate lease on node B mask a leaked one on node A
+        leases_claimed: Dict[Tuple[str, str], int] = {}
+        workers_digested: Dict[str, int] = {}
+        digests = 0
+        for snap in snaps:
+            mem = snap.get(memory_plane_lib.PROC_DIGEST_KEY)
+            if not mem:
+                continue
+            digests += 1
+            if mem.get("dropped"):
+                # capped digest: absence proves nothing this round, and
+                # suspect clocks must not age through it
+                self._mem_suspect.clear()
+                return
+            node = str(snap.get("node_id") or "?")
+            if mem.get("kind") == "worker":
+                workers_digested[node] = workers_digested.get(node, 0) + 1
+            claimed.update(mem.get("owned_store") or ())
+            freed.update(mem.get("freed") or ())
+            for oid, n in (mem.get("leases") or {}).items():
+                leases_claimed[(node, oid)] = \
+                    leases_claimed.get((node, oid), 0) + n
+        if not digests:
+            return
+        window = max(interval_s, 0.05)
+        now = time.monotonic()
+        seen: set = set()
+
+        def suspect(kind: str, node: str, oid: str) -> bool:
+            """True once the suspect has persisted a full interval."""
+            key = (kind, node, oid)
+            seen.add(key)
+            first = self._mem_suspect.setdefault(key, now)
+            return now - first >= window
+
+        for snap in snaps:
+            store = snap.get(memory_plane_lib.STORE_DIGEST_KEY)
+            if not store or store.get("truncated"):
+                continue
+            node = str(snap.get("node_id") or "?")
+            expected = store.get("registered_workers")
+            if expected is not None and \
+                    workers_digested.get(node, 0) < expected:
+                # a registered worker on this node missed the harvest
+                # (slow GIL-bound pull, spawn race): its claims are
+                # unknown, so absence-based checks would false-alarm —
+                # skip the node and restart its suspect clocks
+                for key in [k for k in self._mem_suspect
+                            if k[1] == node]:
+                    del self._mem_suspect[key]
+                continue
+            for oid, size, pinned, leases, _spilled, age_s in \
+                    store.get("entries") or ():
+                young = age_s is not None and age_s < window
+                if oid in freed and not young:
+                    if suspect("freed_resident", node, oid):
+                        self._alert(
+                            "store_residency_mismatch", f"{node}:{oid}",
+                            f"node {node[:12]}: object {oid[:16]} "
+                            f"({size or 0} bytes) is still store-"
+                            f"resident after its owner freed it — "
+                            f"refcount vs residency mismatch",
+                            severity="ERROR", node_id=node,
+                            object_id=oid, value=float(size or 0))
+                    continue
+                if (pinned or 0) > 0 and oid not in claimed \
+                        and not young:
+                    if suspect("dead_owner", node, oid):
+                        self._alert(
+                            "store_leak_dead_owner", f"{node}:{oid}",
+                            f"node {node[:12]}: object {oid[:16]} "
+                            f"({size or 0} bytes) is pinned in the "
+                            f"store but no live owner claims it — "
+                            f"likely leaked by a dead owner; it will "
+                            f"never be freed",
+                            severity="ERROR", node_id=node,
+                            object_id=oid, value=float(size or 0))
+                node_claims = leases_claimed.get((node, oid), 0)
+                if (leases or 0) > node_claims and not young:
+                    if suspect("orphan_lease", node, oid):
+                        self._alert(
+                            "store_orphaned_lease", f"{node}:{oid}",
+                            f"node {node[:12]}: object {oid[:16]} "
+                            f"holds {leases} reader lease(s) but live "
+                            f"processes on that node account for "
+                            f"{node_claims} — leaked leases make the "
+                            f"block unevictable",
+                            node_id=node, object_id=oid,
+                            value=float(leases or 0))
+        # forget suspects that resolved (freed, claimed, or released)
+        for key in list(self._mem_suspect):
+            if key not in seen:
+                del self._mem_suspect[key]
+
     def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
         for node in unreachable:
             self._alert(
@@ -516,6 +673,8 @@ class Watchdog:
                       lambda: self._probe_wait_edge_age(snaps),
                       lambda: self._probe_drop_growth(series),
                       lambda: self._probe_queue_depth(snaps),
+                      lambda: self._probe_memory(snaps, interval_s,
+                                                 unreachable_nodes),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:

@@ -178,6 +178,9 @@ class NodeManager:
             "nm_metrics_snapshot": self.metrics_snapshot,
             "nm_logs_snapshot": self.logs_snapshot,
             "nm_profile_worker": self.profile_worker,
+            "nm_profile_workers": self.profile_workers,
+            "nm_profile_collect": self.profile_collect,
+            "nm_memory_snapshot": self.memory_snapshot,
             "nm_drain": self.drain,
         }, host=host)
         self.address = self.server.address
@@ -190,6 +193,12 @@ class NodeManager:
         from ray_tpu._private import metrics_plane as _metrics_plane
         _metrics_plane.register_sampler("node_manager",
                                         self._sample_metric_gauges)
+        # held-alive store entries ride every metrics harvest so the
+        # watchdog's leak probes can compare residency against live
+        # owners' claims (memory_plane.py)
+        from ray_tpu._private import memory_plane as _memory_plane
+        _metrics_plane.register_snapshot_extra(
+            _memory_plane.STORE_DIGEST_KEY, self._store_objects_digest)
         self.info = NodeInfo(
             node_id=self.node_id, address=self.address,
             store_address=self.store.address,
@@ -261,8 +270,11 @@ class NodeManager:
                     self._gcs.call(
                         "report_resources",
                         node_id_hex=self.node_id.hex(), available=avail)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 - the loop retries every
+                # period; debug level because a down GCS would repeat
+                # this every report tick
+                logger.debug("resource report to GCS failed",
+                             exc_info=True)
             try:
                 self._respill_pending()
             except Exception:  # noqa: BLE001
@@ -554,7 +566,7 @@ class NodeManager:
                     "cw_task_failed", task_id=pl.spec.task_id,
                     error_type="RUNTIME_ENV_SETUP_FAILED",
                     message=message)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - owner gone; nothing to fail
                 pass
 
     def _monitor_worker(self, handle: _WorkerHandle) -> None:
@@ -609,7 +621,7 @@ class NodeManager:
                 self._gcs.call("report_actor_death",
                                actor_id_hex=handle.actor_id_hex,
                                reason=reason, restart=True)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - GCS down; health check sees the death
                 pass
         if running is not None and not handle.is_actor:
             try:
@@ -620,7 +632,7 @@ class NodeManager:
                     "cw_task_failed", task_id=running.task_id,
                     error_type="WORKER_DIED", message=reason,
                     lease_id=lease_id)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - owner gone; its tasks died with it
                 pass
         self._dispatch()
 
@@ -1120,6 +1132,119 @@ class NodeManager:
                 "node_id": self.node_id.hex(),
                 "stack": stack}
 
+    def profile_workers(self, timeout: float = 3.0) -> Dict[str, Any]:
+        """Batched `ray stack`: dump EVERY live worker on this node in
+        one RPC — the signals go out together and the log-tail waits
+        run on parallel threads, so the reply lands in ~one worker's
+        dump time instead of num_workers serial round trips."""
+        with self._lock:
+            worker_ids = [wid for wid, h in self.workers.items()
+                          if h.proc is not None]
+        dumps: List[Dict[str, Any]] = []
+        lock = threading.Lock()
+
+        def _one(wid: str) -> None:
+            try:
+                d = self.profile_worker(wid, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - worker died mid-dump
+                d = {"worker_id": wid, "node_id": self.node_id.hex(),
+                     "pid": None, "stack": "", "error": str(e)}
+            with lock:
+                dumps.append(d)
+
+        threads = [threading.Thread(target=_one, args=(wid,),
+                                    daemon=True) for wid in worker_ids]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout + 2.0
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        return {"node_id": self.node_id.hex(), "dumps": dumps}
+
+    PROFILE_WORKER_GRACE_S = 5.0
+
+    def profile_collect(self, duration_s: float = 5.0, hz: float = 100.0,
+                        device: bool = False) -> Dict[str, Any]:
+        """Profiling-plane gather for this node: sample the daemon's own
+        process (the store server lives here too) AND every registered
+        worker CONCURRENTLY for the same window — the workers'
+        cw_profile_collect calls block for duration_s, so the daemon's
+        own session runs on this handler thread in parallel with the
+        fan-out. Device mode skips the daemon (no jax here) and asks
+        workers for xplane traces instead."""
+        from ray_tpu._private import profiler as profiler_lib
+        from ray_tpu._private import spans as spans_lib
+        with self._lock:
+            worker_addrs = [h.address for h in self.workers.values()
+                            if h.registered and h.address is not None]
+        kwargs = {"duration_s": duration_s, "hz": hz, "device": device}
+        own_box: List[Optional[Dict[str, Any]]] = [None]
+
+        def _own() -> None:
+            try:
+                own_box[0] = profiler_lib.collect_local(duration_s, hz)
+            except Exception:  # noqa: BLE001 - daemon profile is a
+                pass           # bonus, not a reason to fail the node
+
+        own_thread = None
+        if not device:
+            own_thread = threading.Thread(target=_own, daemon=True,
+                                          name="nm-profile-own")
+            own_thread.start()
+        pulled = spans_lib.pull_snapshots(
+            worker_addrs, "cw_profile_collect",
+            timeout=duration_s + self.PROFILE_WORKER_GRACE_S,
+            call_kwargs=kwargs)
+        if own_thread is not None:
+            own_thread.join(timeout=duration_s + 5.0)
+        profiles = [p for p in (own_box[0],) if p is not None]
+        profiles.extend(snap for _a, snap, _t0, _t1 in pulled)
+        # worker_addrs lets the GCS's concurrent direct pull dedupe by
+        # proc uid without transferring twice being a correctness issue
+        # (the collect singleflight already shares one session)
+        return {"node_id": self.node_id.hex(), "profiles": profiles,
+                "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
+
+    MEMORY_WORKER_TIMEOUT_S = 3.0
+
+    def memory_snapshot(self, max_objects: Optional[int] = None
+                        ) -> Dict[str, Any]:
+        """Memory-plane gather for this node: the store's residency
+        table plus every registered worker's reference-table snapshot,
+        one RPC hop below the GCS `memory_collect` fan-out
+        (memory_plane.py builds the cluster object table from these)."""
+        from ray_tpu._private import spans as spans_lib
+        with self._lock:
+            worker_addrs = [h.address for h in self.workers.values()
+                            if h.registered and h.address is not None]
+        pulled = spans_lib.pull_snapshots(
+            worker_addrs, "cw_memory_snapshot",
+            timeout=self.MEMORY_WORKER_TIMEOUT_S,
+            call_kwargs={"max_objects": max_objects}
+            if max_objects is not None else None)
+        return {"node_id": self.node_id.hex(),
+                "store_addr": list(self.store.address),
+                "store": self.store.list_objects(),
+                "worker_snaps": [snap for _a, snap, _t0, _t1 in pulled],
+                "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
+
+    def _store_objects_digest(self) -> Dict[str, Any]:
+        """Held-alive (pinned/leased) store entries for the harvest's
+        leak probes (memory_plane.store_digest). `registered_workers`
+        lets the probe verify WORKER-granularity coverage: one stalled
+        worker missing from the harvest must disable this node's
+        absence-based checks for the round, not read as a dead owner."""
+        from ray_tpu._private import memory_plane as memory_plane_lib
+        entries, truncated = memory_plane_lib.store_digest(
+            self.store.list_objects(),
+            cap=Config.memory_digest_max_objects)
+        with self._lock:
+            registered = sum(1 for h in self.workers.values()
+                             if h.registered and h.address is not None)
+        return {"entries": entries, "truncated": truncated,
+                "registered_workers": registered,
+                "node_id": self.node_id.hex()}
+
     SPANS_WORKER_TIMEOUT_S = 3.0
 
     def spans_snapshot(self) -> Dict[str, Any]:
@@ -1254,7 +1379,7 @@ class NodeManager:
             from ray_tpu._private.log_plane import read_rss_bytes
             if handle.proc is not None:
                 out["rss_bytes"] = read_rss_bytes(handle.proc.pid)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - /proc gone; rss is optional in the bundle
             pass
         self._prekill_dumps[handle.worker_id.hex()] = out
 
@@ -1279,14 +1404,14 @@ class NodeManager:
             self.log_monitor.scan_now()
             log_tail = self.log_monitor.tail_records(
                 f"worker-{wid[:12]}", Config.postmortem_log_lines)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - scan failed; flight-dump fallback below
             pass
         if not log_tail:
             log_tail = flight.get("log_tail") or []
         stats: Dict[str, Any] = {}
         try:
             stats = self.store.stats()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - store gone; gauges are optional
             pass
         with self._lock:
             num_workers = len(self.workers)
@@ -1349,8 +1474,11 @@ class NodeManager:
         if self._dead:
             return
         self._dead = True
+        from ray_tpu._private import memory_plane as _memory_plane
         from ray_tpu._private import metrics_plane as _metrics_plane
         _metrics_plane.unregister_sampler("node_manager")
+        _metrics_plane.unregister_snapshot_extra(
+            _memory_plane.STORE_DIGEST_KEY)
         try:
             self.memory_monitor.stop()
         except AttributeError:
@@ -1375,7 +1503,7 @@ class NodeManager:
                     handle.proc.kill()
         try:
             self._gcs.call("unregister_node", node_id_hex=self.node_id.hex())
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - GCS gone; health check expires us
             pass
         self.store.shutdown()
         self.server.stop()

@@ -53,6 +53,8 @@ class _Entry:
     # neither dropped, spilled, nor chaos-evicted (eviction defers)
     leases: int = 0
     last_access: float = field(default_factory=time.time)
+    # monotonic so ages never jump with wall-clock adjustments (RT010)
+    created_mono: float = field(default_factory=time.monotonic)
     creating: bool = True
     spilled: bool = False    # payload lives in the disk spill dir, not shm
 
@@ -638,11 +640,14 @@ class StoreServer:
             return self._descriptor(e)
 
     def list_objects(self) -> List[Dict[str, Any]]:
-        """Object-level metadata for the state API (`ray list objects`)."""
+        """Object-level metadata for the state API (`ray list objects`
+        and the memory plane's residency join, memory_plane.py)."""
+        now = time.monotonic()
         with self._lock:
             return [{"object_id": oid, "size": e.size, "sealed": e.sealed,
                      "pinned": e.pinned, "leases": e.leases,
-                     "spilled": e.spilled}
+                     "spilled": e.spilled,
+                     "age_s": max(0.0, now - e.created_mono)}
                     for oid, e in self._objects.items()]
 
     def stats(self) -> Dict[str, float]:
@@ -934,5 +939,5 @@ class StoreClient:
         for a in arenas:
             try:
                 a.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - arena already unmapped
                 pass

@@ -187,7 +187,7 @@ class RpcServer:
         try:
             self._server.shutdown()
             self._server.server_close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - server already stopped
             pass
         # sever live connections so clients fail over immediately
         # (e.g. to a restarted server on the same port) instead of
@@ -230,6 +230,14 @@ _IDEMPOTENT_METHODS = frozenset({
     # debug-plane reads (tail-index/postmortem-ring queries)
     "logs_query", "nm_logs_snapshot", "cw_logs_snapshot",
     "postmortem_list", "postmortem_get",
+    # memory-plane reads (reference-table/residency snapshots). The
+    # profile RPCs are deliberately NOT here: a blind resend of a
+    # collect would run a second multi-second sampling window, and
+    # cw_profile_snapshot(reset=True) is destructive — a retry after a
+    # dropped reply would find the already-handed-over table and
+    # silently return an empty profile.
+    "memory_collect", "nm_memory_snapshot", "cw_memory_snapshot",
+    "nm_profile_workers",
 })
 
 

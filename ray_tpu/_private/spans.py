@@ -343,7 +343,8 @@ def pull_snapshots(addrs, method: str, timeout: float,
 
 def gather_cluster_snapshots(gcs, nm_method: str, cw_method: str,
                              timeout: float, grace_s: float = 1.0,
-                             call_kwargs: Optional[Dict[str, Any]] = None):
+                             call_kwargs: Optional[Dict[str, Any]] = None,
+                             concurrent: bool = False):
     """The two-phase cluster gather both telemetry planes share:
     enumerate alive node managers + pubsub subscribers under the GCS
     lock, pull `nm_method` from every NM (each ships its own snapshot
@@ -359,7 +360,15 @@ def gather_cluster_snapshots(gcs, nm_method: str, cw_method: str,
     timeout + grace_s: when unreachable NMs burn phase 1's budget, the
     subscriber phase gets only the remainder — an outage must not
     double the collect's worst case (the metrics sampler holds its
-    round lock for this long against a 2s interval)."""
+    round lock for this long against a 2s interval).
+
+    `concurrent=True` runs both phases SIMULTANEOUSLY under the same
+    deadline, skipping the covered-worker subtraction (callers dedupe
+    by proc uid; peers reached twice must make the double call cheap —
+    the profile plane's collect singleflight). This exists for gathers
+    whose handlers BLOCK for a sampling window: serial phases would
+    give drivers a different window than workers and double the
+    wall-clock."""
     from time import monotonic
     deadline = monotonic() + timeout + grace_s
     with gcs._lock:
@@ -369,6 +378,26 @@ def gather_cluster_snapshots(gcs, nm_method: str, cw_method: str,
                      for subs in gcs.subscribers.values()
                      for addr, _tok in subs}
     sub_addrs -= {a for _nid, a in nm_targets}  # NMs answer nm_*, not cw_*
+
+    if concurrent:
+        nm_box: List[List[tuple]] = [[]]
+
+        def _pull_nms() -> None:
+            nm_box[0] = pull_snapshots(
+                [a for _nid, a in nm_targets], nm_method,
+                timeout=timeout, grace_s=grace_s,
+                call_kwargs=call_kwargs)
+
+        t = threading.Thread(target=_pull_nms, daemon=True)
+        t.start()
+        cw_replies = pull_snapshots(sorted(sub_addrs), cw_method,
+                                    timeout=timeout, grace_s=grace_s,
+                                    call_kwargs=call_kwargs)
+        t.join(timeout=max(0.1, deadline - monotonic()))
+        nm_replies = nm_box[0]
+        answered = {addr for addr, _r, _t0, _t1 in nm_replies}
+        unreachable = [nid for nid, a in nm_targets if a not in answered]
+        return nm_replies, cw_replies, unreachable
 
     nm_replies = pull_snapshots([a for _nid, a in nm_targets], nm_method,
                                 timeout=timeout, grace_s=grace_s,

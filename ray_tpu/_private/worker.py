@@ -11,6 +11,7 @@ binaries via _private/services.py; a standalone-process mode exists via
 from __future__ import annotations
 
 import atexit
+import logging
 import os
 import shutil
 import tempfile
@@ -19,6 +20,8 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private.ids import JobID
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -160,7 +163,7 @@ def _print_worker_logs(msg) -> None:
                   f"... flood control dropped {msg['dropped']} lines "
                   f"from this stream ({msg.get('dropped_total', 0)} "
                   f"total; `ray_tpu logs` has them)", file=sys.stderr)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - printing logs must never kill the driver
         pass
 
 
@@ -228,8 +231,12 @@ def init(address: Optional[str] = None, *,
     if log_to_driver:
         try:
             cw.subscribe("worker_logs", _print_worker_logs)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 - init proceeds without the
+            # stream, but the operator should know why their console
+            # is silent
+            logger.warning("could not subscribe to worker log stream; "
+                           "worker output will not reach this driver",
+                           exc_info=True)
     _global_worker = Worker(core_worker=cw, mode="driver",
                             gcs_address=gcs_address,
                             node_manager_address=nm_address, node=node,
@@ -252,7 +259,7 @@ def shutdown() -> None:
         if w.node is not None:
             w.node.shutdown()
         w.core_worker.shutdown()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - teardown; components may already be gone
         pass
     # drop cluster-scoped chaos context/rules (a re-init may join a
     # different cluster with different node ids and policy)
@@ -260,7 +267,7 @@ def shutdown() -> None:
     chaos_lib.client().reset()
     try:
         atexit.unregister(shutdown)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - already unregistered
         pass
 
 

@@ -344,7 +344,7 @@ class StandardAutoscaler:
                 info = self._pool.get(tuple(n.address)).call("nm_get_info")
                 workers = self._pool.get(tuple(n.address)).call(
                     "nm_list_workers")
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - node died mid-poll; skip this round
                 continue
             out["pending"] += info.get("num_pending_leases", 0)
             out["pending_shapes"].extend(

@@ -87,7 +87,7 @@ class ClusterStatusReader:
                     "nm_get_info")
                 workers = self._pool.get(tuple(n.address)).call(
                     "nm_list_workers")
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - node died mid-poll; skip this round
                 continue
             nid = n.node_id.hex()
             status.alive_node_ids.append(nid)

@@ -222,7 +222,7 @@ class ClientProxyServer:
                 continue  # merely looked-up named actors aren't ours
             try:
                 self._rt.kill(handle)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - client's actor already dead
                 pass
 
     def cluster_info(self) -> Dict[str, Any]:

@@ -230,7 +230,7 @@ class ClientContext:
         self._release_queue.put(None)
         try:
             self._call("cl_disconnect")
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - server gone; disconnect is best-effort
             pass
         self._rpc.close()
         global _active_context

@@ -172,7 +172,7 @@ class Cluster:
         for node in list(self.worker_nodes):
             try:
                 self.remove_node(node, allow_graceful=True, wait_dead=False)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - node already dead
                 pass
         if self._head is not None:
             self._head.shutdown()

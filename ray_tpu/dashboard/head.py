@@ -9,7 +9,13 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
     GET /api/tasks    — state.list_tasks() (+ ?state= filter)
     GET /api/actors   — state.list_actors()
     GET /api/workers  — state.list_workers()
-    GET /api/objects  — state.list_objects() + store stats
+    GET /api/objects  — state.list_objects() + store stats (+ the
+                        unreachable-node list)
+    GET /api/profile  — task-attributed cluster flamegraph (sampling
+                        profiler fan-out; ?duration=&hz=&format=
+                        speedscope|folded|raw&device=1 + id filters)
+    GET /api/memory   — owner-attributed cluster object table
+                        (?group_by=callsite|actor|node|owner&top=N)
     GET /api/jobs     — job table from the GCS KV
     GET /api/summary  — task-state counts
     GET /metrics      — Prometheus exposition of the CLUSTER-merged
@@ -236,16 +242,56 @@ class DashboardHead:
         if route == "/api/workers":
             return s.list_workers()
         if route == "/api/objects":
-            return {"objects": s.list_objects(),
-                    "store_stats": s.object_store_stats()}
+            objs = s.list_objects()
+            stats = s.object_store_stats()
+            return {"objects": objs["objects"],
+                    "store_stats": stats["stats"],
+                    "unreachable": sorted(set(objs["unreachable"])
+                                          | set(stats["unreachable"]))}
         if route == "/api/summary":
             return s.summarize_tasks()
         if route == "/api/profile/stack":
             # live stack dump (reference dashboard reporter module):
             # ?worker_id=<hex> for one worker, else every live worker
+            # (one batched nm_profile_workers RPC per node)
             if "worker_id" in params:
                 return s.profile_worker_stack(params["worker_id"])
             return s.profile_all_worker_stacks()
+        if route == "/api/profile":
+            # sampling profiler fan-out (_private/profiler.py):
+            # ?duration=&hz=&format=speedscope|folded|raw plus the CLI's
+            # node_id/worker_id/actor/trace_id filters; ?device=1 runs
+            # jax profiler traces and reports xplane dirs
+            out = s.profile(
+                duration=float(params.get("duration", 5.0)),
+                hz=float(params["hz"]) if "hz" in params else None,
+                device=params.get("device") in ("1", "true"),
+                node_id=params.get("node_id"),
+                worker_id=params.get("worker_id"),
+                actor=params.get("actor"),
+                trace_id=params.get("trace_id"))
+            fmt = params.get("format", "speedscope")
+            if params.get("device") in ("1", "true") or fmt == "raw":
+                return out
+            from ray_tpu._private import profiler as profiler_lib
+            if fmt == "folded":
+                return {"folded": profiler_lib.to_folded(
+                    out["profiles"]),
+                    "unreachable": out["unreachable"]}
+            # extra top-level keys are ignored by the speedscope app,
+            # so the unreachable-node list rides the payload rather
+            # than being silently dropped (a merged flamegraph missing
+            # a node must say so)
+            return {**profiler_lib.to_speedscope(out["profiles"]),
+                    "unreachable": out["unreachable"]}
+        if route == "/api/memory":
+            # cluster object table (_private/memory_plane.py):
+            # ?group_by=callsite|actor|node|owner&top=N
+            return s.memory_table(
+                group_by=params.get("group_by"),
+                top=int(params["top"]) if "top" in params else None,
+                timeout=(float(params["timeout"])
+                         if "timeout" in params else None))
         if route == "/api/metrics":
             # harvested snapshots + merged series as JSON;
             # ?history=1 returns the GCS's in-memory time-series ring

@@ -151,5 +151,5 @@ class JobSubmissionClient:
             sup = self._rt.get_actor(f"JOB_SUPERVISOR::{job_id}",
                                      namespace="job")
             self._rt.get(sup.stop_job.remote(), timeout=60)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - supervisor already gone; nothing to stop
             pass

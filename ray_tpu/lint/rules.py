@@ -728,11 +728,89 @@ class BarePrintInFramework(Rule):
                     "suppress with `# graftlint: disable=RT012`)")
 
 
+class SilentExceptionSwallow(Rule):
+    id = "RT013"
+    name = "silent-exception-swallow"
+    rationale = ("a broad `except Exception: pass` on a framework "
+                 "fan-out/state path makes partial failures invisible "
+                 "— a node silently missing from a gather reads as a "
+                 "healthy empty result; the handler must log the "
+                 "error, record it (counter, reply field, unreachable "
+                 "list), or carry a written justification of why "
+                 "swallowing is correct")
+
+    # Same surface split as RT012: code whose purpose is a terminal.
+    _EXEMPT_DIR_PARTS = frozenset(
+        {"tests", "test", "tools", "examples", "benchmarks", "scripts"})
+    # lint-code chunks that do NOT count as justification prose
+    _CODES_RE = re.compile(
+        r"noqa:?\s*[A-Z0-9, ]*|graftlint:\s*disable=[A-Za-z0-9_,\s]*")
+
+    def _exempt(self, path: str) -> bool:
+        parts = [p for p in re.split(r"[\\/]", path) if p]
+        if set(parts) & self._EXEMPT_DIR_PARTS:
+            return True
+        base = os.path.basename(path)
+        return base == "__main__.py" or base.startswith("test_")
+
+    def _prose(self, comment: str) -> bool:
+        """True when the comment contains an actual explanation beyond
+        lint codes — `# noqa: BLE001 - peer gone mid-collect` is a
+        justified suppression, bare `# noqa: BLE001` is not."""
+        text = self._CODES_RE.sub("", comment).strip(" #-—:\t")
+        return len(text) >= 8 and any(c.isalpha() for c in text)
+
+    def _justified(self, ctx: ModuleContext, node: ast.ExceptHandler
+                   ) -> bool:
+        end = max(s.lineno for s in node.body)
+        for lineno in range(node.lineno, end + 1):
+            line = ctx.source_lines[lineno - 1] \
+                if lineno - 1 < len(ctx.source_lines) else ""
+            if "#" in line and self._prose(line[line.index("#"):]):
+                return True
+        # comment-only lines directly ABOVE the except count too (the
+        # idiomatic spot when the reason doesn't fit the except line)
+        for lineno in range(node.lineno - 1, max(0, node.lineno - 3), -1):
+            line = ctx.source_lines[lineno - 1].strip() \
+                if lineno - 1 < len(ctx.source_lines) else ""
+            if not line.startswith("#"):
+                break
+            if self._prose(line):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if self._exempt(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler) \
+                    or node.type is None:
+                continue  # bare except is RT008's
+            elts = node.type.elts if isinstance(node.type, ast.Tuple) \
+                else [node.type]
+            names = {ctx.dotted(e) for e in elts}
+            if not names & {"Exception", "BaseException"}:
+                continue
+            if not all(isinstance(s, (ast.Pass, ast.Continue))
+                       for s in node.body):
+                continue  # handler does SOMETHING with the failure
+            if self._justified(ctx, node):
+                continue
+            yield self.finding(
+                ctx, node,
+                "except Exception with a pass-only body silently "
+                "swallows every failure here; log it, record it "
+                "(counter / reply field / unreachable list), or state "
+                "the reason swallowing is safe in the comment "
+                "(`# noqa: BLE001 - <why>`)")
+
+
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
     ClosureMutationInJit(), ActorCallWithoutRemote(), LeakedObjectRef(),
     DictOrderPytree(), SwallowedException(), StoreViewCopy(),
     WallClockDuration(), MetricNameConvention(), BarePrintInFramework(),
+    SilentExceptionSwallow(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

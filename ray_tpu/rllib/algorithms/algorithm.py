@@ -107,7 +107,7 @@ class EnvRunnerSet:
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - actor already dead
                 pass
 
 

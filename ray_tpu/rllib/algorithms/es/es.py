@@ -200,7 +200,7 @@ class ES(Algorithm):
         for w in self._eval_workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - worker already dead
                 pass
         local = getattr(self, "_local_eval", None)
         if local is not None:

@@ -203,6 +203,6 @@ class LearnerGroup:
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - actor already dead
                 pass
         self._actors = []

@@ -144,10 +144,12 @@ def cmd_list(args) -> int:
         cols = ["worker_id", "pid", "is_actor", "idle", "current_task"]
         rows = [{**r, "worker_id": r["worker_id"][:16]} for r in rows]
     elif kind in ("object", "objects"):
-        rows = s.list_objects()
+        listing = s.list_objects()
+        rows = listing["objects"]
         cols = ["object_id", "size", "pinned", "spilled", "node_id"]
         rows = [{**r, "object_id": r["object_id"][:20],
                  "node_id": r["node_id"][:12]} for r in rows]
+        _warn_unreachable(listing.get("unreachable"))
     elif kind in ("placement_group", "placement_groups"):
         rows = s.list_placement_groups()
         cols = ["placement_group_id", "state", "strategy", "bundles"]
@@ -198,14 +200,120 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def _warn_unreachable(unreachable) -> None:
+    if unreachable:
+        print(f"(warning: {len(unreachable)} node(s) unreachable — "
+              f"results are incomplete: "
+              f"{[str(n)[:12] for n in unreachable]})", file=sys.stderr)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
 def cmd_memory(args) -> int:
+    """Owner-attributed memory accounting (see README "Profiling &
+    memory attribution"): per-node store stats plus the cluster object
+    table — who owns each object, what holds it alive (pins / borrows
+    / leases), where bytes are resident — optionally grouped by
+    callsite / actor / node / owner."""
     _connect(args)
     from ray_tpu.util import state as s
-    for st in s.object_store_stats():
+    table = s.memory_table(group_by=args.group_by, top=args.top,
+                           timeout=args.timeout)
+    stats = s.object_store_stats()
+    if args.format == "json":
+        print(json.dumps({**table, "store_stats": stats["stats"],
+                          "stats_unreachable": stats["unreachable"]},
+                         default=str))
+        return 0
+    for st in stats["stats"]:
         print(f"node {st['node_id'][:12]}: "
               f"{st['used']}/{st['capacity']} bytes, "
               f"{st['num_objects']} objects, "
               f"spilled {st['num_spilled']}, restored {st['num_restored']}")
+    if args.group_by:
+        print(f"\n== objects by {args.group_by}")
+        _print_table(
+            [{**g, "bytes": _fmt_bytes(g["bytes"])}
+             for g in table["groups"]],
+            [args.group_by, "objects", "bytes", "pinned", "leases",
+             "borrower_pins"])
+    else:
+        rows = table["objects"][:args.top or 20]
+        total = table.get("total_objects", len(table["objects"]))
+        print(f"\n== top {len(rows)} objects (of {total})")
+        _print_table(
+            [{"object_id": r["object_id"][:20],
+              "size": _fmt_bytes(r.get("size")),
+              "owner": (r.get("owner") or "?"),
+              "state": r.get("owner_state") or "?",
+              "refs": r["local_refs"],
+              "pins": sum(int(res.get("pinned") or 0)
+                          for res in r["residency"]),
+              "borrowers": r["borrowers"],
+              "leases": r["replica_leases"],
+              "nodes": ",".join(sorted(
+                  {str(res["node_id"])[:8] for res in r["residency"]
+                   if res.get("node_id")})) or "-",
+              "callsite": (r.get("callsite") or "-")[-40:]}
+             for r in rows],
+            ["object_id", "size", "owner", "state", "refs", "pins",
+             "borrowers", "leases", "nodes", "callsite"])
+    if table.get("objects_dropped"):
+        print(f"({table['objects_dropped']} object record(s) over the "
+              f"per-process snapshot cap were dropped)", file=sys.stderr)
+    _warn_unreachable(
+        list(table.get("unreachable") or [])
+        + [n for n in stats["unreachable"]
+           if n not in (table.get("unreachable") or [])])
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Cluster flamegraph (see README "Profiling & memory
+    attribution"): sample every process for --duration seconds at
+    --hz, task/actor/trace-attributed, and write speedscope JSON (load
+    at https://www.speedscope.app) or collapsed folded text
+    (flamegraph.pl). --device runs jax profiler traces instead."""
+    _connect(args)
+    from ray_tpu._private import profiler as profiler_lib
+    from ray_tpu.util import state as s
+    out = s.profile(duration=args.duration, hz=args.hz,
+                    device=args.device, node_id=args.node_id,
+                    worker_id=args.worker_id, actor=args.actor,
+                    trace_id=args.trace_id)
+    if args.device:
+        for p in out["profiles"]:
+            tag = p.get("xplane_dir") or p.get("skipped") \
+                or p.get("error") or "?"
+            print(f"{p.get('label', '?')}: {tag}")
+        _warn_unreachable(out.get("unreachable"))
+        return 0
+    profiles = out["profiles"]
+    samples = sum(p.get("samples", 0) for p in profiles)
+    dropped = sum(p.get("dropped", 0) for p in profiles)
+    if args.format == "folded":
+        output = args.output or "/tmp/ray_tpu_profile.folded"
+        with open(output, "w") as f:
+            f.write(profiler_lib.to_folded(profiles))
+    else:
+        output = args.output or "/tmp/ray_tpu_profile.json"
+        with open(output, "w") as f:
+            json.dump(profiler_lib.to_speedscope(profiles), f)
+    print(f"profiled {len(profiles)} process(es): {samples} samples "
+          f"@ {out['hz']:g}hz over {out['duration_s']:g}s"
+          + (f" ({dropped} samples over the stack cap dropped)"
+             if dropped else ""))
+    print(f"wrote {output}"
+          + ("" if args.format == "folded"
+             else " (load at https://www.speedscope.app)"))
+    _warn_unreachable(out.get("unreachable"))
     return 0
 
 
@@ -507,11 +615,48 @@ def main(argv=None) -> int:
     p = sub.add_parser("stop", help="stop local ray_tpu processes")
     p.set_defaults(fn=cmd_stop)
 
-    for name, fn in (("status", cmd_status), ("summary", cmd_summary),
-                     ("memory", cmd_memory)):
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
         p = sub.add_parser(name)
         p.add_argument("--address", default=None)
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("memory", help="owner-attributed memory "
+                                      "accounting: cluster object table "
+                                      "+ per-node store stats")
+    p.add_argument("--address", default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--group-by", choices=("callsite", "actor", "node",
+                                          "owner"), default=None,
+                   help="aggregate objects (callsite needs "
+                        "RAY_TPU_memory_callsite_capture=1)")
+    p.add_argument("--top", type=int, default=None,
+                   help="largest N objects/groups (default 20 objects)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="overall fan-out deadline (seconds)")
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("profile", help="task-attributed cluster "
+                                       "flamegraph (speedscope/folded)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="sampling window in seconds")
+    p.add_argument("--hz", type=float, default=None,
+                   help="samples per second (default "
+                        "Config.profile_default_hz = 100)")
+    p.add_argument("--format", choices=("speedscope", "folded"),
+                   default="speedscope")
+    p.add_argument("--output", "-o", default=None,
+                   help="default /tmp/ray_tpu_profile.{json,folded}")
+    p.add_argument("--node-id", default=None, help="node id prefix")
+    p.add_argument("--worker-id", default=None, help="worker id prefix")
+    p.add_argument("--actor", default=None,
+                   help="actor NAME or actor id prefix")
+    p.add_argument("--trace-id", default=None,
+                   help="keep only samples inside this trace")
+    p.add_argument("--device", action="store_true",
+                   help="jax profiler traces on device-hosting workers "
+                        "(reports xplane dirs) instead of CPU sampling")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", help="tasks|actors|nodes|workers|objects|"

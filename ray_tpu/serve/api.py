@@ -426,11 +426,11 @@ def shutdown() -> None:
         return
     try:
         ray_tpu.get(controller.shutdown.remote(), timeout=120)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - wedged; the kill below is the backstop
         pass
     try:
         ray_tpu.kill(controller)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - controller already dead
         pass
 
 

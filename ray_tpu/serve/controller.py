@@ -372,7 +372,7 @@ class ServeController:
         for r in replicas:
             try:
                 ray_tpu.kill(r)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - replica already dead
                 pass
 
     def _reconcile_one(self, state: _DeploymentState) -> None:

@@ -212,7 +212,7 @@ class BackendExecutor:
             try:
                 self._backend.on_shutdown(self.worker_group,
                                           self._backend_config)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - backend teardown is best-effort
                 pass
             self.worker_group.shutdown()
             self.worker_group = None

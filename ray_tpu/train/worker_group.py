@@ -122,11 +122,11 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - worker already dead
                 pass
         try:
             remove_placement_group(self._pg)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - group already removed
             pass
         self.workers = []
 

@@ -42,7 +42,7 @@ class _TrialRunner:
         restoring a checkpoint — no actor churn, no scheduling race."""
         try:
             self._t.stop()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - old trainable already stopped or broken
             pass
         self._t = self._factory(config)
         if checkpoint_dir:
@@ -209,11 +209,11 @@ class TuneController:
                         trial.actor.save.remote(self._next_ckpt_dir(trial)),
                         timeout=300)
                 ray_tpu.get(trial.actor.stop.remote(), timeout=60)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - wedged/dead; kill below is the backstop
                 pass
             try:
                 ray_tpu.kill(trial.actor)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - actor already dead
                 pass
         trial.actor = None
         trial.in_flight = None
@@ -405,7 +405,7 @@ class TuneController:
             trial.checkpoint_dir or "scratch")
         try:
             ray_tpu.kill(trial.actor)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - actor already dead
             pass
         try:
             self._start_trial(trial, restore=True)

@@ -274,5 +274,5 @@ class FaultTolerantActorManager:
         for a in actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - actor already dead
                 pass

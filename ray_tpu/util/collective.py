@@ -180,7 +180,7 @@ def destroy_collective_group(group_name: str = "default") -> None:
         import ray_tpu
         try:
             ray_tpu.kill(state.coordinator)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - coordinator already dead
             pass
 
 

@@ -178,7 +178,7 @@ class Queue:
     def shutdown(self) -> None:
         try:
             ray_tpu.kill(self._actor)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - queue actor already dead
             pass
 
 

@@ -80,7 +80,7 @@ def _workers_by_node() -> Dict[Any, List[Dict[str, Any]]]:
         try:
             out[tuple(n.address)] = _pool().get(
                 tuple(n.address)).call("nm_list_workers")
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - node died mid-listing; treated as absent
             pass
     return out
 
@@ -102,27 +102,36 @@ def profile_worker_stack(worker_id: str,
 
 def profile_all_worker_stacks(timeout: float = 3.0
                               ) -> List[Dict[str, Any]]:
-    """Stack dumps for every live worker — one worker-list RPC per
-    node (not per worker), dumps issued node by node."""
+    """Stack dumps for every live worker: ONE `nm_profile_workers` RPC
+    per node — each node signals and collects all its workers in
+    parallel — fanned out across nodes under a single overall deadline
+    (the per-worker serial round trips this replaces scaled as
+    nodes x workers). Nodes that don't answer contribute an error
+    entry instead of stalling the dump."""
+    from ray_tpu._private import spans as spans_lib
+    alive = [n for n in _gcs().call("get_all_nodes") if n.alive]
+    replies = spans_lib.pull_snapshots(
+        [tuple(n.address) for n in alive], "nm_profile_workers",
+        timeout=timeout + 2.0, call_kwargs={"timeout": timeout})
+    answered = {addr for addr, _r, _t0, _t1 in replies}
     out: List[Dict[str, Any]] = []
-    for addr, workers in _workers_by_node().items():
-        for w in workers:
-            if w.get("pid") is None:
-                continue
-            try:
-                out.append(_pool().get(addr).call(
-                    "nm_profile_worker",
-                    worker_id_hex=w["worker_id"], timeout=timeout))
-            except Exception as e:  # noqa: BLE001
-                out.append({"worker_id": w["worker_id"],
-                            "pid": w.get("pid"), "stack": "",
-                            "error": str(e)})
+    for _addr, reply, _t0, _t1 in replies:
+        out.extend(reply.get("dumps", ()))
+    for n in alive:
+        if tuple(n.address) not in answered:
+            out.append({"worker_id": None, "pid": None, "stack": "",
+                        "node_id": n.node_id.hex(),
+                        "error": "node unreachable within deadline"})
     return out
 
 
-def list_objects() -> List[Dict[str, Any]]:
-    """Objects resident in every alive node's shared-memory store."""
+def list_objects() -> Dict[str, Any]:
+    """Objects resident in every alive node's shared-memory store:
+    {"objects": [...], "unreachable": [node ids]} — like logs_query, a
+    node that doesn't answer is NAMED rather than silently absent (an
+    empty-looking store on an unreachable node is not an empty store)."""
     out: List[Dict[str, Any]] = []
+    unreachable: List[str] = []
     for n in _gcs().call("get_all_nodes"):
         if not n.alive:
             continue
@@ -130,9 +139,9 @@ def list_objects() -> List[Dict[str, Any]]:
             for rec in _pool().get(tuple(n.store_address)).call("store_list"):
                 rec["node_id"] = n.node_id.hex()
                 out.append(rec)
-        except Exception:  # noqa: BLE001
-            pass
-    return out
+        except Exception:  # noqa: BLE001 - named in the reply instead
+            unreachable.append(n.node_id.hex())
+    return {"objects": out, "unreachable": unreachable}
 
 
 def list_placement_groups() -> List[Dict[str, Any]]:
@@ -340,9 +349,12 @@ def list_cluster_events(event_type: Optional[str] = None,
                        severity=severity, limit=limit)
 
 
-def object_store_stats() -> List[Dict[str, Any]]:
-    """Per-node store stats incl. spill/restore counters (`ray memory`)."""
+def object_store_stats() -> Dict[str, Any]:
+    """Per-node store stats incl. spill/restore counters (`ray memory`):
+    {"stats": [...], "unreachable": [node ids]} — unreachable nodes are
+    named, matching logs_query semantics."""
     out = []
+    unreachable: List[str] = []
     for n in _gcs().call("get_all_nodes"):
         if not n.alive:
             continue
@@ -350,6 +362,57 @@ def object_store_stats() -> List[Dict[str, Any]]:
             stats = _pool().get(tuple(n.store_address)).call("store_stats")
             stats["node_id"] = n.node_id.hex()
             out.append(stats)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 - named in the reply instead
+            unreachable.append(n.node_id.hex())
+    return {"stats": out, "unreachable": unreachable}
+
+
+def profile(duration: float = 5.0, hz: Optional[float] = None,
+            device: bool = False,
+            node_id: Optional[str] = None,
+            worker_id: Optional[str] = None,
+            actor: Optional[str] = None,
+            trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster CPU profile (`ray_tpu profile`, dashboard /api/profile):
+    one GCS fan-out samples every process's threads for `duration`
+    seconds at `hz`, task/actor/trace-attributed, merged clock-free.
+    Returns {"profiles": [per-process folded-stack profiles],
+    "unreachable": [node ids], ...} — render with
+    profiler.to_speedscope / to_folded. Filters select processes by
+    node/worker/actor id prefix (actor also takes a name) and stacks by
+    trace id. device=True instead runs jax profiler traces on
+    jax-initialized workers and reports xplane dirs."""
+    from ray_tpu._private import profiler as profiler_lib
+    from ray_tpu._private.config import Config
+    out = _gcs().call("profile_collect",
+                      duration_s=duration,
+                      hz=float(hz if hz is not None
+                               else Config.profile_default_hz),
+                      device=device)
+    if not device and (node_id or worker_id or actor or trace_id):
+        out["profiles"] = profiler_lib.filter_profiles(
+            out["profiles"], node_id=node_id, worker_id=worker_id,
+            actor_id=_resolve_actor_filter(actor),
+            trace_id=trace_id)
+    return out
+
+
+def memory_table(group_by: Optional[str] = None,
+                 top: Optional[int] = None,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Cluster object table (`ray_tpu memory`): every object joined
+    across its owner's reference table and the stores where bytes are
+    resident — owner identity, local refs, borrower pins, reader
+    leases, creation callsite (when RAY_TPU_memory_callsite_capture=1),
+    and per-node residency (size/pinned/leases/age, primary vs
+    replica). group_by aggregates rows by callsite|actor|node|owner;
+    `top` keeps the N largest. Unreachable nodes are named."""
+    from ray_tpu._private import memory_plane as memory_plane_lib
+    out = _gcs().call("memory_collect", timeout=timeout)
+    out["total_objects"] = len(out["objects"])
+    if top and not group_by:
+        out["objects"] = out["objects"][:int(top)]
+    if group_by:
+        out["groups"] = memory_plane_lib.group_rows(
+            out["objects"], group_by, top=top)
     return out

@@ -130,7 +130,7 @@ def test_max_pending_calls_backpressure():
     @ray_tpu.remote
     class Slow:
         def work(self, marker):
-            time.sleep(5.0)
+            time.sleep(2.0)
             return marker
 
         def fast(self):
@@ -176,7 +176,7 @@ def test_named_lookup_carries_max_pending_calls():
     @ray_tpu.remote
     class Slow2:
         def work(self):
-            time.sleep(4.0)
+            time.sleep(1.5)
             return 1
 
     a = Slow2.options(name="bounded", max_pending_calls=1).remote()

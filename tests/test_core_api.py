@@ -120,7 +120,7 @@ def test_wait(ray_start):
 def test_get_timeout(ray_start):
     @ray_tpu.remote
     def sleepy():
-        time.sleep(5)
+        time.sleep(2)
         return 1
 
     with pytest.raises(exc.GetTimeoutError):

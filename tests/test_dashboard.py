@@ -76,8 +76,10 @@ def test_live_stack_profiling(ray_start):
 
     @ray_tpu.remote
     def spin_marker_fn():
+        # just long enough to be caught mid-flight by the dump below
+        # (detect ~1s + dump ~1s); 20s here was pure suite wall-burn
         t0 = _time.time()
-        while _time.time() - t0 < 20:
+        while _time.time() - t0 < 6:
             _time.sleep(0.05)
         return 1
 

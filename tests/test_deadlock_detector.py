@@ -210,7 +210,7 @@ def test_multi_ref_get_releases_resolved_edges(ray_start):
             return "fast"
 
         def slow(self):
-            time.sleep(4.0)
+            time.sleep(2.5)
             return "slow"
 
         def echo(self):

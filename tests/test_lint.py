@@ -848,10 +848,104 @@ def test_rt012_terminal_facing_paths_exempt(path):
     assert not any(f.rule_id == "RT012" for f in fs), path
 
 
+# ---- RT013 silent exception swallow ---------------------------------------
+
+RT013_POS = """
+    def gather(peers):
+        out = []
+        for p in peers:
+            try:
+                out.append(p.call("snapshot"))
+            except Exception:  # noqa: BLE001
+                pass
+        return out
+"""
+
+RT013_JUSTIFIED_SAME_LINE = """
+    def release(client):
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001 - peer gone mid-collect
+            pass
+"""
+
+RT013_JUSTIFIED_COMMENT_ABOVE = """
+    def release(client):
+        try:
+            client.close()
+        # best-effort during teardown: the peer may already be gone
+        except Exception:  # noqa: BLE001
+            pass
+"""
+
+RT013_SUPPRESSED = """
+    def release(client):
+        try:
+            client.close()
+        except Exception:  # graftlint: disable=RT013
+            pass
+"""
+
+RT013_NEG_HANDLED = """
+    import logging
+    logger = logging.getLogger(__name__)
+
+    def gather(peers):
+        out = []
+        for p in peers:
+            try:
+                out.append(p.call("snapshot"))
+            except Exception:  # noqa: BLE001
+                logger.warning("peer %s dropped from gather", p,
+                               exc_info=True)
+        return out
+"""
+
+RT013_NEG_NARROW = """
+    def release(client):
+        try:
+            client.close()
+        except OSError:
+            pass
+"""
+
+
+def test_rt013_silent_swallow_flagged():
+    assert "RT013" in rules_hit(RT013_POS)
+
+
+def test_rt013_bare_noqa_is_not_justification():
+    # a lint-code-only comment states no reason; the whole point is
+    # that the WHY is written down
+    fs = [f for f in findings(RT013_POS) if f.rule_id == "RT013"]
+    assert fs and "swallows" in fs[0].message
+
+
+def test_rt013_justified_suppressions_pass():
+    assert "RT013" not in rules_hit(RT013_JUSTIFIED_SAME_LINE)
+    assert "RT013" not in rules_hit(RT013_JUSTIFIED_COMMENT_ABOVE)
+    assert "RT013" not in rules_hit(RT013_SUPPRESSED)
+
+
+def test_rt013_logged_or_narrow_handlers_pass():
+    assert "RT013" not in rules_hit(RT013_NEG_HANDLED)
+    assert "RT013" not in rules_hit(RT013_NEG_NARROW)
+
+
+@pytest.mark.parametrize("path", [
+    "tools/bench.py", "examples/demo.py", "tests/test_x.py",
+    "ray_tpu/scripts/cli.py",
+])
+def test_rt013_terminal_facing_paths_exempt(path):
+    import textwrap as _tw
+    fs = lint_source(_tw.dedent(RT013_POS), path)
+    assert not any(f.rule_id == "RT013" for f in fs), path
+
+
 def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == [f"RT00{i}" for i in range(1, 10)] + \
-        ["RT010", "RT011", "RT012"]
+        ["RT010", "RT011", "RT012", "RT013"]
     assert all(r.rationale for r in ALL_RULES)
 
 

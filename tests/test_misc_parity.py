@@ -254,6 +254,7 @@ print("REAP_OK")
     assert "REAP_OK" in out.stdout
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 8): torch.distributed gloo init costs ~19s; torch-parity only
 def test_torch_trainer_gloo_allreduce(ray_start):
     """TorchTrainer parity row (§8.4): gloo process group over the gang,
     DDP-style gradient averaging on CPU torch."""

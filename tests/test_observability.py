@@ -85,10 +85,14 @@ def test_list_actors_and_workers(ray_start):
 def test_list_objects_and_store_stats(ray_start):
     import numpy as np
     ref = ray_tpu.put(np.zeros(64 * 1024))  # > inline threshold
-    objs = state_api.list_objects()
-    assert any(o["object_id"] == ref.hex() for o in objs)
+    listing = state_api.list_objects()
+    assert any(o["object_id"] == ref.hex() for o in listing["objects"])
+    # every alive node answered → the unreachable list is empty (the
+    # logs_query-style contract: silent absence is not allowed)
+    assert listing["unreachable"] == []
     stats = state_api.object_store_stats()
-    assert stats and stats[0]["capacity"] > 0
+    assert stats["stats"] and stats["stats"][0]["capacity"] > 0
+    assert stats["unreachable"] == []
     del ref
 
 

@@ -53,6 +53,7 @@ def test_pip_uri_is_content_addressed():
     assert a == pip_uri(pip_spec({"pip": ["x==1"]}))
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 8): runs a real pip install (~11s); spec/GC units stay in tier-1
 def test_manager_installs_and_caches(tmp_path):
     src = _make_pkg(tmp_path, value=41)
     mgr = RuntimeEnvManager(cache_dir=str(tmp_path / "cache"))
@@ -76,6 +77,7 @@ def test_manager_gc_evicts_lru(tmp_path):
     assert removed == ["pip-fake-0"]  # oldest stamp evicted
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 8): real pip install + worker spawn (~15s)
 def test_worker_imports_pip_env_package(tmp_path):
     """End to end: a task under runtime_env={'pip': [...]} imports the
     installed package inside the worker; a task without the env cannot."""

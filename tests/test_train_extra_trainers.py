@@ -99,6 +99,9 @@ def test_transformers_trainer_tiny_bert(tmp_path):
         result.metrics_history
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 8): accelerate worker
+# spawn cycle (~21s); sklearn/transformers trainers keep this
+# file's trainer surface in tier-1
 def test_accelerate_trainer_runs_loop(tmp_path):
     """AccelerateTrainer (reference train/huggingface/accelerate): an
     unmodified Accelerate loop — Accelerator(), prepare(model,
@@ -142,6 +145,7 @@ def test_accelerate_trainer_runs_loop(tmp_path):
     assert len(losses) == 3 and losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 8): second accelerate worker-spawn cycle (~33s); runs_loop keeps the accelerate path covered in tier-1
 def test_accelerate_config_file_propagates_to_workers(tmp_path):
     """reference accelerate_trainer.py:44-110: the driver-side config
     file (plus a nested deepspeed json) ships by value, materializes on

@@ -19,6 +19,7 @@ def _cluster(ray_start):
     """All tests here run on the shared session cluster."""
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 8): TF import alone costs ~70s across 2 workers on this box
 def test_tf_config_set_per_rank():
     # defined inside the test so cloudpickle ships it by value
     def _loop():

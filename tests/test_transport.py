@@ -270,8 +270,12 @@ def _peer_cls(rt):
         def run_batched(self, b, c):
             # batched get: the fast ref (b) resolves mid-get while the
             # slow one (c) keeps us blocked — b's wait edge must drop
-            # the moment its ref resolves, not when the batch returns
-            refs = [b.busy.remote(0.6), c.busy.remote(2.0)]
+            # the moment its ref resolves, not when the batch returns.
+            # b's run time must comfortably exceed WAIT_EDGE_GRACE_S
+            # (0.2s) PLUS dispatch lag on a contended 2-core box, or
+            # the A->B edge can resolve before it ever registers
+            # (observed flaking at 0.6s under a full-suite run).
+            refs = [b.busy.remote(2.0), c.busy.remote(5.0)]
             return rt.get(refs)  # graftlint: disable=RT001
 
         def ask(self, a):
@@ -319,7 +323,7 @@ def test_batched_get_keeps_per_ref_wait_edges(ray_start):
     # now B blocking on A is safe: B->A->C has no cycle. A stale A->B
     # edge would have made this a false DeadlockError.
     assert rt.get(b.ask.remote(a), timeout=60) == "echo"
-    assert rt.get(r_run, timeout=60) == [0.6, 2.0]
+    assert rt.get(r_run, timeout=60) == [2.0, 5.0]
     # the graph drains once everything resolves
     deadline = time.time() + 10
     while _edges(rt) and time.time() < deadline:

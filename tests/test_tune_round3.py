@@ -187,6 +187,9 @@ class TestPBTEndToEnd:
                 if r.metrics_history[0]["score"] < 10.0][0]
         assert weak.metrics["score"] > 30.0
 
+    @pytest.mark.slow  # wall-time budget (ISSUE 8): second full
+    # PBT loop (~23s); test_pbt_transfers_checkpoint_and_config
+    # keeps checkpoint/restore coverage in tier-1
     def test_tuner_restore_resumes_unfinished(self, tmp_path):
         from ray_tpu.tune import grid_search
         # phase 1: run with a tiny time budget so trials get cut off

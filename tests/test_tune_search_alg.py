@@ -71,6 +71,7 @@ def test_optuna_adapter_importerror_without_optuna():
         tune.OptunaSearcher({"x": uniform(0, 1)}, metric="m")
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 8): full tuner loop (~21s); the TPE unit tests above cover the search-alg math in tier-1
 def test_tuner_with_search_alg_end_to_end():
     """Tuner drives the searcher sequentially: trials get suggested
     configs and results flow back (observations accumulate)."""
