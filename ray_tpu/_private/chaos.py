@@ -44,6 +44,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
+from ray_tpu.util.locks import TracedLock
 
 logger = logging.getLogger(__name__)
 
@@ -149,7 +150,7 @@ class ChaosClient:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = TracedLock("chaos")
         self._rules: List[_RuleState] = []
         self._version = -1
         self.active = False
@@ -186,7 +187,8 @@ class ChaosClient:
                 self.gcs_address = tuple(gcs_address)
 
     def set_actor_class(self, class_name: str) -> None:
-        self.actor_class = class_name
+        with self._lock:
+            self.actor_class = class_name
 
     def reset(self) -> None:
         """Forget cluster-scoped state (context + distributed rules) so
@@ -215,12 +217,14 @@ class ChaosClient:
     def set_kill_actuator(self, fn: Callable[[str], None]) -> None:
         """Node manager registers how kill_worker rules targeting its
         node take effect (kill a matching local worker process)."""
-        self._kill_actuator = fn
+        with self._lock:
+            self._kill_actuator = fn
 
     def set_predeath_hook(self, fn: Callable[[str], Any]) -> None:
         """Worker registers its black-box flight-dump writer, run just
         before a self-kill fault exits the process."""
-        self._predeath_hook = fn
+        with self._lock:
+            self._predeath_hook = fn
 
     # ---- policy install ----------------------------------------------
 

@@ -72,6 +72,11 @@ class Config:
     watchdog_store_occupancy_frac = _define(
         "watchdog_store_occupancy_frac", 0.95, float)
     watchdog_queue_depth = _define("watchdog_queue_depth", 256, int)
+    # Lockdep plane (ray_tpu/util/locks.py): the watchdog's
+    # long-hold-with-waiters probe alerts when a traced lock has been
+    # held longer than this while at least this many threads queue.
+    watchdog_lock_hold_s = _define("watchdog_lock_hold_s", 5.0, float)
+    watchdog_lock_waiters = _define("watchdog_lock_waiters", 1, int)
     # Debug plane (_private/log_plane.py + log_monitor.py): per-worker
     # in-memory tail index depth, driver-stream flood control (per-source
     # token bucket), and crash-postmortem bundle sizes.

@@ -38,6 +38,8 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreFullError, StoreClient
 from ray_tpu._private.state import TaskSpec, TaskType
 from ray_tpu._private.task_events import TaskEventBuffer, now as _ev_now
+from ray_tpu.util import locks as _locks_util
+from ray_tpu.util.locks import TracedLock, TracedRLock
 
 logger = logging.getLogger(__name__)
 
@@ -203,7 +205,7 @@ class CoreWorker:
         # placement group of the currently-executing task/actor, if any
         self.current_placement_group_id = None
 
-        self._lock = threading.RLock()
+        self._lock = TracedRLock("core_worker")
         # Owner-side object directory: oid hex -> (tag, ...) location
         self.objects: Dict[str, Tuple] = {}
         self.object_events: Dict[str, threading.Event] = {}
@@ -232,6 +234,13 @@ class CoreWorker:
         # One long-lived drainer for borrow releases instead of a thread
         # per dropped ref (releases are fire-and-forget, order irrelevant).
         self._borrow_release_queue: "queue.Queue" = queue.Queue()
+        # LOCAL store deletes pending on the drainer (guarded by
+        # self._lock). Kept OUT of the FIFO queue: a remote release to
+        # a dead node can block one queue item for the pool's full
+        # connect timeout, and local frees must not strand store bytes
+        # behind it — the drainer batch-flushes this list every
+        # iteration, so local eviction lags by at most one item.
+        self._local_free_pending: List[str] = []
         # enclosing-result oid hex -> [(owner_addr, nested oid hex)]
         # eager borrows on refs embedded in task results (see
         # _register_nested_borrows)
@@ -317,6 +326,9 @@ class CoreWorker:
             # memory attribution plane (_private/memory_plane.py):
             # owner-side reference-table dump for `ray_tpu memory`
             "cw_memory_snapshot": self.memory_snapshot,
+            # lockdep plane (ray_tpu/util/locks.py): traced-lock
+            # snapshot for `ray_tpu locks` / /api/locks
+            "cw_locks_snapshot": _locks_util.snapshot,
         }
         self.executor: Optional[_Executor] = None
         if mode == "worker":
@@ -684,11 +696,17 @@ class CoreWorker:
                 self._borrow_release_queue.put(
                     ("store_delete", primary_addr, oid_hex))
             try:
-                # local copy (the primary, or a pulled replica) + the
-                # client-side mmap release either way
-                self.store.delete([oid_hex])
+                # client-side mmap release only (no RPC): local views
+                # die with the ref. The LOCAL store's delete is an RPC
+                # round trip too (StoreClient.delete -> store_delete),
+                # and under self._lock it stalled every worker
+                # operation whenever the store server was slow
+                # (RT015); the drainer batch-flushes it off the lock.
+                self.store.release_views([oid_hex])
             except Exception:  # noqa: BLE001 - store gone; probe flags leftovers
                 pass
+            self._local_free_pending.append(oid_hex)
+            self._borrow_release_queue.put(("local_free",))
             # residency-mismatch probe input: this object SHOULD now be
             # gone from every store. Timestamped so the digest can hold
             # a just-freed object back while the queued remote delete
@@ -769,12 +787,37 @@ class CoreWorker:
             except Exception:  # noqa: BLE001
                 logger.exception("done callback failed")
 
+    def _drain_local_frees(self) -> None:
+        """Flush pending LOCAL store deletes in one batched ONE-WAY
+        send. Runs on the drainer thread (never under self._lock) at
+        every loop iteration, so local frees overtake remote releases
+        that may be blocked connecting to dead nodes. Deliberately NOT
+        StoreClient.delete: that client's channel is shared with the
+        put/get hot path, and a slow store_delete handler would hold
+        its per-call lock against the next put for the handler's full
+        duration — the pool connection (the one the remote-primary
+        delete path already uses) keeps the stall off the data path,
+        and a one-way send never waits on the handler at all."""
+        with self._lock:
+            batch, self._local_free_pending = \
+                self._local_free_pending, []
+        if batch:
+            try:
+                self._pool.get(self.store.address).send_oneway(
+                    "store_delete", object_ids=batch)
+            except Exception:  # noqa: BLE001 - store gone; the
+                pass           # residency probe flags leftovers
+
     def _borrow_release_loop(self) -> None:
         while not self._shutdown:
             try:
                 self._expire_ttl_pins()
             except Exception:  # noqa: BLE001
                 logger.exception("ttl pin expiry failed")
+            try:
+                self._drain_local_frees()
+            except Exception:  # noqa: BLE001
+                logger.exception("local free drain failed")
             try:
                 item = self._borrow_release_queue.get(timeout=10.0)
             except queue.Empty:
@@ -786,6 +829,8 @@ class CoreWorker:
                 continue
             if item is None:
                 return
+            if len(item) == 1:
+                continue  # local_free wake: drained at loop top
             if len(item) == 3 and item[0] == "store_delete":
                 # remote-primary free queued by _maybe_free_locked (the
                 # connect must happen OFF the CoreWorker lock)
@@ -2494,12 +2539,16 @@ class CoreWorker:
         _profiler.sampler().stop()
         # Drain queued borrow releases before tearing the process down so a
         # clean exit doesn't strand pins at owners.
+        try:
+            self._drain_local_frees()
+        except Exception:  # noqa: BLE001 - store may already be gone
+            pass
         while True:
             try:
                 item = self._borrow_release_queue.get_nowait()
             except queue.Empty:
                 break
-            if item is None:
+            if item is None or len(item) == 1:
                 continue
             try:
                 if len(item) == 3 and item[0] == "store_delete":
@@ -2552,7 +2601,7 @@ class _Executor:
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
         self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = TracedLock("executor")
         # per-owner seq reordering
         self._next_seq: Dict[str, int] = {}
         self._buffer: Dict[str, Dict[int, TaskSpec]] = {}

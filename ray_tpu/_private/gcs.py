@@ -22,6 +22,7 @@ from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private.ids import ActorID, JobID, NodeID, WorkerID
 from ray_tpu._private.state import (ActorInfo, NodeInfo, PlacementGroupInfo,
                                     ResourceSet, TaskSpec)
+from ray_tpu.util.locks import TracedLock
 
 logger = logging.getLogger(__name__)
 
@@ -31,7 +32,7 @@ class InMemoryStore:
 
     def __init__(self) -> None:
         self._tables: Dict[str, Dict[str, Any]] = {}
-        self._lock = threading.Lock()
+        self._lock = TracedLock("gcs_store")
 
     def put(self, table: str, key: str, value: Any) -> None:
         with self._lock:
@@ -73,7 +74,7 @@ class PersistentStore(InMemoryStore):
         super().__init__()
         self.path = path
         self._dirty = False
-        self._flush_lock = threading.Lock()
+        self._flush_lock = TracedLock("gcs_flush")
         if os.path.exists(path):
             import pickle as _pickle
             try:
@@ -152,7 +153,7 @@ class GcsServer:
         self.store = PersistentStore(persist_path) if persist_path \
             else InMemoryStore()
         self._pool = rpc_lib.ClientPool(timeout=30)
-        self._lock = threading.Lock()
+        self._lock = TracedLock("gcs")
         # node_id hex -> NodeInfo
         self.nodes: Dict[str, NodeInfo] = {}
         # node_id hex -> {resource: available} (synced by node managers)
@@ -261,6 +262,9 @@ class GcsServer:
             # memory attribution plane: cluster object table (`ray_tpu
             # memory`, dashboard /api/memory; _private/memory_plane.py)
             "memory_collect": self.memory_collect,
+            # lockdep plane: traced-lock snapshots + order graphs
+            # (`ray_tpu locks`, dashboard /api/locks; util/locks.py)
+            "locks_collect": self.locks_collect,
             # debug plane: attributed-log fan-out + crash postmortems
             # (`ray_tpu logs`, dashboard /api/logs + /api/postmortems)
             "logs_query": self.logs_query,
@@ -805,6 +809,33 @@ class GcsServer:
                 "objects_dropped": sum(
                     int(s.get("objects_dropped") or 0)
                     for s in proc_snaps),
+                "unreachable": unreachable}
+
+    # ---- lockdep plane (see ray_tpu/util/locks.py) ----------------------
+
+    LOCKS_COLLECT_TIMEOUT_S = 5.0
+
+    def locks_collect(self, timeout: Optional[float] = None
+                      ) -> Dict[str, Any]:
+        """Cluster lock-plane gather: every process's traced-lock
+        snapshot (per-name hold stats + waiters + holder attribution +
+        acquisition-order edge graph with any cycle) over the shared
+        two-phase fan-out, under one overall deadline. Reply names the
+        nodes that did not answer."""
+        from ray_tpu._private import spans as spans_lib
+        from ray_tpu.util import locks as locks_lib
+        t = float(timeout) if timeout else self.LOCKS_COLLECT_TIMEOUT_S
+        own = locks_lib.snapshot()
+        nm_replies, cw_replies, unreachable = \
+            spans_lib.gather_cluster_snapshots(
+                self, "nm_locks_snapshot", "cw_locks_snapshot",
+                timeout=t, grace_s=1.0)
+        gathered: List[Dict[str, Any]] = []
+        for _addr, reply, _t0, _t1 in nm_replies:
+            gathered.extend(reply.get("snapshots", ()))
+        gathered.extend(snap for _a, snap, _t0, _t1 in cw_replies)
+        procs = spans_lib.dedupe_by_uid([own] + gathered)
+        return {"ts": time.time(), "procs": procs,
                 "unreachable": unreachable}
 
     # ---- debug plane: log fan-out + postmortems (log_plane.py) ----------

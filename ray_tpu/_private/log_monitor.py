@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import log_plane
 from ray_tpu._private.config import Config
+from ray_tpu.util.locks import TracedLock
 
 logger = logging.getLogger(__name__)
 
@@ -57,7 +58,7 @@ class LogMonitor:
         # stop()'s final drain after the join)
         self._bucket: Dict[str, Tuple[float, float]] = {}
         self.dropped_by_source: Dict[str, int] = {}
-        self._scan_lock = threading.Lock()
+        self._scan_lock = TracedLock("log_monitor_scan")
         # (source, records) awaiting publication, guarded by _scan_lock.
         # Scans queue here and the monitor thread publishes OUTSIDE the
         # lock: the publish RPC can block up to its 30s client timeout

@@ -37,6 +37,7 @@ import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
+from ray_tpu.util.locks import TracedLock
 
 logger = logging.getLogger(__name__)
 
@@ -346,12 +347,16 @@ class Watchdog:
 
     def __init__(self, emit: Callable[..., None],
                  cooldown_s: float, wait_edge_age_s: float,
-                 store_occupancy_frac: float, queue_depth: int) -> None:
+                 store_occupancy_frac: float, queue_depth: int,
+                 lock_hold_s: float = 5.0,
+                 lock_waiters: int = 1) -> None:
         self._emit = emit
         self.cooldown_s = cooldown_s
         self.wait_edge_age_s = wait_edge_age_s
         self.store_occupancy_frac = store_occupancy_frac
         self.queue_depth = queue_depth
+        self.lock_hold_s = lock_hold_s
+        self.lock_waiters = lock_waiters
         self._last_alert: Dict[Tuple[str, str], float] = {}
         # lease probe: uid -> (leaked-slot count, monotonic ts it was
         # first seen stuck at that value)
@@ -656,6 +661,51 @@ class Watchdog:
             if key not in seen:
                 del self._mem_suspect[key]
 
+    def _probe_locks(self, snaps: List[Dict[str, Any]]) -> None:
+        """Lockdep probes over the traced-lock digests riding the
+        harvest (util/locks.py digest()): per-process, (1) a cycle in
+        the observed acquisition-order graph — two code paths took the
+        same locks in opposite orders, a deadlock that merely hasn't
+        fired yet (the order is the bug, lockdep semantics); (2) a
+        lock held past the configured threshold while threads queue
+        behind it — a stalled critical section starving the process.
+        Edges accumulate for the process's lifetime, so an inversion
+        alerts within the next harvest interval and the cooldown
+        dedupes the repeats."""
+        from ray_tpu.util import locks as locks_lib
+        for snap in snaps:
+            d = snap.get(locks_lib.DIGEST_KEY)
+            if not d:
+                continue
+            # the digest pre-computes the cycle over its process's FULL
+            # edge graph (the shipped edge list is capped); fall back
+            # to detecting over the shipped edges for older digests
+            cycle = d.get("cycle") or locks_lib.find_cycle(
+                (a, b) for a, b in d.get("edges", ()))
+            if cycle:
+                path = " -> ".join(cycle)
+                self._alert(
+                    "lock_order_inversion",
+                    f"{snap['proc_uid']}:{path}",
+                    f"{snap['proc']}: observed lock acquisition orders "
+                    f"form a cycle {path} — threads interleaving these "
+                    f"paths deadlock; pick one global order (static "
+                    f"twin: graftlint RT016)", severity="ERROR",
+                    proc=snap["proc"], node_id=snap.get("node_id"))
+            for lh in d.get("long_holds", ()):
+                if lh.get("held_s", 0.0) >= self.lock_hold_s and \
+                        lh.get("waiters", 0) >= self.lock_waiters:
+                    self._alert(
+                        "lock_long_hold",
+                        f"{snap['proc_uid']}:{lh['name']}",
+                        f"{snap['proc']}: lock {lh['name']!r} held "
+                        f"{lh['held_s']:.1f}s (> {self.lock_hold_s:g}s) "
+                        f"with {lh['waiters']} thread(s) queued — a "
+                        f"stalled critical section is starving this "
+                        f"process", proc=snap["proc"],
+                        node_id=snap.get("node_id"),
+                        value=lh["held_s"])
+
     def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
         for node in unreachable:
             self._alert(
@@ -675,6 +725,7 @@ class Watchdog:
                       lambda: self._probe_queue_depth(snaps),
                       lambda: self._probe_memory(snaps, interval_s,
                                                  unreachable_nodes),
+                      lambda: self._probe_locks(snaps),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:
@@ -710,7 +761,9 @@ class MetricsPlane:
             cooldown_s=Config.watchdog_cooldown_s,
             wait_edge_age_s=Config.watchdog_wait_edge_age_s,
             store_occupancy_frac=Config.watchdog_store_occupancy_frac,
-            queue_depth=Config.watchdog_queue_depth)
+            queue_depth=Config.watchdog_queue_depth,
+            lock_hold_s=Config.watchdog_lock_hold_s,
+            lock_waiters=Config.watchdog_lock_waiters)
         self._harvest_hist = get_or_create(
             Histogram, "ray_tpu_metrics_harvest_seconds",
             description="wall time of one cluster metrics harvest "
@@ -719,10 +772,10 @@ class MetricsPlane:
         self._procs_gauge = get_or_create(
             Gauge, "ray_tpu_metrics_harvest_procs",
             description="processes covered by the last metrics harvest")
-        self._lock = threading.Lock()
+        self._lock = TracedLock("metrics_plane")
         # serializes full rounds: the sampler loop and on-demand callers
         # (scrapes, dumps) never harvest concurrently
-        self._round_lock = threading.Lock()
+        self._round_lock = TracedLock("metrics_round")
         self._last_snaps: List[Dict[str, Any]] = []
         self._last_series: Dict[str, float] = {}
         self._last_harvest_mono = 0.0
@@ -894,7 +947,9 @@ class MetricsPlane:
                   cooldown_s: Optional[float] = None,
                   wait_edge_age_s: Optional[float] = None,
                   store_occupancy_frac: Optional[float] = None,
-                  queue_depth: Optional[int] = None) -> Dict[str, Any]:
+                  queue_depth: Optional[int] = None,
+                  lock_hold_s: Optional[float] = None,
+                  lock_waiters: Optional[int] = None) -> Dict[str, Any]:
         """Runtime tuning (ops + tests): adjust the sample interval and
         watchdog thresholds without restarting the GCS."""
         if interval_s is not None:
@@ -909,12 +964,18 @@ class MetricsPlane:
                 float(store_occupancy_frac)
         if queue_depth is not None:
             self.watchdog.queue_depth = int(queue_depth)
+        if lock_hold_s is not None:
+            self.watchdog.lock_hold_s = float(lock_hold_s)
+        if lock_waiters is not None:
+            self.watchdog.lock_waiters = int(lock_waiters)
         return {"interval_s": self.interval_s,
                 "cooldown_s": self.watchdog.cooldown_s,
                 "wait_edge_age_s": self.watchdog.wait_edge_age_s,
                 "store_occupancy_frac":
                     self.watchdog.store_occupancy_frac,
-                "queue_depth": self.watchdog.queue_depth}
+                "queue_depth": self.watchdog.queue_depth,
+                "lock_hold_s": self.watchdog.lock_hold_s,
+                "lock_waiters": self.watchdog.lock_waiters}
 
     def stop(self) -> None:
         self._stopped = True

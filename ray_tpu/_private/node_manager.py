@@ -31,6 +31,7 @@ from ray_tpu._private.state import (NodeAffinitySchedulingStrategy, NodeInfo,
                                     NodeLabelSchedulingStrategy,
                                     PlacementGroupSchedulingStrategy,
                                     ResourceSet, TaskSpec, TaskType)
+from ray_tpu.util.locks import TracedLock
 
 logger = logging.getLogger(__name__)
 
@@ -99,7 +100,7 @@ class NodeManager:
         self._runtime_env_mgr = RuntimeEnvManager()
         self._pool = rpc_lib.ClientPool(timeout=60)
         self._gcs = rpc_lib.RpcClient(self.gcs_address, timeout=60)
-        self._lock = threading.Lock()
+        self._lock = TracedLock("node_manager")
         self._dead = False
 
         if resources is None:
@@ -181,6 +182,7 @@ class NodeManager:
             "nm_profile_workers": self.profile_workers,
             "nm_profile_collect": self.profile_collect,
             "nm_memory_snapshot": self.memory_snapshot,
+            "nm_locks_snapshot": self.locks_snapshot,
             "nm_drain": self.drain,
         }, host=host)
         self.address = self.server.address
@@ -1335,6 +1337,23 @@ class NodeManager:
         # worker_addrs lets the GCS skip its direct-subscriber pull for
         # workers this reply already covers (only successfully-pulled
         # ones: a worker the NM missed may answer the GCS directly)
+        return {"snapshots": snapshots,
+                "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
+
+    def locks_snapshot(self) -> Dict[str, Any]:
+        """Lockdep-plane gather for this node: the daemon's own traced
+        locks plus every registered worker's, one hop below the GCS
+        `locks_collect` fan-out (structure mirrors metrics_snapshot)."""
+        from ray_tpu._private import spans as spans_lib
+        from ray_tpu.util import locks as locks_lib
+        with self._lock:
+            worker_addrs = [h.address for h in self.workers.values()
+                            if h.registered and h.address is not None]
+        pulled = spans_lib.pull_snapshots(
+            worker_addrs, "cw_locks_snapshot",
+            timeout=self.METRICS_WORKER_TIMEOUT_S)
+        snapshots = [locks_lib.snapshot()]
+        snapshots.extend(snap for _a, snap, _t0, _t1 in pulled)
         return {"snapshots": snapshots,
                 "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
 

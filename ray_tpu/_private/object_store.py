@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private import chaos as chaos_lib
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import spans as _spans
+from ray_tpu.util.locks import TracedLock
 
 logger = logging.getLogger(__name__)
 
@@ -94,7 +95,7 @@ class StoreServer:
         # in-flight pull dedup: oid -> Event set when the transfer ends
         # (N concurrent pulls of one object must stream it ONCE)
         self._pulls_in_flight: Dict[str, threading.Event] = {}
-        self._lock = threading.Lock()
+        self._lock = TracedLock("object_store")
         self._sealed_cv = threading.Condition(self._lock)
         self._pool = rpc_lib.ClientPool(timeout=60)
 
@@ -690,7 +691,7 @@ class StoreClient:
     def __init__(self, store_address: Tuple[str, int]):
         self.address = tuple(store_address)
         self._rpc = rpc_lib.RpcClient(self.address, timeout=None)
-        self._lock = threading.Lock()
+        self._lock = TracedLock("store_client")
         self._arenas: Dict[str, Any] = {}     # arena path -> NativeArena
         # file-layout fallback: object id -> (mmap, view, inode)
         self._maps: Dict[str, Tuple[mmap.mmap, memoryview, int]] = {}
@@ -913,6 +914,14 @@ class StoreClient:
     def delete(self, object_ids: List[str]) -> None:
         self._release(object_ids)
         self._rpc.call("store_delete", object_ids=object_ids)
+
+    def release_views(self, object_ids: List[str]) -> None:
+        """Drop this client's mmap views only — a purely local cleanup
+        with no RPC, safe to call under caller locks. The server-side
+        delete is a separate (blocking) RPC; callers holding locks
+        queue it onto an off-lock drainer instead (core_worker's
+        borrow-release loop)."""
+        self._release(object_ids)
 
     def _release_locked(self, oid: str) -> None:
         m = self._maps.pop(oid, None)

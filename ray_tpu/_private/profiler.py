@@ -41,6 +41,7 @@ import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+from ray_tpu.util.locks import TracedLock
 
 # ---------------------------------------------------------------------
 # Per-thread execution context (the attribution the sampler stamps)
@@ -117,7 +118,7 @@ class Sampler:
 
     def __init__(self, max_stacks: int = 2000):
         self.max_stacks = max(16, int(max_stacks))
-        self._lock = threading.Lock()   # start/stop/snapshot control
+        self._lock = TracedLock("profiler")  # start/stop/snapshot control
         self._thread: Optional[threading.Thread] = None
         self._stop_ev = threading.Event()
         self.hz = 0.0

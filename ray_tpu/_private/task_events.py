@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from typing import Any, Dict, Optional
+from ray_tpu.util.locks import TracedLock
 
 FLUSH_PERIOD_S = 1.0
 
@@ -30,7 +31,7 @@ class TaskEventBuffer:
 
     def __init__(self, gcs_client: Any, pending_max: int = PENDING_MAX):
         self._gcs = gcs_client
-        self._lock = threading.Lock()
+        self._lock = TracedLock("task_events")
         self._pending: Dict[str, Dict[str, Any]] = {}
         self._pending_max = max(1, pending_max)
         self.dropped_total = 0

@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
+from ray_tpu.util.locks import TracedLock
 
 
 class WaitGraph:
@@ -40,7 +41,7 @@ class WaitGraph:
     to the same target stack and unwind independently."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = TracedLock("wait_graph")
         # waiter hex -> {target hex: outstanding edge count}
         self._edges: Dict[str, Dict[str, int]] = {}
         # token -> (waiter hex, target hex, registered_at monotonic) —

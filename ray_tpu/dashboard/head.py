@@ -16,6 +16,8 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
                         speedscope|folded|raw&device=1 + id filters)
     GET /api/memory   — owner-attributed cluster object table
                         (?group_by=callsite|actor|node|owner&top=N)
+    GET /api/locks    — runtime lockdep: per-process traced-lock stats
+                        + acquisition-order graphs (util/locks.py)
     GET /api/jobs     — job table from the GCS KV
     GET /api/summary  — task-state counts
     GET /metrics      — Prometheus exposition of the CLUSTER-merged
@@ -284,6 +286,11 @@ class DashboardHead:
             # a node must say so)
             return {**profiler_lib.to_speedscope(out["profiles"]),
                     "unreachable": out["unreachable"]}
+        if route == "/api/locks":
+            # runtime lockdep (ray_tpu/util/locks.py): per-process
+            # traced-lock stats + acquisition-order graphs
+            return s.locks(timeout=(float(params["timeout"])
+                                    if "timeout" in params else None))
         if route == "/api/memory":
             # cluster object table (_private/memory_plane.py):
             # ?group_by=callsite|actor|node|owner&top=N

@@ -12,6 +12,31 @@ import sys
 from typing import List, Optional, Sequence
 
 
+def _git_changed_files() -> Optional[List[str]]:
+    """Absolute paths of .py files changed vs HEAD (worktree + index)
+    plus untracked ones; None when git is unavailable/not a repo."""
+    import os
+    import subprocess
+    out: List[str] = []
+    try:
+        root = subprocess.check_output(
+            ["git", "rev-parse", "--show-toplevel"],
+            stderr=subprocess.DEVNULL, text=True).strip()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            got = subprocess.check_output(
+                cmd, stderr=subprocess.DEVNULL, text=True, cwd=root)
+        except (OSError, subprocess.CalledProcessError):
+            continue  # e.g. a fresh repo with no HEAD yet
+        out.extend(os.path.join(root, line)
+                   for line in got.splitlines()
+                   if line.endswith(".py"))
+    return sorted({p for p in out if os.path.isfile(p)})
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from ray_tpu.lint.engine import lint_paths
     from ray_tpu.lint.rules import ALL_RULES
@@ -31,6 +56,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="comma-separated rule ids to skip")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
+    parser.add_argument("--cache", default=None, metavar="PATH",
+                        help="on-disk findings cache keyed by file "
+                             "content hash (+ rule-set fingerprint); "
+                             "unchanged files skip parsing entirely")
+    parser.add_argument("--changed", action="store_true",
+                        help="report findings only for files git "
+                             "considers changed (worktree + index + "
+                             "untracked); the whole tree is still "
+                             "enumerated so cross-file lock-order "
+                             "analysis (RT016) stays sound — pair "
+                             "with --cache to make that cheap")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -51,8 +87,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
     paths: List[str] = args.paths or ["."]
+    only_files = None
+    if args.changed:
+        only_files = _git_changed_files()
+        if only_files is None:
+            print("error: --changed requires a git checkout "
+                  "(git diff failed)", file=sys.stderr)
+            return 2
+        if not only_files:
+            print("no changed python files")
+            return 0
     try:
-        findings = lint_paths(paths, select=select, ignore=ignore)
+        findings = lint_paths(paths, select=select, ignore=ignore,
+                              cache_path=args.cache,
+                              only_files=only_files)
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -283,29 +283,70 @@ def _suppressed(finding: Finding, supp: Dict[int, Set[str]]) -> bool:
     return False
 
 
+def _is_project_rule(rule) -> bool:
+    """Project rules extract JSON-able per-file FACTS (cache-friendly)
+    and analyze them across the whole linted set (RT016's lock-order
+    graph spans files); the engine never calls their per-file check."""
+    return hasattr(rule, "collect_facts")
+
+
+def _check_file(ctx: ModuleContext) -> tuple:
+    """All per-file findings (suppressions applied) + per-rule facts
+    for project rules. Always computed for the FULL rule set so cache
+    entries stay valid whatever --select/--ignore the next run uses."""
+    from ray_tpu.lint.rules import ALL_RULES
+    supp = _suppressions(ctx.source_lines)
+    findings: List[Finding] = []
+    facts: Dict[str, object] = {}
+    for rule in ALL_RULES:
+        if _is_project_rule(rule):
+            facts[rule.id] = rule.collect_facts(ctx)
+            continue
+        for f in rule.check(ctx):
+            if not _suppressed(f, supp):
+                findings.append(f)
+    return findings, facts, supp
+
+
+def _project_findings(facts_by_rule: Dict[str, Dict[str, object]],
+                      supp_by_path: Dict[str, Dict[int, Set[str]]]
+                      ) -> List[Finding]:
+    from ray_tpu.lint.rules import ALL_RULES
+    findings: List[Finding] = []
+    for rule in ALL_RULES:
+        if not _is_project_rule(rule):
+            continue
+        for f in rule.project_check(facts_by_rule.get(rule.id, {})):
+            supp = supp_by_path.get(f.path, {})
+            if not _suppressed(f, supp):
+                findings.append(f)
+    return findings
+
+
+def _filtered(findings: List[Finding],
+              select: Optional[Sequence[str]],
+              ignore: Optional[Sequence[str]]) -> List[Finding]:
+    selected = {s.upper() for s in select} if select else None
+    ignored = {s.upper() for s in ignore} if ignore else set()
+    out = [f for f in findings
+           if (selected is None or f.rule_id in selected)
+           and f.rule_id not in ignored]
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return out
+
+
 def lint_source(source: str, path: str = "<string>",
                 select: Optional[Sequence[str]] = None,
                 ignore: Optional[Sequence[str]] = None) -> List[Finding]:
-    from ray_tpu.lint.rules import ALL_RULES
     try:
         ctx = build_context(source, path)
     except SyntaxError as e:
         return [Finding("RT000", path, e.lineno or 1, e.offset or 0,
                         f"syntax error: {e.msg}")]
-    supp = _suppressions(ctx.source_lines)
-    selected = {s.upper() for s in select} if select else None
-    ignored = {s.upper() for s in ignore} if ignore else set()
-    findings: List[Finding] = []
-    for rule in ALL_RULES:
-        if selected is not None and rule.id not in selected:
-            continue
-        if rule.id in ignored:
-            continue
-        for f in rule.check(ctx):
-            if not _suppressed(f, supp):
-                findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
-    return findings
+    findings, facts, supp = _check_file(ctx)
+    findings += _project_findings(
+        {rid: {path: fct} for rid, fct in facts.items()}, {path: supp})
+    return _filtered(findings, select, ignore)
 
 
 def lint_file(path: str, select: Optional[Sequence[str]] = None,
@@ -335,10 +376,118 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
+# ---------------------------------------------------------------------
+# Incremental lint: on-disk cache keyed by file content hash
+# ---------------------------------------------------------------------
+
+
+def _ruleset_fingerprint() -> str:
+    """Hash of the lint package's own sources: an edited rule must
+    invalidate every cache entry, or stale findings would gate CI."""
+    import hashlib
+    h = hashlib.sha1()
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    for name in sorted(os.listdir(pkg_dir)):
+        if name.endswith(".py"):
+            with open(os.path.join(pkg_dir, name), "rb") as f:
+                h.update(name.encode())
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def _load_cache(cache_path: str) -> Dict[str, object]:
+    import json
+    try:
+        with open(cache_path, "r", encoding="utf-8") as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        return {"files": {}}
+    if cache.get("version") != _ruleset_fingerprint():
+        return {"files": {}}
+    return cache
+
+
+def _save_cache(cache_path: str, cache: Dict[str, object]) -> None:
+    import json
+    cache["version"] = _ruleset_fingerprint()
+    tmp = f"{cache_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, cache_path)  # atomic: a raced run sees old or new
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:  # noqa: BLE001 - cache is an optimization; a
+            pass         # read-only tree just lints uncached
+
+
 def lint_paths(paths: Sequence[str],
                select: Optional[Sequence[str]] = None,
-               ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+               ignore: Optional[Sequence[str]] = None,
+               cache_path: Optional[str] = None,
+               only_files: Optional[Sequence[str]] = None
+               ) -> List[Finding]:
+    """Lint files/directories. With `cache_path`, per-file findings and
+    project-rule facts are reused when the file's content hash matches
+    (rule-set fingerprinted), so a warm zero-findings baseline run
+    costs one hash per file instead of a parse + 16 rules. Project
+    rules always re-analyze over the (cached or fresh) facts of EVERY
+    enumerated file — cross-file lock-order cycles stay sound under
+    incremental runs. `only_files` restricts which files' findings are
+    REPORTED (tools/lint.py --changed) without shrinking the project
+    graph."""
+    import hashlib
+    files = iter_python_files(paths)
+    cache = _load_cache(cache_path) if cache_path else {"files": {}}
+    cached_files: Dict[str, Dict] = cache.get("files", {})  # type: ignore
+    new_files: Dict[str, Dict] = {}
     findings: List[Finding] = []
-    for path in iter_python_files(paths):
-        findings.extend(lint_file(path, select=select, ignore=ignore))
-    return findings
+    facts_by_rule: Dict[str, Dict[str, object]] = {}
+    supp_by_path: Dict[str, Dict[int, Set[str]]] = {}
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding("RT000", path, 1, 0,
+                                    f"unreadable: {e}"))
+            continue
+        key = os.path.abspath(path)
+        h = hashlib.sha1(source.encode("utf-8",
+                                       "surrogatepass")).hexdigest()
+        ent = cached_files.get(key)
+        if ent is not None and ent.get("hash") == h:
+            file_findings = [Finding(**fd) for fd in ent["findings"]]
+            facts = ent.get("facts", {})
+            supp = {int(ln): set(rs)
+                    for ln, rs in ent.get("supp", {}).items()}
+        else:
+            try:
+                ctx = build_context(source, path)
+            except SyntaxError as e:
+                findings.append(Finding("RT000", path, e.lineno or 1,
+                                        e.offset or 0,
+                                        f"syntax error: {e.msg}"))
+                continue
+            file_findings, facts, supp = _check_file(ctx)
+        new_files[key] = {
+            "hash": h,
+            "findings": [{"rule_id": f.rule_id, "path": f.path,
+                          "line": f.line, "col": f.col,
+                          "message": f.message} for f in file_findings],
+            "facts": facts,
+            "supp": {str(ln): sorted(rs) for ln, rs in supp.items()},
+        }
+        findings.extend(file_findings)
+        supp_by_path[path] = supp
+        for rid, fct in facts.items():
+            facts_by_rule.setdefault(rid, {})[path] = fct
+    findings += _project_findings(facts_by_rule, supp_by_path)
+    if cache_path:
+        _save_cache(cache_path, {"files": new_files})
+    if only_files is not None:
+        wanted = {os.path.abspath(p) for p in only_files}
+        findings = [f for f in findings
+                    if os.path.abspath(f.path) in wanted]
+    return _filtered(findings, select, ignore)

@@ -1,6 +1,6 @@
-"""graftlint rule set: 12 framework-aware checks.
+"""graftlint rule set: 16 framework-aware checks.
 
-Each rule has a stable id (RT001..RT012), a one-line rationale, and a
+Each rule has a stable id (RT001..RT016), a one-line rationale, and a
 `check(ctx)` generator yielding Findings. Rules are deliberately
 conservative: a finding should be actionable, and intentional
 exceptions are silenced in-place with `# graftlint: disable=RTxxx`
@@ -805,12 +805,18 @@ class SilentExceptionSwallow(Rule):
                 "(`# noqa: BLE001 - <why>`)")
 
 
+# Concurrency layer (class-level guard maps + lock-order graph) lives
+# in its own module; the rules plug into the same catalogue.
+from ray_tpu.lint.concurrency import (BlockingUnderLock,  # noqa: E402
+                                      LockOrderCycle, MixedGuardAccess)
+
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
     ClosureMutationInJit(), ActorCallWithoutRemote(), LeakedObjectRef(),
     DictOrderPytree(), SwallowedException(), StoreViewCopy(),
     WallClockDuration(), MetricNameConvention(), BarePrintInFramework(),
-    SilentExceptionSwallow(),
+    SilentExceptionSwallow(), MixedGuardAccess(), BlockingUnderLock(),
+    LockOrderCycle(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

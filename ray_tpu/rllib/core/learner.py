@@ -42,14 +42,21 @@ class Learner:
         import jax
         import optax
 
-        self._params = self.module.init_params(jax.random.PRNGKey(seed))
+        # params/opt_state are lock-guarded everywhere else (a weight
+        # sync racing an update must not tear the pytree); build() is
+        # nominally pre-concurrency but is a public entry point, so it
+        # takes the same lock rather than asserting callers sequence it
+        with self._state_lock:
+            self._params = self.module.init_params(
+                jax.random.PRNGKey(seed))
         clip = getattr(self.config, "grad_clip", None)
         chain = []
         if clip:
             chain.append(optax.clip_by_global_norm(clip))
         chain.append(optax.adam(self.config.lr))
         self._optimizer = optax.chain(*chain)
-        self._opt_state = self._optimizer.init(self._params)
+        with self._state_lock:
+            self._opt_state = self._optimizer.init(self._params)
 
         def update(params, opt_state, batch, extra):
             def loss_wrap(p):
@@ -144,15 +151,18 @@ class Learner:
                 np.shape(x), self._rep, lambda idx: np.asarray(x)[idx])
 
         self._replicate_host = _replicate
-        self._params = jax.tree.map(_replicate, host_params)
+        # same locking rationale as build(): public entry, shared state
+        with self._state_lock:
+            self._params = jax.tree.map(_replicate, host_params)
         clip = getattr(self.config, "grad_clip", None)
         chain = []
         if clip:
             chain.append(optax.clip_by_global_norm(clip))
         chain.append(optax.adam(self.config.lr))
         self._optimizer = optax.chain(*chain)
-        self._opt_state = jax.tree.map(
-            _replicate, self._optimizer.init(host_params))
+        with self._state_lock:
+            self._opt_state = jax.tree.map(
+                _replicate, self._optimizer.init(host_params))
 
         def update(params, opt_state, batch, extra):
             def loss_wrap(p):

@@ -275,6 +275,46 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_locks(args) -> int:
+    """Runtime lockdep plane (see README "Concurrency analysis"):
+    per-process traced-lock stats (holds, hold time, current holder,
+    waiters) plus the acquisition-order graph — a cycle means two code
+    paths take the same locks in opposite orders and will deadlock
+    under the right interleaving."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    out = s.locks(timeout=args.timeout)
+    if args.format == "json":
+        print(json.dumps(out, default=str))
+        return 0
+    for snap in out["procs"]:
+        locks = snap.get("locks") or []
+        edges = snap.get("edges") or []
+        if not locks and not snap.get("cycle"):
+            continue
+        print(f"\n== {snap.get('proc')} (pid {snap.get('pid')})")
+        _print_table(
+            [{"lock": a["name"], "holds": a["holds"],
+              "hold_total_s": f"{a['hold_total_s']:.3f}",
+              "waiters": a["waiters"],
+              "held_s": (f"{a['held_s']:.3f}" if a["held_now"]
+                         else "-"),
+              "held_by": ",".join(
+                  str(h.get("thread_name") or h.get("thread"))
+                  for h in a.get("held_by", ())) or "-"}
+             for a in locks],
+            ["lock", "holds", "hold_total_s", "waiters", "held_s",
+             "held_by"])
+        if edges:
+            print("order edges: " + "; ".join(
+                f"{a}->{b} x{n}" for a, b, n in edges))
+        if snap.get("cycle"):
+            print("!! ORDER INVERSION: "
+                  + " -> ".join(snap["cycle"]))
+    _warn_unreachable(list(out.get("unreachable") or []))
+    return 0
+
+
 def cmd_profile(args) -> int:
     """Cluster flamegraph (see README "Profiling & memory
     attribution"): sample every process for --duration seconds at
@@ -657,6 +697,15 @@ def main(argv=None) -> int:
                    help="jax profiler traces on device-hosting workers "
                         "(reports xplane dirs) instead of CPU sampling")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("locks", help="runtime lockdep: per-process "
+                                     "traced-lock stats + acquisition-"
+                                     "order graph (cycle = deadlock "
+                                     "in waiting)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--timeout", type=float, default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.set_defaults(fn=cmd_locks)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", help="tasks|actors|nodes|workers|objects|"

@@ -152,7 +152,11 @@ class DeploymentHandle:
             if self._replicas and \
                     time.monotonic() - self._last_refresh < self.REFRESH_PERIOD_S:
                 return  # another thread refreshed while we waited
-            info = ray_tpu.get(
+            # singleflight by design: _refresh_lock exists ONLY to make
+            # concurrent first callers block for this one in-flight
+            # controller fetch instead of racing into an empty replica
+            # list; no other state hides behind it
+            info = ray_tpu.get(  # graftlint: disable=RT015
                 self._controller.get_routing_info.remote(
                     self.deployment_name), timeout=30)
             self._apply_routing_info(info)

@@ -13,6 +13,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+from ray_tpu.util.locks import TracedLock
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +56,7 @@ class _MultiplexWrapper:
         self.max_models = max(1, max_num_models_per_replica)
         self.models: Dict[str, Any] = {}   # insertion order = LRU
         self._loading: Dict[str, threading.Event] = {}
-        self._lock = threading.Lock()
+        self._lock = TracedLock("serve_model_cache")
 
     def load(self, owner, model_id: str):
         # per-model-id load serialization: concurrent requests for the
@@ -138,7 +139,7 @@ class Replica:
             self._callable = target
         self._in_flight = 0
         self._total = 0
-        self._lock = threading.Lock()
+        self._lock = TracedLock("serve_replica")
 
     @_control_group
     def ping(self) -> str:
@@ -238,7 +239,7 @@ class ServeController:
 
     def __init__(self) -> None:
         self._deployments: Dict[str, _DeploymentState] = {}
-        self._lock = threading.Lock()
+        self._lock = TracedLock("serve_controller")
         self._stop = threading.Event()
         # long-poll state (reference serve/_private/long_poll.py:30
         # LongPollHost): per-deployment snapshot ids; listeners block on

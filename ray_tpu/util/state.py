@@ -397,6 +397,16 @@ def profile(duration: float = 5.0, hz: Optional[float] = None,
     return out
 
 
+def locks(timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Cluster lockdep snapshot (`ray_tpu locks`, dashboard
+    /api/locks): every process's traced locks (hold counts/times,
+    current holders, threads waiting) and its acquisition-order edge
+    graph, with any observed order-inversion cycle called out per
+    process. Unreachable nodes are named — an empty lock list is only
+    meaningful when coverage was complete."""
+    return _gcs().call("locks_collect", timeout=timeout)
+
+
 def memory_table(group_by: Optional[str] = None,
                  top: Optional[int] = None,
                  timeout: Optional[float] = None) -> Dict[str, Any]:

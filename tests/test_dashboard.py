@@ -49,6 +49,11 @@ def test_dashboard_endpoints(ray_start):
         objects = _get(port, "/api/objects")
         assert "store_stats" in objects
 
+        locks = _get(port, "/api/locks")
+        assert any(a["name"] == "core_worker"
+                   for s in locks["procs"]
+                   for a in s.get("locks", ()))
+
         # HTML overview serves
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/", timeout=30) as r:

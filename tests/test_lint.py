@@ -945,8 +945,435 @@ def test_rt013_terminal_facing_paths_exempt(path):
 def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == [f"RT00{i}" for i in range(1, 10)] + \
-        ["RT010", "RT011", "RT012", "RT013"]
+        ["RT010", "RT011", "RT012", "RT013", "RT014", "RT015", "RT016"]
     assert all(r.rationale for r in ALL_RULES)
+
+
+# ---- RT014 mixed-guard attribute access -----------------------------------
+
+RT014_POS = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def add(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            self._items.pop(k, None)
+"""
+
+
+def test_rt014_unguarded_mutation_flagged():
+    assert "RT014" in rules_hit(RT014_POS)
+
+
+def test_rt014_suppressed():
+    src = RT014_POS.replace(
+        "self._items.pop(k, None)",
+        "self._items.pop(k, None)  # graftlint: disable=RT014")
+    assert "RT014" not in rules_hit(src)
+
+
+def test_rt014_unguarded_iteration_flagged():
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def add(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def snapshot(self):
+                return dict(self._items.items())
+    """
+    assert "RT014" in rules_hit(src)
+
+
+def test_rt014_all_guarded_clean():
+    src = RT014_POS.replace(
+        "self._items.pop(k, None)",
+        "with self._lock:\n                self._items.pop(k, None)")
+    assert "RT014" not in rules_hit(src)
+
+
+def test_rt014_init_and_init_helpers_exempt():
+    """Unguarded writes during construction (no other thread can see
+    the object yet) are not races — including in helpers reachable
+    only from __init__."""
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+                self._setup()
+
+            def _setup(self):
+                self._items["boot"] = 1
+
+            def add(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+    """
+    assert "RT014" not in rules_hit(src)
+
+
+def test_rt014_guarded_helper_inferred():
+    """A private helper whose every call site holds the lock runs
+    under it: its accesses are guarded, not findings."""
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def add(self, k, v):
+                with self._lock:
+                    self._insert(k, v)
+
+            def _insert(self, k, v):
+                self._items[k] = v
+    """
+    assert "RT014" not in rules_hit(src)
+
+
+def test_rt014_thread_target_counts_as_public_path():
+    """A method referenced as a callback (thread target) runs on a
+    foreign thread: its unguarded accesses race even though the name
+    is private."""
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+                threading.Thread(target=self._loop).start()
+
+            def add(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def _loop(self):
+                self._items.clear()
+    """
+    assert "RT014" in rules_hit(src)
+
+
+# ---- RT015 blocking call under lock ---------------------------------------
+
+RT015_POS = """
+    import threading
+    import time
+
+    class Daemon:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poke(self, client):
+            with self._lock:
+                client.call("ping")
+"""
+
+
+def test_rt015_rpc_under_lock_flagged():
+    assert "RT015" in rules_hit(RT015_POS)
+
+
+def test_rt015_suppressed():
+    src = RT015_POS.replace(
+        'client.call("ping")',
+        'client.call("ping")  # graftlint: disable=RT015')
+    assert "RT015" not in rules_hit(src)
+
+
+def test_rt015_sleep_and_timeout_get_flagged():
+    src = """
+        import threading
+        import time
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = None
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.5)
+                    return self._q.get(timeout=1.0)
+    """
+    assert "RT015" in rules_hit(src)
+
+
+def test_rt015_plain_dict_get_not_flagged():
+    src = """
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+
+            def read(self, k):
+                with self._lock:
+                    return self._d.get(k, None)
+    """
+    assert "RT015" not in rules_hit(src)
+
+
+def test_rt015_condition_wait_allowlisted():
+    """Condition.wait RELEASES the lock it guards — the allowlisted
+    blocking wait; Event.wait does not and is flagged."""
+    ok = """
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.ready = False
+
+            def take(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait()
+    """
+    assert "RT015" not in rules_hit(ok)
+    bad = """
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._event = threading.Event()
+
+            def take(self):
+                with self._lock:
+                    self._event.wait()
+    """
+    assert "RT015" in rules_hit(bad)
+
+
+def test_rt015_blocking_in_guarded_helper_flagged():
+    """Cross-function: the blocking call sits two frames below the
+    `with` block, in a helper only ever called under the lock."""
+    src = """
+        import threading
+        import time
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    self._slow()
+
+            def _slow(self):
+                time.sleep(1)
+    """
+    assert "RT015" in rules_hit(src)
+
+
+def test_rt015_str_join_not_flagged():
+    src = """
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fmt(self, parts):
+                with self._lock:
+                    return ",".join(parts)
+    """
+    assert "RT015" not in rules_hit(src)
+
+
+# ---- RT016 lock-order cycles ----------------------------------------------
+
+RT016_POS = """
+    import threading
+
+    class TwoLocks:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_rt016_inversion_flagged():
+    fs = findings(RT016_POS)
+    assert any(f.rule_id == "RT016" and "cycle" in f.message
+               for f in fs)
+
+
+def test_rt016_suppressed():
+    src = RT016_POS.replace(
+        "with self._a:\n                with self._b:",
+        "with self._a:  # graftlint: disable=RT016\n"
+        "                with self._b:")
+    assert "RT016" not in rules_hit(src)
+
+
+def test_rt016_consistent_order_clean():
+    src = RT016_POS.replace(
+        "with self._b:\n                with self._a:",
+        "with self._a:\n                with self._b:")
+    assert "RT016" not in rules_hit(src)
+
+
+def test_rt016_cross_function_self_deadlock():
+    src = """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+    """
+    assert "RT016" in rules_hit(src)
+
+
+def test_rt016_rlock_reacquire_clean():
+    """Self-edges on an RLock are legal reentrancy, not deadlock."""
+    src = """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+    """
+    assert "RT016" not in rules_hit(src)
+
+
+def test_rt016_cross_file_cycle():
+    """The lock-order graph spans files: each file alone is clean, the
+    pair cycles (project-level analysis over per-file facts)."""
+    a = textwrap.dedent("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._x = threading.Lock()
+                self._y = threading.Lock()
+
+            def fwd(self):
+                with self._x:
+                    with self._y:
+                        pass
+    """)
+    # same class name in both files so the lock identities (A._x,
+    # A._y) collide across files, as shared module locks do; file b
+    # swaps every _x/_y reference, nesting in the OPPOSITE order
+    b = a.replace("self._x", "self._TMP") \
+         .replace("self._y", "self._x") \
+         .replace("self._TMP", "self._y")
+    assert b != a
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        pa = os.path.join(d, "mod_a.py")
+        pb = os.path.join(d, "mod_b.py")
+        with open(pa, "w") as f:
+            f.write(a)
+        with open(pb, "w") as f:
+            f.write(b)
+        fs = lint_paths([d])
+    assert any(f.rule_id == "RT016" for f in fs)
+    assert all(f.rule_id == "RT016" for f in fs)
+
+
+# ---- incremental lint cache ------------------------------------------------
+
+def test_lint_cache_hit_and_invalidation(tmp_path):
+    src = textwrap.dedent(RT014_POS)
+    target = tmp_path / "mod.py"
+    target.write_text(src)
+    cache = tmp_path / "cache.json"
+    fs1 = lint_paths([str(tmp_path)], cache_path=str(cache))
+    assert cache.exists()
+    fs2 = lint_paths([str(tmp_path)], cache_path=str(cache))
+    assert [f.format() for f in fs1] == [f.format() for f in fs2]
+    assert any(f.rule_id == "RT014" for f in fs2)
+    # a content change must invalidate that file's entry
+    target.write_text(src.replace(
+        "self._items.pop(k, None)",
+        "with self._lock:\n            self._items.pop(k, None)"))
+    fs3 = lint_paths([str(tmp_path)], cache_path=str(cache))
+    assert not any(f.rule_id == "RT014" for f in fs3)
+
+
+def test_lint_cache_preserves_project_rule_facts(tmp_path):
+    """RT016 cycles spanning files must survive a warm-cache run: the
+    per-file edge FACTS are cached, the graph analysis re-runs."""
+    a = textwrap.dedent(RT016_POS)
+    (tmp_path / "mod.py").write_text(a)
+    cache = tmp_path / "cache.json"
+    cold = lint_paths([str(tmp_path)], cache_path=str(cache))
+    warm = lint_paths([str(tmp_path)], cache_path=str(cache))
+    assert any(f.rule_id == "RT016" for f in cold)
+    assert [f.format() for f in cold] == [f.format() for f in warm]
+
+
+def test_cli_changed_flag(tmp_path):
+    """--changed needs git; outside a repo it must fail loudly, not
+    lint nothing and exit green."""
+    from ray_tpu.lint.__main__ import main
+    import subprocess as sp
+    env_cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        assert main([str(tmp_path), "--changed"]) == 2
+        sp.run(["git", "init", "-q"], check=True)
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(RT015_POS))
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main([str(tmp_path), "--changed"])
+        assert rc == 1
+        assert "RT015" in buf.getvalue()
+    finally:
+        os.chdir(env_cwd)
 
 
 # ---- CLI ------------------------------------------------------------------
@@ -1015,9 +1442,13 @@ def test_cli_module_invocation():
 def test_ray_tpu_package_lints_clean():
     """The zero-findings baseline: the framework passes its own linter.
     Any new finding means either a real bug crept in or an intentional
-    pattern is missing its `# graftlint: disable=...` justification."""
+    pattern is missing its `# graftlint: disable=...` justification.
+    Runs through the on-disk incremental cache (content-hash keyed,
+    rule-set fingerprinted), so a warm tree costs one hash per file
+    instead of re-parsing everything every suite run."""
+    from tools.lint import CACHE_PATH
     pkg = os.path.join(REPO_ROOT, "ray_tpu")
-    fs = lint_paths([pkg])
+    fs = lint_paths([pkg], cache_path=CACHE_PATH)
     assert fs == [], "\n" + "\n".join(f.format() for f in fs)
 
 

@@ -1,0 +1,449 @@
+"""Runtime lockdep plane (ray_tpu/util/locks.py): TracedLock
+bookkeeping, order-graph + cycle detection, Condition compatibility,
+metrics export, the watchdog inversion/long-hold probes, the
+`locks_collect` cluster fan-out, and the blocking-free regression the
+RT015 pass confirmed in core_worker's free path."""
+
+import statistics
+import threading
+import time
+from time import perf_counter
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import metrics_plane as mp
+from ray_tpu.util import locks as locks_lib
+from ray_tpu.util import state as state_api
+from ray_tpu.util.locks import TracedLock, TracedRLock
+
+
+@pytest.fixture(autouse=True)
+def _clean_edges():
+    """Each test starts from a clean order graph (edges accumulate for
+    the process lifetime by design)."""
+    locks_lib.reset_edges()
+    yield
+    locks_lib.reset_edges()
+
+
+# ---- order graph -----------------------------------------------------------
+
+
+def test_nested_acquisition_records_edge():
+    a, b = TracedLock("ut_edge_a"), TracedLock("ut_edge_b")
+    with a:
+        with b:
+            pass
+    assert locks_lib.edges().get(("ut_edge_a", "ut_edge_b")) == 1
+    assert ("ut_edge_b", "ut_edge_a") not in locks_lib.edges()
+    # consistent re-nesting bumps the count, no new edge
+    with a:
+        with b:
+            pass
+    assert locks_lib.edges()[("ut_edge_a", "ut_edge_b")] == 2
+
+
+def test_inversion_produces_cycle():
+    a, b = TracedLock("ut_inv_a"), TracedLock("ut_inv_b")
+    with a:
+        with b:
+            pass
+    assert locks_lib.find_cycle(locks_lib.edges()) is None
+    with b:
+        with a:
+            pass
+    cyc = locks_lib.find_cycle(locks_lib.edges())
+    assert cyc is not None and cyc[0] == cyc[-1]
+    assert set(cyc) == {"ut_inv_a", "ut_inv_b"}
+
+
+def test_rlock_reentrancy_no_false_cycle():
+    r = TracedRLock("ut_rl")
+    with r:
+        with r:
+            assert r._is_owned()
+        assert r._is_owned()
+    assert not r.locked()
+    # a reentrant self-edge must not read as a deadlock
+    assert locks_lib.find_cycle([("ut_rl", "ut_rl")]) is None
+    # method-form reentrancy too
+    assert r.acquire()
+    assert r.acquire()
+    r.release()
+    assert r.locked()
+    r.release()
+    assert not r.locked()
+
+
+def test_condition_over_traced_lock():
+    """Condition needs only acquire/release/_is_owned; wait() releases
+    the traced lock (hold ends) and reacquires on notify."""
+    lk = TracedLock("ut_cond")
+    cv = threading.Condition(lk)
+    state = {"go": False, "saw": False}
+
+    def waiter():
+        with cv:
+            while not state["go"]:
+                cv.wait(timeout=5)
+            state["saw"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5
+    # while the waiter sits in wait(), the lock must be RELEASED
+    while time.monotonic() < deadline:
+        if lk.acquire(blocking=False):
+            lk.release()
+            break
+        time.sleep(0.01)
+    with cv:
+        state["go"] = True
+        cv.notify()
+    t.join(timeout=5)
+    assert state["saw"]
+    assert not lk.locked()
+
+
+def test_condition_over_traced_rlock():
+    r = TracedRLock("ut_cond_rl")
+    cv = threading.Condition(r)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    with cv:
+        cv.notify()
+    t.join(timeout=5)
+    assert hits and not r.locked()
+
+
+def test_method_acquire_inside_with_survives_with_exit():
+    """Regression (review): a method-form b.acquire() inside `with a:`
+    leaves b above a on the chain; exiting the with-block must splice
+    a out, NOT blind-restore — b stays owned (its Condition._is_owned
+    and holder attribution must keep working)."""
+    a, b = TracedLock("ut_mix_a"), TracedLock("ut_mix_b")
+    with a:
+        b.acquire()
+    assert b.locked() and b._is_owned()
+    assert not a.locked()
+    ownr = locks_lib._owner_map().get(threading.get_ident(), [])
+    assert "ut_mix_b" in ownr and "ut_mix_a" not in ownr
+    b.release()
+    assert not b.locked()
+
+
+def test_digest_ships_cycle_over_full_edge_graph():
+    """Regression (review): the digest's shipped edge list is capped;
+    the cycle must be computed in-process over the FULL graph so an
+    inversion among late-sorting names still reaches the watchdog."""
+    za, zb = TracedLock("zz_cap_a"), TracedLock("zz_cap_b")
+    with za:
+        with zb:
+            pass
+    with zb:
+        with za:
+            pass
+    old_cap = locks_lib._DIGEST_EDGE_CAP
+    locks_lib._DIGEST_EDGE_CAP = 1  # force the cycle out of the list
+    try:
+        d = locks_lib.digest()
+        assert d["edges_dropped"] >= 1
+        assert d["cycle"] and set(d["cycle"]) == {"zz_cap_a",
+                                                  "zz_cap_b"}
+    finally:
+        locks_lib._DIGEST_EDGE_CAP = old_cap
+
+
+def test_out_of_lifo_release_keeps_chain_consistent():
+    a, b = TracedLock("ut_ool_a"), TracedLock("ut_ool_b")
+    a.acquire()
+    b.acquire()
+    a.release()          # out of order
+    assert not a.locked() and b.locked()
+    assert b._is_owned()
+    b.release()
+    assert not b.locked()
+    # chain fully drained: nothing held by this thread
+    assert threading.get_ident() not in {
+        i for i, names in locks_lib._owner_map().items() if names}
+
+
+def test_waiters_counted_and_digest_long_hold():
+    lk = TracedLock("ut_waiter")
+    lk.acquire()
+    blocked = threading.Thread(target=lambda: (lk.acquire(),
+                                               lk.release()))
+    blocked.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and lk._waiters < 1:
+        time.sleep(0.01)
+    assert lk._waiters == 1
+    # a hold >0.5s with a queued waiter appears in the harvest digest
+    time.sleep(0.6)
+    d = locks_lib.digest()
+    mine = [h for h in d["long_holds"] if h["name"] == "ut_waiter"]
+    assert mine and mine[0]["waiters"] == 1
+    assert mine[0]["held_s"] >= 0.5
+    lk.release()
+    blocked.join(timeout=5)
+
+
+def test_snapshot_structure_and_owner_attribution():
+    lk = TracedLock("ut_snap")
+    with lk:
+        snap = locks_lib.snapshot()
+    rec = [a for a in snap["locks"] if a["name"] == "ut_snap"]
+    assert rec and rec[0]["held_by"], \
+        "holder thread missing from snapshot"
+    assert rec[0]["held_now"] == 1
+    assert {"proc_uid", "pid", "proc", "edges", "cycle"} <= set(snap)
+    snap2 = locks_lib.snapshot()
+    rec2 = [a for a in snap2["locks"] if a["name"] == "ut_snap"][0]
+    assert rec2["held_now"] == 0 and not rec2["held_by"]
+
+
+def test_metrics_export_histogram_and_waiters_gauge():
+    """The harvest-time sampler exports ray_tpu_lock_held_seconds and
+    ray_tpu_lock_waiters per lock name (satellite: lock telemetry on
+    /metrics and `ray_tpu top`)."""
+    lk = TracedLock("ut_export")
+    for _ in range(64):
+        with lk:
+            pass
+    snap = mp.snapshot_process()  # runs registered samplers
+    by_name = {m["name"]: m for m in snap["metrics"]}
+    hist = by_name.get("ray_tpu_lock_held_seconds")
+    gauge = by_name.get("ray_tpu_lock_waiters")
+    assert hist is not None and gauge is not None
+    mine = [s for s in hist["series"]
+            if s["tags"].get("lock") == "ut_export"]
+    assert mine and mine[0]["count"] >= 64
+    assert sum(mine[0]["buckets"]) == mine[0]["count"]
+    assert any(s["tags"].get("lock") == "ut_export"
+               for s in gauge["series"])
+
+
+# ---- watchdog probes (unit) ------------------------------------------------
+
+
+def _wd(events):
+    return mp.Watchdog(
+        emit=lambda msg_type, message, **kw: events.append(
+            {"type": msg_type, "message": message, **kw}),
+        cooldown_s=0.0, wait_edge_age_s=60.0,
+        store_occupancy_frac=0.95, queue_depth=256,
+        lock_hold_s=5.0, lock_waiters=1)
+
+
+def _snap(uid, locks_digest):
+    return {"proc_uid": uid, "proc": f"proc-{uid}", "node_id": "n1",
+            "metrics": [], locks_lib.DIGEST_KEY: locks_digest}
+
+
+def test_watchdog_lock_inversion_probe():
+    events = []
+    wd = _wd(events)
+    wd.evaluate([_snap("u1", {"edges": [["a", "b"], ["b", "a"]],
+                              "long_holds": []})], {}, [])
+    inv = [e for e in events if e.get("probe") == "lock_order_inversion"]
+    assert inv and inv[0]["severity"] == "ERROR"
+    assert "a -> b -> a" in inv[0]["message"] \
+        or "b -> a -> b" in inv[0]["message"]
+    # acyclic graph: silent
+    events.clear()
+    wd.evaluate([_snap("u2", {"edges": [["a", "b"], ["b", "c"]],
+                              "long_holds": []})], {}, [])
+    assert not [e for e in events
+                if e.get("probe") == "lock_order_inversion"]
+
+
+def test_watchdog_long_hold_probe_thresholds():
+    events = []
+    wd = _wd(events)
+    wd.evaluate([_snap("u1", {"edges": [], "long_holds": [
+        {"name": "slow", "held_s": 9.0, "waiters": 2},
+        {"name": "below_time", "held_s": 2.0, "waiters": 3},
+        {"name": "no_waiters", "held_s": 30.0, "waiters": 0},
+    ]})], {}, [])
+    hits = [e for e in events if e.get("probe") == "lock_long_hold"]
+    assert len(hits) == 1 and "slow" in hits[0]["message"]
+
+
+# ---- overhead bound --------------------------------------------------------
+
+
+def test_traced_lock_overhead_bound():
+    """Uncontended acquire/release overhead bound, in-situ.
+
+    Measured as `with lock: <one dict store>` — the smallest realistic
+    critical section (no adopted lock guards zero statements). Two
+    assertions: (1) the INSTRUMENTATION cost — TracedLock vs a bare
+    threading.Lock behind an identical no-op Python context-manager
+    wrapper — stays within 3x; the wrapper baseline isolates what this
+    plane ADDS from the fixed interpreter dispatch cost any pure-Python
+    lock object pays (a raw C `with threading.Lock()` block has no
+    Python frames at all, so on fast hardware its ratio to ANY wrapper
+    grows without bound and guards nothing). (2) an absolute sanity
+    ceiling vs the raw C lock so gross regressions still fail loudly.
+    Median-of-batches, best of 3 rounds (this box times +-40%, see
+    BASELINE notes)."""
+
+    class _Floor:
+        __slots__ = ("_acq", "_rel")
+
+        def __init__(self):
+            lk = threading.Lock()
+            self._acq = lk.acquire
+            self._rel = lk.release
+
+        def __enter__(self):
+            self._acq()
+            return self
+
+        def __exit__(self, t, v, tb):
+            self._rel()
+
+    def bench(lock, n=8000, batches=9):
+        d = {}
+        meds = []
+        for _ in range(batches):
+            t0 = perf_counter()
+            for i in range(n):
+                with lock:
+                    d["k"] = i
+            meds.append((perf_counter() - t0) / n)
+        return statistics.median(meds)
+
+    bare = threading.Lock()
+    floor = _Floor()
+    traced = TracedLock("ut_bench")
+    for lk in (bare, floor, traced):
+        bench(lk, 1000, 2)  # warmup
+    best_ratio, best_abs = float("inf"), float("inf")
+    for _ in range(3):
+        t_bare = bench(bare)
+        t_floor = bench(floor)
+        t_traced = bench(traced)
+        best_ratio = min(best_ratio, t_traced / t_floor)
+        best_abs = min(best_abs, t_traced / t_bare)
+    assert best_ratio < 3.0, \
+        f"TracedLock instrumentation {best_ratio:.2f}x the wrapped " \
+        f"bare lock (bound 3x)"
+    assert best_abs < 12.0, \
+        f"TracedLock {best_abs:.2f}x a raw threading.Lock — " \
+        f"catastrophic fast-path regression"
+
+
+# ---- cluster plane ---------------------------------------------------------
+
+
+def _gcs():
+    from ray_tpu._private import worker as worker_mod
+    return worker_mod.global_worker().core_worker._gcs
+
+
+def test_locks_collect_cluster_fanout(ray_start):
+    """`locks_collect` gathers every process's traced locks; the
+    driver's own daemon locks (core_worker et al.) must be present."""
+    out = state_api.locks()
+    assert out["procs"], "no lock snapshots gathered"
+    names = {a["name"] for s in out["procs"]
+             for a in s.get("locks", ())}
+    assert "core_worker" in names
+    assert "gcs" in names or "gcs_store" in names
+    assert out.get("unreachable") == []
+
+
+def test_seeded_inversion_raises_watchdog_alert(ray_start):
+    """THE acceptance check: a seeded two-lock inversion in a live
+    process produces a cluster HEALTH_ALERT within 2 harvest
+    intervals. No deadlock actually fires — observing the opposite
+    acquisition orders is enough (lockdep semantics)."""
+    a = TracedLock("seed_inv_a")
+    b = TracedLock("seed_inv_b")
+    t_start = time.time()
+    _gcs().call("metrics_configure", interval_s=0.3, cooldown_s=0.1)
+    try:
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        interval = 0.3
+        deadline = time.monotonic() + 10
+        alerts = []
+        while time.monotonic() < deadline and not alerts:
+            alerts = [x for x in state_api.health_alerts()
+                      if x.get("probe") == "lock_order_inversion"
+                      and "seed_inv_a" in x.get("message", "")
+                      and x.get("ts", 0) >= t_start]
+            time.sleep(0.1)
+        assert alerts, "watchdog never alerted on the seeded inversion"
+        al = alerts[-1]
+        assert al["severity"] == "ERROR"
+        assert "seed_inv_b" in al["message"]
+        # within two harvest intervals (+ slack for a loaded box)
+        assert al["ts"] - t_start < interval * 2 + 3.0
+    finally:
+        _gcs().call("metrics_configure", interval_s=2.0,
+                    cooldown_s=30.0)
+
+
+def test_lock_metrics_on_cluster_endpoint(ray_start):
+    """Lock telemetry rides the ordinary metrics harvest: the merged
+    endpoint serves ray_tpu_lock_held_seconds/_lock_waiters series."""
+    lk = TracedLock("seed_metric_probe")
+    for _ in range(16):
+        with lk:
+            pass
+    text = _gcs().call("metrics_prometheus", force=True)
+    assert "ray_tpu_lock_held_seconds" in text
+    assert "ray_tpu_lock_waiters" in text
+    assert 'lock="seed_metric_probe"' in text
+
+
+def test_free_path_does_not_block_worker_lock_under_chaos(ray_start):
+    """Regression for the RT015 true positive this PR fixed: dropping
+    the last ref of a store-resident object used to run the LOCAL
+    store-delete RPC under CoreWorker._lock — a slow store server
+    stalled every worker operation. Now the delete rides the off-lock
+    drainer. Chaos-delaying store_delete widens the window (PR 7
+    pattern): put/free/put must stay fast while the delete crawls."""
+    from ray_tpu._private import worker as worker_mod
+    cw = worker_mod.global_worker().core_worker
+    payload = b"x" * 300_000  # > max_inline: store-resident
+    ray_tpu.chaos.inject("delay", method="store_delete",
+                         delay_ms=1200, max_fires=4)
+    try:
+        ref = ray_tpu.put(payload)
+        oid = ref.hex()
+        t0 = time.monotonic()
+        cw.free([ref])
+        free_s = time.monotonic() - t0
+        # the free itself and a subsequent lock-needing op both finish
+        # far inside the injected 1.2s handler delay
+        t0 = time.monotonic()
+        ref2 = ray_tpu.put(payload)
+        put_s = time.monotonic() - t0
+        assert free_s < 0.6, f"free blocked {free_s:.2f}s on the lock"
+        assert put_s < 0.6, f"put stalled {put_s:.2f}s behind free"
+        # the delayed delete still lands: the object leaves the store
+        deadline = time.monotonic() + 8
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            gone = not cw.store.contains(oid)
+            time.sleep(0.1)
+        assert gone, "queued store delete never reached the store"
+        del ref2
+    finally:
+        ray_tpu.chaos.clear()

@@ -17,6 +17,9 @@ def _cluster(ray_start):
     """Shared session cluster."""
 
 
+@pytest.mark.slow  # wall-time budget (ISSUE 9): ~21s, peripheral
+# integration (sklearn); trainer checkpoint/report plumbing stays
+# tier-1-covered by test_train.py TestDataParallelTrainer
 def test_sklearn_trainer_fits_scores_and_checkpoints(tmp_path):
     from sklearn.linear_model import LogisticRegression
 

@@ -2,10 +2,14 @@
 """CI lint runner: `python tools/lint.py [paths...]`.
 
 Thin wrapper over `python -m ray_tpu.lint` that defaults to linting the
-ray_tpu package itself (the checked-in zero-findings baseline). Exits
-non-zero on any finding so CI fails the PR; `--format=json` feeds
-dashboards and future tooling. Fast and JAX_PLATFORMS=cpu-safe: pure
-AST analysis, nothing under test is imported.
+ray_tpu package itself (the checked-in zero-findings baseline) WITH the
+on-disk incremental cache enabled (.graftlint-cache.json at the repo
+root, keyed by file content hash + rule-set fingerprint), so the tier-1
+baseline test re-parses only files that changed since the last run.
+`--changed` limits reporting to git-changed files. Exits non-zero on
+any finding so CI fails the PR; `--format=json` feeds dashboards.
+Fast and JAX_PLATFORMS=cpu-safe: pure AST analysis, nothing under test
+is imported.
 """
 
 from __future__ import annotations
@@ -14,6 +18,7 @@ import os
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CACHE_PATH = os.path.join(_REPO_ROOT, ".graftlint-cache.json")
 
 
 def main(argv=None) -> int:
@@ -22,6 +27,11 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not any(not a.startswith("-") for a in argv):
         argv.append(os.path.join(_REPO_ROOT, "ray_tpu"))
+    has_cache_flag = any(a == "--cache" or a.startswith("--cache=")
+                         for a in argv)
+    if not has_cache_flag and "--no-cache" not in argv:
+        argv += ["--cache", CACHE_PATH]
+    argv = [a for a in argv if a != "--no-cache"]
     return lint_main(argv)
 
 
