@@ -77,6 +77,18 @@ class Config:
     # held longer than this while at least this many threads queue.
     watchdog_lock_hold_s = _define("watchdog_lock_hold_s", 5.0, float)
     watchdog_lock_waiters = _define("watchdog_lock_waiters", 1, int)
+    # Serve request telemetry (serve/_telemetry.py): per-request handle
+    # wait bound at the ingress proxies (timeouts surface as 504 /
+    # DEADLINE_EXCEEDED), and the SLO watchdog probes over the
+    # harvested RED metrics — p99 latency threshold (computed from
+    # per-harvest histogram deltas) and error-rate threshold (5xx
+    # fraction of the per-harvest request delta). Runtime-tunable via
+    # the GCS `metrics_configure` RPC.
+    serve_request_timeout_s = _define(
+        "serve_request_timeout_s", 120.0, float)
+    watchdog_serve_p99_s = _define("watchdog_serve_p99_s", 2.0, float)
+    watchdog_serve_error_rate = _define(
+        "watchdog_serve_error_rate", 0.1, float)
     # Debug plane (_private/log_plane.py + log_monitor.py): per-worker
     # in-memory tail index depth, driver-stream flood control (per-source
     # token bucket), and crash-postmortem bundle sizes.

@@ -345,11 +345,17 @@ class Watchdog:
     # to ~10s of backoff
     LEASE_BACKLOG_FLOOR_S = 15.0
 
+    # minimum requests in a harvest window before the serve SLO probes
+    # judge it — a p99 or error-rate over 1-2 requests is noise
+    SERVE_MIN_REQUESTS = 5
+
     def __init__(self, emit: Callable[..., None],
                  cooldown_s: float, wait_edge_age_s: float,
                  store_occupancy_frac: float, queue_depth: int,
                  lock_hold_s: float = 5.0,
-                 lock_waiters: int = 1) -> None:
+                 lock_waiters: int = 1,
+                 serve_p99_s: float = 2.0,
+                 serve_error_rate: float = 0.1) -> None:
         self._emit = emit
         self.cooldown_s = cooldown_s
         self.wait_edge_age_s = wait_edge_age_s
@@ -357,6 +363,13 @@ class Watchdog:
         self.queue_depth = queue_depth
         self.lock_hold_s = lock_hold_s
         self.lock_waiters = lock_waiters
+        self.serve_p99_s = serve_p99_s
+        self.serve_error_rate = serve_error_rate
+        # serve SLO probes: last cumulative per-deployment request
+        # histogram / per-(deployment, code) request counts; the probe
+        # judges per-harvest DELTAS so an old breach can't alert forever
+        self._prev_serve_hist: Dict[str, Dict[str, Any]] = {}
+        self._prev_serve_req: Dict[Tuple[str, str], float] = {}
         self._last_alert: Dict[Tuple[str, str], float] = {}
         # lease probe: uid -> (leaked-slot count, monotonic ts it was
         # first seen stuck at that value)
@@ -706,6 +719,117 @@ class Watchdog:
                         node_id=snap.get("node_id"),
                         value=lh["held_s"])
 
+    def _probe_serve_slo(self, snaps: List[Dict[str, Any]]) -> None:
+        """Serve SLO probes over the harvested RED metrics (serve/
+        _telemetry.py): per deployment and per harvest window,
+
+          - ``serve_latency_slo``: the p99 upper bound from this
+            round's request-histogram DELTA (cumulative buckets diffed
+            against the previous round, merged across processes) over
+            `serve_p99_s`;
+          - ``serve_error_burn``: the 5xx fraction of this round's
+            request-count delta over `serve_error_rate` (4xx are the
+            client's errors and don't burn the budget).
+
+        Windows with fewer than SERVE_MIN_REQUESTS requests are
+        skipped, as are rounds whose deltas go negative (proxy/handle
+        churn reset a counter — judging them would fabricate traffic).
+        A sustained breach alerts within two harvest intervals (one
+        round to baseline, one to judge) and the cooldown dedupes the
+        repeats."""
+        hist_parts: Dict[str, List[Dict[str, Any]]] = {}
+        req: Dict[Tuple[str, str], float] = {}
+        for snap in snaps:
+            for m in snap.get("metrics", ()):
+                if m["name"] == "ray_tpu_serve_request_seconds" \
+                        and m["kind"] == "histogram":
+                    for s in m["series"]:
+                        dep = s["tags"].get("deployment", "?")
+                        hist_parts.setdefault(dep, []).append(
+                            {"boundaries": m["boundaries"],
+                             "buckets": s["buckets"],
+                             "sum": s["sum"], "count": s["count"]})
+                elif m["name"] == "ray_tpu_serve_requests_total":
+                    for s in m["series"]:
+                        key = (s["tags"].get("deployment", "?"),
+                               s["tags"].get("code", "?"))
+                        req[key] = req.get(key, 0.0) + s["value"]
+        # prune deployments gone from the harvest — the always-on GCS
+        # must stay bounded under deployment churn (a returning
+        # deployment just pays one fresh baseline round)
+        for dep in [d for d in self._prev_serve_hist
+                    if d not in hist_parts]:
+            del self._prev_serve_hist[dep]
+        # latency SLO from histogram deltas
+        for dep, parts in hist_parts.items():
+            cur = merge_histograms(parts)
+            prev = self._prev_serve_hist.get(dep)
+            self._prev_serve_hist[dep] = cur
+            if prev is None or prev["boundaries"] != cur["boundaries"]:
+                continue
+            delta = [c - p for c, p in zip(cur["buckets"],
+                                           prev["buckets"])]
+            total = cur["count"] - prev["count"]
+            if total < self.SERVE_MIN_REQUESTS or \
+                    any(d < 0 for d in delta):
+                continue
+            target = 0.99 * total
+            cum = 0
+            p99_edge: Optional[float] = None  # None = overflow bucket
+            for bound, d in zip(cur["boundaries"], delta):
+                cum += d
+                if cum >= target:
+                    p99_edge = bound
+                    break
+            top = cur["boundaries"][-1]
+            if p99_edge is not None and p99_edge <= self.serve_p99_s:
+                continue
+            shown = p99_edge if p99_edge is not None else top
+            self._alert(
+                "serve_latency_slo", dep,
+                f"deployment {dep!r}: p99 request latency "
+                f"{'>' if p99_edge is None else '<='} {shown:g}s over "
+                f"the last harvest window ({total:g} requests) exceeds "
+                f"the {self.serve_p99_s:g}s SLO",
+                deployment=dep, value=float(shown))
+        # error burn from request-count deltas. Deltas are judged
+        # per KEY against the previous round; a key absent from prev —
+        # first appearance, or a vanish/reappear across an unreachable
+        # round — is BASELINED, not judged, exactly like the histogram
+        # probe (else a reappearing counter's full cumulative history
+        # reads as one window and fires a false ERROR from old traffic)
+        deltas: Dict[str, Dict[str, float]] = {}
+        ok = True
+        for key, v in req.items():
+            prev_v = self._prev_serve_req.get(key)
+            if prev_v is None:
+                continue  # baseline round for this key
+            d = v - prev_v
+            if d < 0:
+                ok = False  # counter churn: skip the whole round
+                break
+            dep, code = key
+            rec = deltas.setdefault(dep, {"total": 0.0, "errors": 0.0})
+            rec["total"] += d
+            if code.startswith("5"):
+                rec["errors"] += d
+        self._prev_serve_req = req
+        if not ok:
+            return
+        for dep, rec in deltas.items():
+            if rec["total"] < self.SERVE_MIN_REQUESTS:
+                continue
+            rate = rec["errors"] / rec["total"]
+            if rate > self.serve_error_rate:
+                self._alert(
+                    "serve_error_burn", dep,
+                    f"deployment {dep!r}: {rec['errors']:g} of "
+                    f"{rec['total']:g} requests ({100 * rate:.0f}%) "
+                    f"failed with 5xx over the last harvest window "
+                    f"(error-rate SLO {100 * self.serve_error_rate:.0f}"
+                    f"%)", severity="ERROR", deployment=dep,
+                    value=rate)
+
     def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
         for node in unreachable:
             self._alert(
@@ -726,6 +850,7 @@ class Watchdog:
                       lambda: self._probe_memory(snaps, interval_s,
                                                  unreachable_nodes),
                       lambda: self._probe_locks(snaps),
+                      lambda: self._probe_serve_slo(snaps),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:
@@ -763,7 +888,9 @@ class MetricsPlane:
             store_occupancy_frac=Config.watchdog_store_occupancy_frac,
             queue_depth=Config.watchdog_queue_depth,
             lock_hold_s=Config.watchdog_lock_hold_s,
-            lock_waiters=Config.watchdog_lock_waiters)
+            lock_waiters=Config.watchdog_lock_waiters,
+            serve_p99_s=Config.watchdog_serve_p99_s,
+            serve_error_rate=Config.watchdog_serve_error_rate)
         self._harvest_hist = get_or_create(
             Histogram, "ray_tpu_metrics_harvest_seconds",
             description="wall time of one cluster metrics harvest "
@@ -949,7 +1076,10 @@ class MetricsPlane:
                   store_occupancy_frac: Optional[float] = None,
                   queue_depth: Optional[int] = None,
                   lock_hold_s: Optional[float] = None,
-                  lock_waiters: Optional[int] = None) -> Dict[str, Any]:
+                  lock_waiters: Optional[int] = None,
+                  serve_p99_s: Optional[float] = None,
+                  serve_error_rate: Optional[float] = None
+                  ) -> Dict[str, Any]:
         """Runtime tuning (ops + tests): adjust the sample interval and
         watchdog thresholds without restarting the GCS."""
         if interval_s is not None:
@@ -968,6 +1098,10 @@ class MetricsPlane:
             self.watchdog.lock_hold_s = float(lock_hold_s)
         if lock_waiters is not None:
             self.watchdog.lock_waiters = int(lock_waiters)
+        if serve_p99_s is not None:
+            self.watchdog.serve_p99_s = float(serve_p99_s)
+        if serve_error_rate is not None:
+            self.watchdog.serve_error_rate = float(serve_error_rate)
         return {"interval_s": self.interval_s,
                 "cooldown_s": self.watchdog.cooldown_s,
                 "wait_edge_age_s": self.watchdog.wait_edge_age_s,
@@ -975,7 +1109,9 @@ class MetricsPlane:
                     self.watchdog.store_occupancy_frac,
                 "queue_depth": self.watchdog.queue_depth,
                 "lock_hold_s": self.watchdog.lock_hold_s,
-                "lock_waiters": self.watchdog.lock_waiters}
+                "lock_waiters": self.watchdog.lock_waiters,
+                "serve_p99_s": self.watchdog.serve_p99_s,
+                "serve_error_rate": self.watchdog.serve_error_rate}
 
     def stop(self) -> None:
         self._stopped = True

@@ -274,6 +274,18 @@ def end(name: str, t0: float, /, **attrs: Any) -> None:
         getattr(_tls, "trace_id", None), attrs or None)
 
 
+def complete(name: str, dur_s: float, /, **attrs: Any) -> None:
+    """Record a span that ends NOW with an externally-measured duration
+    — for stages whose start lived in another process (serve's replica
+    time-in-queue: the handle's submit wall stamp → execution start).
+    The record lands on this process's timeline ending at the current
+    instant, stretching `dur_s` back — exactly end() with a
+    back-computed t0."""
+    if not _enabled:
+        return
+    end(name, perf_counter() - max(0.0, dur_s), **attrs)
+
+
 def instant(name: str, /, **attrs: Any) -> None:
     """Point-in-time event (Chrome trace ph 'i')."""
     if not _enabled:

@@ -33,6 +33,10 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
                         trace_id/level/match/tail/timeout)
     GET /api/postmortems — crash-postmortem summaries (?id=pm-... for
                         one full bundle)
+    GET /api/serve/requests — serve request telemetry: slowest + errored
+                        requests from every ingress proxy's ring
+                        (?deployment=&errors=1&slowest=N; entries carry
+                        trace ids + per-stage latency breakdowns)
 """
 
 from __future__ import annotations
@@ -330,6 +334,16 @@ class DashboardHead:
             if "id" in params:
                 return s.get_postmortem(params["id"])
             return s.postmortems(limit=int(params.get("limit", 50)))
+        if route == "/api/serve/requests":
+            # serve request telemetry: slow/errored capture across all
+            # ingress proxies (serve/_telemetry.py; CLI equivalent
+            # `ray_tpu serve requests`)
+            return s.serve_requests(
+                deployment=params.get("deployment"),
+                errors=params.get("errors") in ("1", "true"),
+                slowest=(int(params["slowest"])
+                         if "slowest" in params else None),
+                timeout=float(params.get("timeout", 10.0)))
         if route == "/api/wait_graph":
             # live actor waits-for edges + deadlocks-detected counter
             # (runtime counterpart of graftlint RT001)

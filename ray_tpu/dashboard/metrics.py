@@ -36,6 +36,21 @@ BASE_PANELS: List[Dict[str, Any]] = [
      "targets": [{"expr": "ray_tpu_wait_graph_edges"}]},
     {"title": "Deadlocks detected", "type": "timeseries",
      "targets": [{"expr": "ray_tpu_deadlocks_detected"}]},
+    # Serve request telemetry (serve/_telemetry.py): RED per deployment
+    {"title": "Serve requests/sec by code", "type": "timeseries",
+     "targets": [{"expr": "sum by (code) "
+                          "(rate(ray_tpu_serve_requests_total[1m]))"}]},
+    {"title": "Serve p99 latency by deployment", "type": "timeseries",
+     "targets": [{"expr": "histogram_quantile(0.99, sum by "
+                          "(le, deployment) (rate("
+                          "ray_tpu_serve_request_seconds_bucket[5m])))"}]},
+    {"title": "Serve p99 queue time by deployment", "type": "timeseries",
+     "targets": [{"expr": "histogram_quantile(0.99, sum by "
+                          "(le, deployment) (rate("
+                          "ray_tpu_serve_queue_seconds_bucket[5m])))"}]},
+    {"title": "Serve replica queue depth", "type": "timeseries",
+     "targets": [{"expr": "sum by (deployment) "
+                          "(ray_tpu_serve_replica_queue_depth)"}]},
 ]
 
 

@@ -1,6 +1,6 @@
-"""graftlint rule set: 16 framework-aware checks.
+"""graftlint rule set: 17 framework-aware checks.
 
-Each rule has a stable id (RT001..RT016), a one-line rationale, and a
+Each rule has a stable id (RT001..RT017), a one-line rationale, and a
 `check(ctx)` generator yielding Findings. Rules are deliberately
 conservative: a finding should be actionable, and intentional
 exceptions are silenced in-place with `# graftlint: disable=RTxxx`
@@ -805,6 +805,46 @@ class SilentExceptionSwallow(Rule):
                 "(`# noqa: BLE001 - <why>`)")
 
 
+class UnboundedWaitInServingPath(Rule):
+    id = "RT017"
+    name = "unbounded-wait-in-serving-path"
+    rationale = ("blocking ray_tpu.get()/wait() without an explicit "
+                 "finite timeout in request-serving paths (serve/, "
+                 "dashboard/) turns overload into hangs: one stuck "
+                 "replica or store pull parks a proxy/handler thread "
+                 "forever, and a saturated thread pool collapses "
+                 "instead of shedding load")
+
+    # Directories whose code sits on a request-serving path: every
+    # thread there is a bounded resource a client is waiting on.
+    _SERVING_DIR_PARTS = frozenset({"serve", "dashboard"})
+
+    def _serving(self, path: str) -> bool:
+        # DIRECTORY parts only — tools/bench_serve.py is a harness, not
+        # a serving path; its basename merely contains "serve"
+        parts = [p for p in re.split(r"[\\/]", path) if p][:-1]
+        return bool(set(parts) & self._SERVING_DIR_PARTS)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not self._serving(ctx.path):
+            return
+        for call in _blocking_calls(ctx):
+            fn = ctx.call_name(call)
+            timeout = next((k.value for k in call.keywords
+                            if k.arg == "timeout"), None)
+            unbounded = timeout is None or (
+                isinstance(timeout, ast.Constant)
+                and timeout.value is None)
+            if unbounded:
+                yield self.finding(
+                    ctx, call,
+                    f"{fn}() on a request-serving path without an "
+                    f"explicit finite timeout= waits forever when a "
+                    f"replica/store wedges — bound it (e.g. "
+                    f"Config.serve_request_timeout_s) so overload "
+                    f"sheds instead of hanging")
+
+
 # Concurrency layer (class-level guard maps + lock-order graph) lives
 # in its own module; the rules plug into the same catalogue.
 from ray_tpu.lint.concurrency import (BlockingUnderLock,  # noqa: E402
@@ -816,7 +856,7 @@ ALL_RULES: List[Rule] = [
     DictOrderPytree(), SwallowedException(), StoreViewCopy(),
     WallClockDuration(), MetricNameConvention(), BarePrintInFramework(),
     SilentExceptionSwallow(), MixedGuardAccess(), BlockingUnderLock(),
-    LockOrderCycle(),
+    LockOrderCycle(), UnboundedWaitInServingPath(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

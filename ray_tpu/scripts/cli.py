@@ -545,6 +545,44 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve request telemetry (see README "Serve request telemetry"):
+    the slowest + all errored requests captured by every ingress proxy,
+    with trace ids (feed them to `ray_tpu timeline --trace-id`) and
+    per-stage latency breakdowns."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    if args.serve_cmd != "requests":
+        raise SystemExit(f"unknown serve command {args.serve_cmd!r}")
+    out = s.serve_requests(deployment=args.deployment,
+                           errors=args.errors, slowest=args.slowest,
+                           timeout=args.timeout)
+    if args.format == "json":
+        print(json.dumps(out, default=str))
+        return 0
+    rows = []
+    for e in out["requests"]:
+        stages = e.get("stages") or {}
+        rows.append({
+            "trace_id": e.get("trace_id", ""),
+            "deployment": e.get("deployment", "?"),
+            "method": e.get("method", "?"),
+            "code": e.get("code", "?"),
+            "total_ms": f"{1e3 * (e.get('total_s') or 0.0):.1f}",
+            "stages": " ".join(
+                f"{k[:-2]}={1e3 * v:.1f}ms"
+                for k, v in sorted(stages.items())),
+            # tracebacks are multi-line; one table row per request
+            "error": " ".join(str(e.get("error") or "").split())[:60],
+        })
+    _print_table(rows, ["trace_id", "deployment", "method", "code",
+                        "total_ms", "stages", "error"])
+    print(f"({out['proxies']} prox{'y' if out['proxies'] == 1 else 'ies'}"
+          f" answered)")
+    _warn_unreachable(out.get("unreachable"))
+    return 0
+
+
 def cmd_metrics(args) -> int:
     """Cluster metrics plane (see README "Cluster metrics"): dump the
     merged registry (text exposition or JSON harvest), or print the
@@ -765,6 +803,22 @@ def main(argv=None) -> int:
     p.add_argument("--postmortems", action="store_true",
                    help="list recent crash postmortems")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("serve", help="serve request telemetry: slow + "
+                                     "errored request capture "
+                                     "(see README)")
+    p.add_argument("serve_cmd", choices=["requests"])
+    p.add_argument("--address", default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--deployment", default=None,
+                   help="filter to one deployment")
+    p.add_argument("--errors", action="store_true",
+                   help="only errored requests")
+    p.add_argument("--slowest", type=int, default=None,
+                   help="the N slowest requests across all proxies")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="overall proxy fan-out deadline (seconds)")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("metrics", help="cluster metrics plane: dump the "
                                        "merged registry / watchdog alerts")

@@ -9,8 +9,9 @@ replica actors with in-flight accounting, a threaded HTTP proxy actor.
 """
 
 from ray_tpu.serve.api import (Application, Deployment,  # noqa: F401
-                               DeploymentHandle, delete, deployment,
-                               get_handle, run, shutdown, start_http)
+                               DeploymentHandle, DeploymentNotFound,
+                               delete, deployment, get_handle, run,
+                               shutdown, start_http)
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.controller import (get_multiplexed_model_id,  # noqa: F401
                                       multiplexed)
@@ -18,6 +19,7 @@ from ray_tpu.serve.grpc_proxy import grpc_call, start_grpc  # noqa: F401
 
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
+    "DeploymentNotFound",
     "run", "get_handle", "delete", "shutdown", "start_http",
     "start_grpc", "grpc_call", "batch",
     "multiplexed", "get_multiplexed_model_id",

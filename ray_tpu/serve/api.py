@@ -21,6 +21,13 @@ from ray_tpu.serve.controller import (CONTROLLER_NAME, ServeController,
 _NAMESPACE = "serve"
 
 
+class DeploymentNotFound(Exception):
+    """No deployment by that name is registered with the controller —
+    the ingress proxies map this to 404 / NOT_FOUND (a missing route is
+    the CLIENT's error; only real replica/infrastructure failures may
+    surface as 5xx)."""
+
+
 def _get_or_create_controller():
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
@@ -139,6 +146,14 @@ class DeploymentHandle:
         # handles through deploy()'s init args).
         self._last_refresh = 0.0
         self._listener_started = False
+        # False once the controller reports the name unknown/deleted —
+        # routes _pick's empty-replica failure to DeploymentNotFound
+        # (ingress 404) instead of a generic 500
+        self._exists = True
+        # request telemetry: harvest-time queue-depth gauge (weak
+        # registration; see serve/_telemetry.py)
+        from ray_tpu.serve import _telemetry
+        _telemetry.register_handle(self)
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -161,7 +176,11 @@ class DeploymentHandle:
                     self.deployment_name), timeout=30)
             self._apply_routing_info(info)
             self._last_refresh = time.monotonic()
-            self._ensure_listener()
+            # no listener for a name the controller doesn't know: a
+            # 404 flood must not spawn a parked thread per request
+            # (the next successful refresh arms it)
+            if self._exists:
+                self._ensure_listener()
 
     def _apply_routing_info(self, info: Dict[str, Any]) -> None:
         replicas = info["replicas"]
@@ -173,6 +192,7 @@ class DeploymentHandle:
                 return
             self._routing_version = version
             self._replicas = replicas
+            self._exists = bool(info.get("exists", True))
             self._max_queries = info.get("max_concurrent_queries", 0)
             live = {r._actor_id.hex() for r in replicas}
             self._in_flight = {k: v for k, v in self._in_flight.items()
@@ -252,6 +272,10 @@ class DeploymentHandle:
         with self._lock:
             n = len(self._replicas)
             if n == 0:
+                if not self._exists:
+                    raise DeploymentNotFound(
+                        f"no deployment named "
+                        f"{self.deployment_name!r}")
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no replicas")
             if n == 1:
@@ -288,24 +312,38 @@ class DeploymentHandle:
 
     def _submit(self, args: tuple, kwargs: Dict[str, Any], *,
                 model_id: str, stream: bool):
-        self._refresh()
-        replica = self._pick(model_id)
-        key = replica._actor_id.hex()
-        with self._lock:
-            self._in_flight[key] = self._in_flight.get(key, 0) + 1
-            self._probe_delta[key] = self._probe_delta.get(key, 0) + 1
-        if stream:
-            method = replica.handle_request_stream.options(
-                num_returns="streaming")
-        else:
-            method = replica.handle_request
-        ref = method.remote(args, kwargs, model_id)
+        from ray_tpu._private import spans as _spans_lib
+        from ray_tpu.serve import _telemetry
+        t_submit = time.monotonic()
+        with _spans_lib.span("serve.handle.submit",
+                             deployment=self.deployment_name):
+            self._refresh()
+            replica = self._pick(model_id)
+            key = replica._actor_id.hex()
+            with self._lock:
+                self._in_flight[key] = self._in_flight.get(key, 0) + 1
+                self._probe_delta[key] = \
+                    self._probe_delta.get(key, 0) + 1
+            if stream:
+                method = replica.handle_request_stream.options(
+                    num_returns="streaming")
+            else:
+                method = replica.handle_request
+            # the wall stamp rides to the replica, which records its
+            # time-in-queue (submit → execution start) against it
+            ref = method.remote(args, kwargs, model_id, time.time())
 
         def _done() -> None:
             with self._lock:
                 self._in_flight[key] = max(
                     0, self._in_flight.get(key, 1) - 1)
                 self._probe_delta[key] = self._probe_delta.get(key, 1) - 1
+            # one request_seconds observation per request, handle-side:
+            # covers proxy AND direct-handle traffic without double
+            # counting, and a request the proxy abandoned at its
+            # deadline still records its true latency
+            _telemetry.observe_request(self.deployment_name,
+                                       time.monotonic() - t_submit)
 
         # completion observer — no extra thread, no second result fetch
         import ray_tpu._private.worker as worker_mod
@@ -390,9 +428,13 @@ class _StreamingResponse:
         self._gen = gen
 
     def __iter__(self):
+        from ray_tpu._private.config import Config
         for ref in self._gen:
-            # streaming: chunks are consumed in order as they land
-            yield ray_tpu.get(ref)  # graftlint: disable=RT002
+            # streaming: chunks are consumed in order as they land;
+            # bounded per chunk — a wedged generator must fail the
+            # consumer instead of parking it forever (RT017)
+            yield ray_tpu.get(  # graftlint: disable=RT002
+                ref, timeout=Config.serve_request_timeout_s)
 
 
 def run(app: Any, *, name: Optional[str] = None) -> DeploymentHandle:
@@ -438,12 +480,22 @@ def shutdown() -> None:
         pass
 
 
-def start_http(port: int = 8000) -> Any:
+def start_http(port: int = 8000,
+               request_timeout_s: Optional[float] = None) -> Any:
     """Start the HTTP ingress actor (reference proxy.py HTTPProxy): POST
     /<deployment> with a JSON body calls the deployment and returns the
-    JSON result."""
+    JSON result. `request_timeout_s` bounds each request's handle wait
+    (default Config.serve_request_timeout_s; timeouts surface as 504).
+    The actor gets a unique cluster name (SERVE_PROXY_HTTP_*, namespace
+    "serve") so the request-telemetry query plane can enumerate it."""
+    import uuid as _uuid
+
     from ray_tpu.serve.proxy import HTTPProxyActor
     cls = ray_tpu.remote(HTTPProxyActor)
-    proxy = cls.options(num_cpus=0.1).remote(port)
+    proxy = cls.options(
+        num_cpus=0.1,
+        name=f"SERVE_PROXY_HTTP_{_uuid.uuid4().hex[:8]}",
+        namespace=_NAMESPACE).remote(
+        port, request_timeout_s=request_timeout_s)
     ray_tpu.get(proxy.ready.remote(), timeout=60)
     return proxy

@@ -127,35 +127,74 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
 
 class Replica:
     """The per-replica actor: hosts one instance of the user deployment
-    (reference serve/_private/replica.py)."""
+    (reference serve/_private/replica.py). Request telemetry (README
+    "Serve request telemetry"): each request records its time-in-queue
+    (the handle's submit wall stamp → execution start, into
+    ``ray_tpu_serve_queue_seconds{deployment}`` + a span) and its
+    execution as a ``serve.replica.execute`` span — both carry the
+    ingress trace id, which the executor already restored from the task
+    spec before this method runs."""
 
     def __init__(self, target_blob: bytes, init_args: tuple,
-                 init_kwargs: Dict[str, Any]):
+                 init_kwargs: Dict[str, Any],
+                 deployment_name: str = ""):
         import cloudpickle
         target = cloudpickle.loads(target_blob)
         if isinstance(target, type):
             self._callable = target(*init_args, **init_kwargs)
         else:
             self._callable = target
+        self.deployment_name = deployment_name
         self._in_flight = 0
         self._total = 0
         self._lock = TracedLock("serve_replica")
+        from ray_tpu.serve import _telemetry
+        _telemetry.register_replica(self)
 
     @_control_group
     def ping(self) -> str:
         return "pong"
 
+    def ongoing_requests(self) -> int:
+        """Queued + executing on this worker's default executor group —
+        the harvest-time replica queue-depth gauge reads this (NOT an
+        actor call: runs in-process from the metrics sampler)."""
+        from ray_tpu._private import worker as worker_mod
+        w = worker_mod.global_worker_or_none()
+        ex = w.core_worker.executor if w is not None else None
+        return ex.queue_depth("") if ex is not None else 0
+
+    def _record_queue_time(self, submit_ts) -> None:
+        if not submit_ts:
+            return
+        import time as _time
+
+        from ray_tpu._private import spans as spans_lib
+        from ray_tpu.serve import _telemetry
+        # cross-process interval: the handle stamped WALL time (monotonic
+        # clocks are per-process); same-host skew is negligible next to
+        # queueing delay  # graftlint: disable=RT010
+        queue_s = max(0.0, _time.time() - submit_ts)
+        _telemetry.observe_queue(self.deployment_name, queue_s)
+        spans_lib.complete("serve.replica.queue", queue_s,
+                           deployment=self.deployment_name)
+
     def handle_request(self, args: tuple, kwargs: Dict[str, Any],
-                       model_id: str = "") -> Any:
+                       model_id: str = "", submit_ts=None) -> Any:
+        from ray_tpu._private import spans as spans_lib
+        self._record_queue_time(submit_ts)
         with self._lock:
             self._in_flight += 1
             self._total += 1
         _current_model_id.value = model_id
         try:
-            fn = self._callable
-            if not callable(fn):
-                raise TypeError(f"deployment target {fn!r} is not callable")
-            return fn(*args, **kwargs)
+            with spans_lib.span("serve.replica.execute",
+                                deployment=self.deployment_name):
+                fn = self._callable
+                if not callable(fn):
+                    raise TypeError(
+                        f"deployment target {fn!r} is not callable")
+                return fn(*args, **kwargs)
         finally:
             _current_model_id.value = ""
             with self._lock:
@@ -163,20 +202,25 @@ class Replica:
 
     def handle_request_stream(self, args: tuple,
                               kwargs: Dict[str, Any],
-                              model_id: str = ""):
+                              model_id: str = "", submit_ts=None):
         """Generator variant (reference serve streaming responses /
         proxy.py:556): the deployment callable returns an iterable and
         chunks stream back as they are produced (num_returns=
         "streaming" on the caller side)."""
+        from ray_tpu._private import spans as spans_lib
+        self._record_queue_time(submit_ts)
         with self._lock:
             self._in_flight += 1
             self._total += 1
         _current_model_id.value = model_id
         try:
-            fn = self._callable
-            out = fn(*args, **kwargs)
-            for chunk in out:
-                yield chunk
+            with spans_lib.span("serve.replica.execute",
+                                deployment=self.deployment_name,
+                                stream=True):
+                fn = self._callable
+                out = fn(*args, **kwargs)
+                for chunk in out:
+                    yield chunk
         finally:
             _current_model_id.value = ""
             with self._lock:
@@ -322,11 +366,14 @@ class ServeController:
         with self._lock:
             state = self._deployments.get(name)
             if state is None:
+                # exists=False routes the handle's empty-replica failure
+                # to DeploymentNotFound (ingress 404), distinct from a
+                # known deployment transiently at zero replicas
                 return {"replicas": [], "max_concurrent_queries": 0,
-                        "snapshot_id": snap}
+                        "snapshot_id": snap, "exists": False}
             return {"replicas": list(state.replicas),
                     "max_concurrent_queries": state.max_concurrent_queries,
-                    "snapshot_id": snap}
+                    "snapshot_id": snap, "exists": True}
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -366,7 +413,8 @@ class ServeController:
         opts["concurrency_groups"] = {
             **(opts.get("concurrency_groups") or {}), "control": 2}
         return cls.options(**opts).remote(
-            state.target_blob, state.init_args, state.init_kwargs)
+            state.target_blob, state.init_args, state.init_kwargs,
+            deployment_name=state.name)
 
     def _stop_replicas(self, replicas: List[Any]) -> None:
         import ray_tpu

@@ -289,6 +289,68 @@ def get_postmortem(postmortem_id: str) -> Optional[Dict[str, Any]]:
     return _gcs().call("postmortem_get", postmortem_id=postmortem_id)
 
 
+def serve_requests(deployment: Optional[str] = None,
+                   errors: bool = False,
+                   slowest: Optional[int] = None,
+                   timeout: float = 10.0) -> Dict[str, Any]:
+    """Captured serve requests from every ingress proxy's bounded ring
+    (`ray_tpu serve requests`, dashboard /api/serve/requests): the
+    slowest and all errored requests, each with its trace id,
+    deployment, status code, per-stage latency breakdown, and error.
+    Proxies self-register as named actors (SERVE_PROXY_*, namespace
+    "serve"); ones that don't answer are named in `unreachable` — an
+    empty capture from an unreachable proxy is not an empty capture.
+    `errors=True` restricts to errored requests; `slowest=N` returns
+    the N slowest across all proxies; `deployment` filters either
+    view."""
+    import ray_tpu
+    entries: List[Dict[str, Any]] = []
+    proxies = 0
+    unreachable: List[str] = []
+    pending: List[tuple] = []  # (proxy name, snapshot ref)
+    for a in list_actors():
+        name = a.get("name") or ""
+        if a.get("state") == "DEAD" or \
+                not name.startswith("SERVE_PROXY_"):
+            continue
+        try:
+            h = ray_tpu.get_actor(name, namespace=a.get("namespace")
+                                  or "serve")
+            pending.append((name, h.requests_snapshot.remote(
+                deployment=deployment, errors=errors,
+                slowest=slowest)))
+        except Exception:  # noqa: BLE001 - named in the reply instead
+            unreachable.append(name)
+    if pending:
+        # one batched wait bounds the whole fan-out by `timeout`
+        # instead of timeout x proxies
+        ready, _ = ray_tpu.wait([r for _n, r in pending],
+                                num_returns=len(pending),
+                                timeout=timeout)
+        ready_set = {r.hex() for r in ready}
+        for name, ref in pending:
+            if ref.hex() not in ready_set:
+                unreachable.append(name)
+                continue
+            try:
+                # ready refs: the get is a local materialize, zero
+                # extra round trips
+                entries.extend(  # graftlint: disable=RT002
+                    ray_tpu.get(ref, timeout=timeout))
+                proxies += 1
+            except Exception:  # noqa: BLE001 - named in the reply instead
+                unreachable.append(name)
+    if slowest is not None:
+        # composes with errors=True: the N slowest ERRORED requests
+        entries.sort(key=lambda e: e.get("total_s") or 0.0,
+                     reverse=True)
+        entries = entries[:slowest]
+    else:
+        entries.sort(key=lambda e: e.get("ts") or 0.0)
+    return {"requests": entries, "proxies": proxies,
+            "unreachable": unreachable}
+
+
 def chaos_rules() -> Dict[str, Any]:
     """Installed chaos rules + cluster-wide fired counts (the runtime
     view behind `ray_tpu chaos list` and the dashboard /api/chaos)."""

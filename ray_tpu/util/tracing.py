@@ -26,6 +26,26 @@ def get_current_trace_id() -> Optional[str]:
 
 
 @contextlib.contextmanager
+def use_trace(trace_id: str, name: Optional[str] = None):
+    """Adopt an EXTERNALLY-minted trace id for the current thread:
+    every task submitted inside the block (and transitively, their
+    children) carries it. This is the ingress half of request tracing —
+    the Serve HTTP/gRPC proxies wrap each request in use_trace(<the
+    X-Request-Id header, or a minted id>) so one id links proxy →
+    handle → replica → nested deployment calls in `ray_tpu timeline
+    --trace-id` (see README "Serve request telemetry")."""
+    w = worker_mod.global_worker()
+    cw = w.core_worker
+    prev_id = cw.current_trace_id()
+    prev_name = cw.current_trace_name()
+    cw.set_current_trace(trace_id, name=name)
+    try:
+        yield trace_id
+    finally:
+        cw.set_current_trace(prev_id, name=prev_name)
+
+
+@contextlib.contextmanager
 def start_trace(name: str = ""):
     """Group every task submitted in this block (and transitively, their
     children) under one trace id; yields the id. `name` labels the

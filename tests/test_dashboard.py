@@ -54,6 +54,12 @@ def test_dashboard_endpoints(ray_start):
                    for s in locks["procs"]
                    for a in s.get("locks", ()))
 
+        # serve request telemetry: the route answers with the query
+        # plane's shape even with no proxies running
+        reqs = _get(port, "/api/serve/requests?errors=1")
+        assert "requests" in reqs and "proxies" in reqs \
+            and "unreachable" in reqs
+
         # HTML overview serves
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/", timeout=30) as r:

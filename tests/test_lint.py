@@ -945,8 +945,76 @@ def test_rt013_terminal_facing_paths_exempt(path):
 def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == [f"RT00{i}" for i in range(1, 10)] + \
-        ["RT010", "RT011", "RT012", "RT013", "RT014", "RT015", "RT016"]
+        ["RT010", "RT011", "RT012", "RT013", "RT014", "RT015", "RT016",
+         "RT017"]
     assert all(r.rationale for r in ALL_RULES)
+
+
+# ---- RT017 unbounded wait in serving path ---------------------------------
+
+RT017_POS = """
+    import ray_tpu
+
+    def dispatch(handle, body):
+        ref = handle.remote(body)
+        return ray_tpu.get(ref)
+"""
+
+RT017_POS_TIMEOUT_NONE = """
+    import ray_tpu
+
+    def dispatch(handle, body):
+        return ray_tpu.get(handle.remote(body), timeout=None)
+"""
+
+RT017_POS_WAIT = """
+    import ray_tpu
+
+    def drain(refs):
+        return ray_tpu.wait(refs, num_returns=len(refs))
+"""
+
+RT017_NEG_BOUNDED = """
+    import ray_tpu
+
+    def dispatch(handle, body, deadline):
+        return ray_tpu.get(handle.remote(body), timeout=deadline)
+"""
+
+RT017_SUPPRESSED = """
+    import ray_tpu
+
+    def dispatch(handle, body):
+        return ray_tpu.get(handle.remote(body))  # graftlint: disable=RT017
+"""
+
+
+def _rt017_hits(src, path):
+    return {f.rule_id
+            for f in lint_source(textwrap.dedent(src), path)}
+
+
+@pytest.mark.parametrize("src", [RT017_POS, RT017_POS_TIMEOUT_NONE,
+                                 RT017_POS_WAIT])
+def test_rt017_unbounded_wait_on_serving_path_flagged(src):
+    assert "RT017" in _rt017_hits(src, "ray_tpu/serve/proxy.py")
+    assert "RT017" in _rt017_hits(src, "ray_tpu/dashboard/head.py")
+
+
+def test_rt017_bounded_and_suppressed_fine():
+    assert "RT017" not in _rt017_hits(RT017_NEG_BOUNDED,
+                                      "ray_tpu/serve/proxy.py")
+    assert "RT017" not in _rt017_hits(RT017_SUPPRESSED,
+                                      "ray_tpu/serve/proxy.py")
+
+
+def test_rt017_non_serving_paths_exempt():
+    # the rule is scoped to DIRECTORY parts: core code may carry
+    # intentionally-unbounded gets (RT001/RT002 police those), and a
+    # file merely NAMED like serving code is not a serving path
+    for path in ("ray_tpu/_private/core_worker.py",
+                 "tools/bench_serve.py", "ray_tpu/data/dataset.py"):
+        assert "RT017" not in _rt017_hits(RT017_POS, path), path
 
 
 # ---- RT014 mixed-guard attribute access -----------------------------------

@@ -328,8 +328,12 @@ def test_traced_lock_overhead_bound():
     traced = TracedLock("ut_bench")
     for lk in (bare, floor, traced):
         bench(lk, 1000, 2)  # warmup
+    # best of 5: under full-suite contention a 3-round best still
+    # caught a preempted floor batch and read 3.05x (isolated runs
+    # measure ~1.5-2x); two extra rounds buy a clean pair without
+    # loosening the bound itself
     best_ratio, best_abs = float("inf"), float("inf")
-    for _ in range(3):
+    for _ in range(5):
         t_bare = bench(bare)
         t_floor = bench(floor)
         t_traced = bench(traced)

@@ -1,11 +1,16 @@
-"""Serve throughput microbench: handle path and HTTP proxy path.
+"""Serve throughput + latency microbench: handle path and HTTP proxy path.
 
 reference parity: the reference ships proxy/handle throughput release
-tests (serve release suite); this measures requests/sec through (a) a
-DeploymentHandle with queue-aware P2C routing and (b) the HTTP ingress
-actor, on a trivial deployment.
+tests (serve release suite); this measures requests/sec AND latency
+percentiles (p50/p95/p99) through (a) a DeploymentHandle with
+queue-aware P2C routing and (b) the HTTP ingress actor, on a trivial
+deployment — plus an in-situ estimate of the request-telemetry plane's
+overhead (per-record span/metric cost x records per request / request
+latency, the PR-5 flight-recorder methodology: a direct on/off A-B
+cannot resolve sub-1% effects under this box's scheduling noise).
 
     python tools/bench_serve.py [--seconds 15] [--out FILE]
+                                [--format json|text]
 """
 
 from __future__ import annotations
@@ -20,12 +25,74 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _percentiles(samples, points=(50, 95, 99)):
+    if not samples:
+        return {f"p{p}": None for p in points}
+    s = sorted(samples)
+    out = {}
+    for p in points:
+        idx = min(len(s) - 1, max(0, round(p / 100.0 * len(s)) - 1))
+        out[f"p{p}"] = round(s[idx] * 1e3, 3)  # ms
+    return out
+
+
+def _record_costs() -> dict:
+    """In-situ per-record costs of the telemetry primitives a serve
+    request pays: one flight-recorder span record and one tagged
+    metric op (counter inc / histogram observe are the same shape).
+    Warmed, best-of-batches (the lockdep overhead test's methodology):
+    the primitive's intrinsic cost is what scales with request volume —
+    a batch that caught a scheduler preemption on this contended box
+    would overstate it 10x."""
+    from ray_tpu._private import spans
+    from ray_tpu.util.metrics import Histogram, get_or_create
+
+    def best_of(fn, batches=5, n=10000):
+        fn(1000)  # warm
+        return min(fn(n) for _ in range(batches))
+
+    def span_batch(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            spans.end("bench.span_cost", spans.begin())
+        return (time.perf_counter() - t0) / n
+
+    hist = get_or_create(Histogram, "bench_serve_cost_seconds",
+                         boundaries=[0.01, 1.0],
+                         tag_keys=("deployment",))
+
+    def metric_batch(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            hist.observe(0.001, tags={"deployment": "bench"})
+        return (time.perf_counter() - t0) / n
+
+    return {"span_record_s": best_of(span_batch),
+            "metric_op_s": best_of(metric_batch)}
+
+
+def _overhead(costs: dict, mean_latency_s: float,
+              spans_per_req: int, metrics_per_req: int) -> dict:
+    per_req = (spans_per_req * costs["span_record_s"]
+               + metrics_per_req * costs["metric_op_s"])
+    return {
+        "spans_per_request": spans_per_req,
+        "metric_ops_per_request": metrics_per_req,
+        "telemetry_cost_per_request_us": round(per_req * 1e6, 2),
+        "overhead_frac": (round(per_req / mean_latency_s, 5)
+                          if mean_latency_s > 0 else None),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=15.0)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--format", choices=("json", "text"),
+                    default="json")
     args = ap.parse_args()
 
+    import urllib.error
     import urllib.request
 
     import ray_tpu
@@ -38,22 +105,40 @@ def main() -> None:
         return x
 
     handle = serve.run(echo)
-    assert ray_tpu.get(handle.remote(1)) == 1  # warm replicas + listener
+    assert ray_tpu.get(handle.remote(1), timeout=60) == 1  # warm
 
     # ---- handle path: keep a pipeline of in-flight calls ------------
     window = 32
-    refs = [handle.remote(i) for i in range(window)]
+    submit_ts = {}
+    lat_handle = []
+    errors_handle = 0
+    refs = []
+    for i in range(window):
+        r = handle.remote(i)
+        submit_ts[r.hex()] = time.perf_counter()
+        refs.append(r)
     n = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < args.seconds:
         done, refs = ray_tpu.wait(refs, num_returns=1, timeout=10)
-        ray_tpu.get(done)
+        now = time.perf_counter()
+        for d in done:
+            lat_handle.append(now - submit_ts.pop(d.hex(), now))
+            try:
+                ray_tpu.get(d, timeout=10)
+            except Exception:  # noqa: BLE001 - counted, not fatal
+                errors_handle += 1
         n += len(done)
-        refs.append(handle.remote(n))
-    handle_rps = n / (time.perf_counter() - t0)
+        r = handle.remote(n)
+        submit_ts[r.hex()] = time.perf_counter()
+        refs.append(r)
+    handle_dt = time.perf_counter() - t0
+    handle_rps = n / handle_dt
 
     # ---- HTTP proxy path --------------------------------------------
     proxy = serve.start_http(port=8123)
+    lat_http = []
+    errors_http = 0
     n_http = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < args.seconds:
@@ -61,20 +146,61 @@ def main() -> None:
             "http://127.0.0.1:8123/bench_echo",
             data=json.dumps({"x": n_http}).encode(),
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            resp.read()
+        t1 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+        except (urllib.error.URLError, OSError):
+            errors_http += 1
+        lat_http.append(time.perf_counter() - t1)
         n_http += 1
-    http_rps = n_http / (time.perf_counter() - t0)
+    http_dt = time.perf_counter() - t0
+    http_rps = n_http / http_dt
+
+    # ---- telemetry overhead (in-situ per-record methodology) --------
+    costs = _record_costs()
+    mean_handle = sum(lat_handle) / max(1, len(lat_handle))
+    mean_http = sum(lat_http) / max(1, len(lat_http))
 
     result = {
         "suite": "serve_throughput",
-        "handle_requests_per_sec": round(handle_rps, 1),
-        "http_proxy_requests_per_sec": round(http_rps, 1),
+        "seconds_per_path": args.seconds,
         "replicas": 2,
-        "note": "1-CPU-core host; serial HTTP client, pipelined handle "
-                "client (window 32)",
+        "handle": {
+            "requests_per_sec": round(handle_rps, 1),
+            "requests": n,
+            "errors": errors_handle,
+            "latency_ms": {**_percentiles(lat_handle),
+                           "mean": round(mean_handle * 1e3, 3)},
+            # handle path records: handle.submit + replica.queue +
+            # replica.execute spans; request_seconds + queue_seconds
+            "telemetry": _overhead(costs, mean_handle, 3, 2),
+        },
+        "http_proxy": {
+            "requests_per_sec": round(http_rps, 1),
+            "requests": n_http,
+            "errors": errors_http,
+            "latency_ms": {**_percentiles(lat_http),
+                           "mean": round(mean_http * 1e3, 3)},
+            # + proxy.request/proxy.write spans and requests_total
+            "telemetry": _overhead(costs, mean_http, 5, 3),
+        },
+        "telemetry_record_costs_us": {
+            k: round(v * 1e6, 3) for k, v in costs.items()},
+        "note": "pipelined handle client (window 32), serial HTTP "
+                "client; overhead = records/request x in-situ record "
+                "cost / mean latency (direct A-B too noisy for sub-1%)",
     }
-    print(json.dumps(result))
+    if args.format == "json":
+        print(json.dumps(result, indent=1))
+    else:
+        for path in ("handle", "http_proxy"):
+            r = result[path]
+            print(f"{path}: {r['requests_per_sec']}/s "
+                  f"({r['requests']} reqs, {r['errors']} errors) "
+                  f"latency {r['latency_ms']} "
+                  f"telemetry overhead "
+                  f"{r['telemetry']['overhead_frac']}")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=1)
