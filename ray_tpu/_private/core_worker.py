@@ -28,6 +28,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private import log_plane as _log_plane
 from ray_tpu._private import memory_plane as _memory_plane
 from ray_tpu._private import metrics_plane as _metrics_plane
+from ray_tpu._private import ownership as _ownership
 from ray_tpu._private import profiler as _profiler
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import serialization as ser
@@ -45,6 +46,11 @@ logger = logging.getLogger(__name__)
 
 # Object location tags (owner's object directory entries)
 INLINE, STORE, ERROR, PENDING, FREED = "inline", "store", "error", "pending", "freed"
+# the ownership protocol module validates location edges against the
+# same tags; a drift between the two would corrupt its state machine
+assert (INLINE, STORE, ERROR, PENDING, FREED) == (
+    _ownership.INLINE, _ownership.STORE, _ownership.ERROR,
+    _ownership.PENDING, _ownership.FREED)
 
 # the package root, for callsite capture: the creation site reported by
 # `ray_tpu memory --group-by callsite` is the first frame OUTSIDE the
@@ -67,6 +73,10 @@ def _capture_callsite() -> Optional[str]:
 
 # Sentinel: materialization must be retried after in-flight recovery.
 _RETRY = object()
+
+# CoreWorker instance epochs (see CoreWorker.epoch / ObjectRef.__del__)
+import itertools as _itertools  # noqa: E402
+_CW_EPOCH = _itertools.count(1)
 
 # Lazy transport metrics (util.metrics registers per-process; created on
 # first use so importing this module costs nothing).
@@ -121,6 +131,11 @@ class _TaskEntry:
     spec: TaskSpec
     retries_left: int
     return_ids: List[ObjectID]
+    # submission order (monotonic per owner): failure batches re-enqueue
+    # in THIS order — submission order is topological for data
+    # dependencies, while an arbitrary (hex-sorted) order can queue a
+    # dependent ahead of its dependency and deadlock a pipelined lease
+    submit_seq: int = 0
     lease_node: Optional[Tuple[str, int]] = None
     node_id_hex: Optional[str] = None  # node the lease was granted on
     sched_key: Optional[bytes] = None  # scheduling-key for lease reuse
@@ -136,34 +151,12 @@ class _TaskEntry:
     dynamic_event: threading.Event = field(default_factory=threading.Event)
 
 
-@dataclass
-class _SchedKeyState:
-    """Owner-side per-scheduling-key submission state (reference
-    direct_task_transport.cc SchedulingKey entries): tasks of one shape
-    share a queue, at most one lease request is in flight per key, and
-    leased workers are reused back-to-back while the queue has work —
-    one push RPC per task instead of a lease round trip per task."""
-
-    queue: "collections.deque" = field(
-        default_factory=collections.deque)
-    # outstanding lease requests (reference pipelines lease requests
-    # against backlog — one request per queued task up to a cap — so a
-    # burst fans out over workers instead of serializing onto the first
-    # lease)
-    requests_in_flight: int = 0
-    # of those, how many are parked at each NM awaiting an async grant
-    # ("queued" reply received, no grant yet). A slot held with no
-    # parked request and no queued work is a LEAK — the watchdog's
-    # lease_slot_balance probe alarms on in_flight - parked. Keyed by
-    # NM address so a node death discards exactly that NM's entry
-    # without corrupting counts parked elsewhere; per-NM values are
-    # signed (a grant can outrace its request's "queued" reply,
-    # dipping one to -1 until the reply lands) and clamped at read.
-    parked_at: Dict[Tuple[str, int], int] = field(default_factory=dict)
-    # lease_id -> (worker_address, nm_address, node_id_hex)
-    leases: Dict[str, Tuple] = field(default_factory=dict)
-    # lease_id -> tasks pushed but not yet completed (pipeline depth)
-    lease_inflight: Dict[str, int] = field(default_factory=dict)
+# Owner-side per-scheduling-key submission state lives in the ownership
+# protocol module (ownership.LeaseState): tasks of one shape share a
+# queue, lease request slots cover the backlog up to a cap, and leased
+# workers are reused back-to-back while the queue has work — one push
+# RPC per task instead of a lease round trip per task. All slot/parked/
+# lease/pipeline counts mutate through LeaseTable methods (RT018).
 
 
 @dataclass
@@ -192,6 +185,11 @@ class CoreWorker:
                  worker_id: Optional[WorkerID] = None,
                  host: str = "127.0.0.1"):
         assert mode in ("driver", "worker")
+        # instance epoch: ObjectRefs bind their refcount registration to
+        # the CoreWorker instance that counted it (object_ref.__del__) —
+        # a stale ref from a shut-down cluster must not release against
+        # a successor instance's reference table
+        self.epoch = next(_CW_EPOCH)
         self.mode = mode
         self.job_id = job_id
         self.worker_id = worker_id or WorkerID.from_random()
@@ -206,8 +204,19 @@ class CoreWorker:
         self.current_placement_group_id = None
 
         self._lock = TracedRLock("core_worker")
+        # Ownership protocol state (_private/ownership.py): the explicit
+        # RefState/LeaseState machines behind this worker's reference
+        # counting and lease bookkeeping. The aliases below preserve the
+        # historical read surface (memory/metrics planes, tests); every
+        # MUTATION goes through the tables' methods, which funnel into
+        # ownership.transition() — the choke point that validates legal
+        # edges and records the transition ring `ray_tpu ownership`
+        # serves. Mutations are made under self._lock (tables don't
+        # lock; see ownership.py's locking contract).
+        self._own = _ownership.RefTable()
+        self._ltab = _ownership.LeaseTable()
         # Owner-side object directory: oid hex -> (tag, ...) location
-        self.objects: Dict[str, Tuple] = {}
+        self.objects: Dict[str, Tuple] = self._own.objects
         self.object_events: Dict[str, threading.Event] = {}
         # oid hex -> [callback]: fired once when the object becomes ready
         # (value or error), without a blocking get (used by handle-style
@@ -219,18 +228,20 @@ class CoreWorker:
         # (cw_add_ref) on first local ref and releases it (cw_remove_ref)
         # when its last local ref drops, so the object outlives the owner's
         # own release while borrowed.
-        self.local_refs: Dict[str, int] = {}
-        self.arg_pins: Dict[str, int] = {}
-        self.borrowed: Dict[str, Tuple[str, int]] = {}  # oid hex -> owner addr
+        self.local_refs: Dict[str, int] = self._own.local_refs
+        self.arg_pins: Dict[str, int] = self._own.arg_pins
+        # oid hex -> owner addr
+        self.borrowed: Dict[str, Tuple[str, int]] = self._own.borrowed
         # oid hex -> reader-lease count held on the LOCAL store's pulled
         # replica (zero-copy views stay valid while leased); released
         # when this process's last local ref to the object drops
-        self._replica_leases: Dict[str, int] = {}
+        self._replica_leases: Dict[str, int] = self._own.replica_leases
         # Owner-side borrower accounting: oid hex -> {borrower addr: count}.
         # A liveness sweep drops pins of borrowers that died without
         # releasing (reference: ReferenceCounter detects borrower failure
         # via the WaitForRefRemoved long-poll connection breaking).
-        self.borrower_pins: Dict[str, Dict[Tuple[str, int], int]] = {}
+        self.borrower_pins: Dict[str, Dict[Tuple[str, int], int]] = \
+            self._own.borrower_pins
         # One long-lived drainer for borrow releases instead of a thread
         # per dropped ref (releases are fire-and-forget, order irrelevant).
         self._borrow_release_queue: "queue.Queue" = queue.Queue()
@@ -241,21 +252,27 @@ class CoreWorker:
         # behind it — the drainer batch-flushes this list every
         # iteration, so local eviction lags by at most one item.
         self._local_free_pending: List[str] = []
+        # (ready_time, item) releases that failed transiently, waiting
+        # out their backoff before re-entering the release queue
+        self._release_retries: List[Tuple[float, Tuple]] = []
+        self._last_borrower_sweep = time.monotonic()
         # enclosing-result oid hex -> [(owner_addr, nested oid hex)]
         # eager borrows on refs embedded in task results (see
         # _register_nested_borrows)
-        self._nested_borrows: Dict[str, List[Tuple]] = {}
+        self._nested_borrows: Dict[str, List[Tuple]] = \
+            self._own.nested_borrows
         # (deadline, local hexes, remote (addr, hex)) transit pins on
         # refs embedded in results this EXECUTOR shipped (see
         # pin_refs_with_ttl); expired by the borrow-release loop
-        self._ttl_pins: List[Tuple] = []
+        self._ttl_pins: List[Tuple] = self._own.ttl_pins
         self.tasks: Dict[str, _TaskEntry] = {}
         self.actors: Dict[str, _ActorState] = {}
-        self._sched_keys: Dict[bytes, _SchedKeyState] = {}
+        self._sched_keys: Dict[bytes, _ownership.LeaseState] = \
+            self._ltab.keys
         # lease_id -> set of task hexes pushed-but-incomplete on that
         # lease (worker death reports fail exactly these under lease
         # reuse + pipelining)
-        self._lease_running: Dict[str, set] = {}
+        self._lease_running: Dict[str, set] = self._ltab.running
         # actor id hex -> submitted-but-unfinished calls from THIS
         # process (max_pending_calls backpressure is per caller, like
         # the reference's submit-queue bound)
@@ -295,6 +312,10 @@ class CoreWorker:
             "cw_recover_object": self._on_recover_object,
             "cw_add_ref": self._on_add_ref,
             "cw_remove_ref": self._on_remove_ref,
+            # anti-entropy: owners ask whether this process still claims
+            # pinned objects (the lost-release safety net; see
+            # _sweep_dead_borrowers)
+            "cw_claims": self._on_claims,
             "cw_pubsub_push": self._on_pubsub_push,
             "cw_kill_self": self._on_kill_self,
             "cw_can_exit": self._on_can_exit,
@@ -326,6 +347,10 @@ class CoreWorker:
             # memory attribution plane (_private/memory_plane.py):
             # owner-side reference-table dump for `ray_tpu memory`
             "cw_memory_snapshot": self.memory_snapshot,
+            # ownership protocol plane (_private/ownership.py): live
+            # RefState/LeaseState + transition-ring tail for
+            # `ray_tpu ownership` / /api/ownership
+            "cw_ownership_snapshot": self.ownership_snapshot,
             # lockdep plane (ray_tpu/util/locks.py): traced-lock
             # snapshot for `ray_tpu locks` / /api/locks
             "cw_locks_snapshot": _locks_util.snapshot,
@@ -629,10 +654,9 @@ class CoreWorker:
         h = ref.hex()
         register_borrow = False
         with self._lock:
-            n = self.local_refs.get(h, 0) + 1
-            self.local_refs[h] = n
+            n = self._own.incr_local(h)
             if n == 1 and not self._is_own(ref) and h not in self.borrowed:
-                self.borrowed[h] = tuple(ref.owner_address)
+                self._own.note_borrow(h, tuple(ref.owner_address))
                 register_borrow = True
         if register_borrow:
             # Synchronous so the borrower pin lands before the task that
@@ -646,7 +670,7 @@ class CoreWorker:
                 # later cw_remove_ref would decrement a pin some OTHER
                 # borrower legitimately holds.
                 with self._lock:
-                    self.borrowed.pop(h, None)
+                    self._own.drop_borrow(h, event="borrow_rollback")
 
     def remove_local_ref(self, ref: ObjectRef) -> None:
         if self._shutdown:
@@ -654,13 +678,13 @@ class CoreWorker:
         release_borrow = None
         with self._lock:
             h = ref.hex()
-            n = self.local_refs.get(h, 0) - 1
+            # strict: a second release of the same ObjectRef is exactly
+            # the double-release class the protocol exists to catch
+            n = self._own.decr_local(h)
             if n > 0:
-                self.local_refs[h] = n
                 return
-            self.local_refs.pop(h, None)
-            release_borrow = self.borrowed.pop(h, None)
-            lease_count = self._replica_leases.pop(h, 0)
+            release_borrow = self._own.drop_borrow(h)
+            lease_count = self._own.pop_replica_leases(h)
             # owner-side free runs regardless of replica leases: an owned
             # ref whose value was pulled from a remote store still must
             # free on last drop (the lease release below is independent)
@@ -677,10 +701,11 @@ class CoreWorker:
         if release_borrow is not None:
             self._borrow_release_queue.put((release_borrow, h))
 
-    def _maybe_free_locked(self, oid_hex: str) -> None:
+    def _maybe_free_locked(self, oid_hex: str,
+                           force: bool = False) -> None:
         loc = self.objects.get(oid_hex)
-        if loc is None or loc[0] == PENDING:
-            return  # task in flight; keep until completion
+        if loc is None or loc[0] in (PENDING, FREED):
+            return  # in flight (keep until completion) / already freed
         if loc[0] == STORE:
             # the delete must reach the store that HOLDS the primary:
             # a task result created pinned in the executing worker's
@@ -713,21 +738,21 @@ class CoreWorker:
             # drains (memory_plane.py)
             self._recently_freed.append((oid_hex, time.monotonic()))
         self._callsites.pop(oid_hex, None)
-        self.objects[oid_hex] = (FREED,)
+        # the RefState machine rejects free-while-pinned here unless
+        # forced (ray.free's explicit "free even though referenced")
+        self._own.set_location(oid_hex, (FREED,), event="free",
+                               force=force)
         # release eager borrows on refs nested inside this result (see
         # _register_nested_borrows): remote owners via the async release
         # queue; locally-owned nested objects unpin (and may free) here
-        nested = self._nested_borrows.pop(oid_hex, None)
+        nested = self._own.pop_nested(oid_hex)
         if nested:
             for owner_addr, ref_hex in nested:
                 if owner_addr == self.address:
-                    n = self.arg_pins.get(ref_hex, 0) - 1
-                    if n <= 0:
-                        self.arg_pins.pop(ref_hex, None)
-                        if self.local_refs.get(ref_hex, 0) == 0:
-                            self._maybe_free_locked(ref_hex)
-                    else:
-                        self.arg_pins[ref_hex] = n
+                    n = self._own.unpin_arg(ref_hex,
+                                            event="nested_unpin")
+                    if n <= 0 and self.local_refs.get(ref_hex, 0) == 0:
+                        self._maybe_free_locked(ref_hex)
                 else:
                     self._borrow_release_queue.put((owner_addr, ref_hex))
 
@@ -743,20 +768,28 @@ class CoreWorker:
             addr = tuple(owner_addr)
             if addr == self.address:
                 with self._lock:
-                    self.arg_pins[oid.hex()] = \
-                        self.arg_pins.get(oid.hex(), 0) + 1
+                    self._own.pin_arg(oid.hex(), event="nested_pin")
             else:
+                # transit claim bridges the gap until note_nested below
+                # records the durable claim (the owner's reconciliation
+                # sweep must never see a claimless pin)
+                with self._lock:
+                    self._own.add_transit_out(oid.hex())
                 try:
                     self._pool.get(addr).call(
                         "cw_add_ref", oid_hex=oid.hex(),
                         borrower=self.address)
                 except Exception:  # noqa: BLE001 — owner gone; the get
-                    continue      # will surface the loss
+                    with self._lock:  # will surface the loss
+                        self._own.drop_transit_out(oid.hex())
+                    continue
             recorded.append((addr, oid.hex()))
         if recorded:
             with self._lock:
-                self._nested_borrows.setdefault(outer_hex,
-                                                []).extend(recorded)
+                self._own.note_nested(outer_hex, recorded)
+                for addr, h in recorded:
+                    if addr != self.address:
+                        self._own.drop_transit_out(h)
 
     def add_done_callback(self, ref: ObjectRef, cb: Any) -> None:
         """Invoke cb() once when the owned object is no longer pending.
@@ -808,6 +841,33 @@ class CoreWorker:
             except Exception:  # noqa: BLE001 - store gone; the
                 pass           # residency probe flags leftovers
 
+    # Transient-failure budget for protocol releases riding the drainer
+    # (borrow releases, remote-primary deletes): a dropped connection
+    # must not leak the pin/copy forever — the item re-queues with
+    # backoff and only a peer that stays unreachable this long loses it
+    # (the dead-borrower sweep / leak probes then own the cleanup).
+    RELEASE_RETRY_ATTEMPTS = 4
+    RELEASE_RETRY_BACKOFF_S = 0.5
+
+    def _requeue_release(self, item: Tuple, attempts: int) -> None:
+        if attempts >= self.RELEASE_RETRY_ATTEMPTS:
+            logger.warning("giving up on protocol release %s after %d "
+                           "attempts", item[:2], attempts)
+            return
+        with self._lock:
+            self._release_retries.append(
+                (time.monotonic()
+                 + self.RELEASE_RETRY_BACKOFF_S * (attempts + 1), item))
+
+    def _drain_release_retries(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            due = [it for t, it in self._release_retries if t <= now]
+            self._release_retries = [
+                (t, it) for t, it in self._release_retries if t > now]
+        for it in due:
+            self._borrow_release_queue.put(it)
+
     def _borrow_release_loop(self) -> None:
         while not self._shutdown:
             try:
@@ -816,38 +876,69 @@ class CoreWorker:
                 logger.exception("ttl pin expiry failed")
             try:
                 self._drain_local_frees()
+                self._drain_release_retries()
             except Exception:  # noqa: BLE001
                 logger.exception("local free drain failed")
             try:
-                item = self._borrow_release_queue.get(timeout=10.0)
+                item = self._borrow_release_queue.get(timeout=2.0)
             except queue.Empty:
                 # Idle: sweep for borrowers that died without releasing.
-                try:
-                    self._sweep_dead_borrowers()
-                except Exception:  # noqa: BLE001
-                    logger.exception("borrower sweep failed")
+                # (Sweep cadence rides the queue timeout; retries above
+                # need the shorter tick.)
+                now = time.monotonic()
+                if now - self._last_borrower_sweep >= 10.0:
+                    self._last_borrower_sweep = now
+                    try:
+                        self._sweep_dead_borrowers()
+                    except Exception:  # noqa: BLE001
+                        logger.exception("borrower sweep failed")
+                    # idle gc: refcounting rides __del__, but ObjectRefs
+                    # captured in exception-traceback CYCLES (a failed
+                    # task's frames hold its arg refs) wait for the gc —
+                    # and an idle worker may not allocate enough to
+                    # trigger one for minutes, pinning objects at their
+                    # owners the whole time (reference: Ray triggers
+                    # worker gc under plasma pressure for the same
+                    # reason)
+                    try:
+                        import gc as _gc
+                        _gc.collect()
+                    # a finalizer crashing mid-collection must not kill
+                    # the drainer; the cycle just waits for the next tick
+                    except Exception:  # noqa: BLE001  graftlint: disable=RT013
+                        pass
                 continue
             if item is None:
                 return
             if len(item) == 1:
                 continue  # local_free wake: drained at loop top
-            if len(item) == 3 and item[0] == "store_delete":
+            if item[0] == "store_delete":
                 # remote-primary free queued by _maybe_free_locked (the
                 # connect must happen OFF the CoreWorker lock)
-                _tag, store_addr, oid_hex = item
+                _tag, store_addr, oid_hex = item[:3]
+                attempts = item[3] if len(item) > 3 else 0
                 try:
                     self._pool.get(store_addr).send_oneway(
                         "store_delete", object_ids=[oid_hex])
-                except Exception:  # noqa: BLE001 - node gone; the leak
-                    pass           # probe flags any stranded copy
+                except Exception:  # noqa: BLE001 - transient: retry with
+                    # backoff; a node that stays gone loses the copy and
+                    # the residency probe flags any stranded one
+                    self._requeue_release(
+                        ("store_delete", store_addr, oid_hex,
+                         attempts + 1), attempts)
                 continue
-            owner_addr, oid_hex = item
+            owner_addr, oid_hex = item[:2]
+            attempts = item[2] if len(item) > 2 else 0
             try:
                 self._pool.get(owner_addr).call("cw_remove_ref",
                                                 oid_hex=oid_hex,
                                                 borrower=self.address)
-            except Exception:  # noqa: BLE001 - owner gone; nothing to free
-                pass
+            except Exception:  # noqa: BLE001 - transient: retry with
+                # backoff so a dropped connection doesn't leak the pin
+                # at a LIVE owner forever (a dead owner has nothing to
+                # free)
+                self._requeue_release((owner_addr, oid_hex, attempts + 1),
+                                      attempts)
 
     def pin_refs(self, refs: List[Any]) -> Tuple[List[str], List[Tuple]]:
         """Pin objects across a result/report hand-off window: locally
@@ -867,14 +958,21 @@ class CoreWorker:
                 remote_keys.append((tuple(ref.owner_address), ref.hex()))
         with self._lock:
             for h in local:
-                self.arg_pins[h] = self.arg_pins.get(h, 0) + 1
+                self._own.pin_arg(h, event="transit_pin")
         remote_sent: List[Tuple] = []
         for addr, h in remote_keys:
+            # claim evidence for cw_claims BEFORE the send: the owner's
+            # reconciliation sweep must never observe the pin without
+            # the claim that protects it
+            with self._lock:
+                self._own.add_transit_out(h)
             try:
                 self._pool.get(addr).send_oneway(
                     "cw_add_ref", oid_hex=h, borrower=self.address)
             except Exception:  # noqa: BLE001 — owner gone; the consumer's
-                continue      # get surfaces the loss
+                with self._lock:   # get surfaces the loss
+                    self._own.drop_transit_out(h)
+                continue
             remote_sent.append((addr, h))
         return (local, remote_sent)
 
@@ -885,6 +983,8 @@ class CoreWorker:
         local, remote_keys = handle
         with self._lock:
             self._release_local_pins_locked(local)
+            for _addr, h in remote_keys:
+                self._own.drop_transit_out(h)
         for addr, h in remote_keys:
             self._borrow_release_queue.put((addr, h))
 
@@ -895,8 +995,8 @@ class CoreWorker:
         granularity) rather than one timer thread per result."""
         local, remote_keys = handle
         with self._lock:
-            self._ttl_pins.append(
-                (time.monotonic() + ttl_s, local, remote_keys))
+            self._own.add_ttl_pins(time.monotonic() + ttl_s, local,
+                                   remote_keys)
 
     def pin_refs_with_ttl(self, refs: List[Any],
                           ttl_s: float = 30.0) -> None:
@@ -906,23 +1006,20 @@ class CoreWorker:
 
     def _release_local_pins_locked(self, hexes: List[str]) -> None:
         for h in hexes:
-            n = self.arg_pins.get(h, 0) - 1
-            if n <= 0:
-                self.arg_pins.pop(h, None)
-                if self.local_refs.get(h, 0) == 0:
-                    self._maybe_free_locked(h)
-            else:
-                self.arg_pins[h] = n
+            n = self._own.unpin_arg(h, event="transit_unpin")
+            if n <= 0 and self.local_refs.get(h, 0) == 0:
+                self._maybe_free_locked(h)
 
     def _expire_ttl_pins(self) -> None:
         now = time.monotonic()
         with self._lock:
-            due = [p for p in self._ttl_pins if p[0] <= now]
+            due = self._own.pop_due_ttl(now)
             if not due:
                 return
-            self._ttl_pins = [p for p in self._ttl_pins if p[0] > now]
-            for _, local, _ in due:
+            for _, local, remote_keys in due:
                 self._release_local_pins_locked(local)
+                for _addr, h in remote_keys:
+                    self._own.drop_transit_out(h)
         for _, _, remote_keys in due:
             for addr, h in remote_keys:
                 self._borrow_release_queue.put((addr, h))
@@ -930,19 +1027,15 @@ class CoreWorker:
     def _pin_args(self, refs: List[ObjectID]) -> None:
         with self._lock:
             for oid in refs:
-                self.arg_pins[oid.hex()] = self.arg_pins.get(oid.hex(), 0) + 1
+                self._own.pin_arg(oid.hex(), event="arg_pin")
 
     def _unpin_args(self, refs: List[ObjectID]) -> None:
         with self._lock:
             for oid in refs:
                 h = oid.hex()
-                n = self.arg_pins.get(h, 0) - 1
-                if n <= 0:
-                    self.arg_pins.pop(h, None)
-                    if self.local_refs.get(h, 0) == 0:
-                        self._maybe_free_locked(h)
-                else:
-                    self.arg_pins[h] = n
+                n = self._own.unpin_arg(h, event="arg_unpin")
+                if n <= 0 and self.local_refs.get(h, 0) == 0:
+                    self._maybe_free_locked(h)
 
     # ------------------------------------------------------------------
     # Put / Get / Wait / Free
@@ -955,7 +1048,7 @@ class CoreWorker:
             self._note_callsite([h])
         loc = self.store_value(h, value)
         with self._lock:
-            self.objects[h] = loc
+            self._own.set_location(h, loc, event="put")
             ev = self.object_events.get(h)
             if ev is not None:
                 ev.set()
@@ -1116,8 +1209,7 @@ class CoreWorker:
         remove_local_ref) keeps the zero-copy view valid."""
         view = self.store.pull(oid_hex, store_addr, size, pin=True)
         with self._lock:
-            self._replica_leases[oid_hex] = \
-                self._replica_leases.get(oid_hex, 0) + 1
+            self._own.add_replica_lease(oid_hex)
         _transport_bytes(size, "pull")
         return view
 
@@ -1259,7 +1351,8 @@ class CoreWorker:
             for rh in produced:
                 if self.objects.get(rh, (PENDING,))[0] not in (FREED, INLINE,
                                                               ERROR):
-                    self.objects[rh] = (PENDING,)
+                    self._own.set_location(rh, (PENDING,),
+                                           event="recover")
                     self.object_events.setdefault(rh, threading.Event()).clear()
         logger.info("recovering object %s by resubmitting task %s",
                     oid_hex[:16], entry.spec.function_name)
@@ -1443,7 +1536,9 @@ class CoreWorker:
         with self._lock:
             for r in refs:
                 if self._is_own(r):
-                    self._maybe_free_locked(r.hex())
+                    # explicit ray.free contract: free even though
+                    # references may still exist (forced transition)
+                    self._maybe_free_locked(r.hex(), force=True)
 
     # ------------------------------------------------------------------
     # Function export/import (reference _private/function_manager.py)
@@ -1477,12 +1572,19 @@ class CoreWorker:
                       for i in range(spec.num_returns)]
         entry = _TaskEntry(spec=spec, retries_left=spec.max_retries,
                            return_ids=return_ids,
-                           sched_key=self._sched_key(spec))
+                           sched_key=self._sched_key(spec),
+                           submit_seq=self.next_put_index())
         with self._lock:
             for oid in return_ids:
-                self.objects[oid.hex()] = (PENDING,)
+                self._own.set_location(oid.hex(), (PENDING,),
+                                       event="submit")
                 self.object_events[oid.hex()] = threading.Event()
             self.tasks[spec.task_id.hex()] = entry
+        # the caller's refs register BEFORE the task can complete: the
+        # free-on-resolve check in _on_task_done reads local_refs == 0
+        # as "nobody can ever reach this result" — a fast completion
+        # racing a later registration would free a live result
+        refs_out = [ObjectRef(oid, self.address) for oid in return_ids]
         if Config.memory_callsite_capture and return_ids:
             self._note_callsite([oid.hex() for oid in return_ids])
         self._attach_trace(spec)
@@ -1495,7 +1597,7 @@ class CoreWorker:
             self._locality_info(spec.arg_object_refs)
         self._pin_args(spec.arg_object_refs)
         self._enqueue_for_lease(spec.task_id.hex(), entry)
-        return [ObjectRef(oid, self.address) for oid in return_ids]
+        return refs_out
 
     @staticmethod
     def _sched_key(spec: TaskSpec):
@@ -1513,7 +1615,7 @@ class CoreWorker:
         the queue over leased workers and re-request while backlogged)."""
         key = entry.sched_key
         with self._lock:
-            ks = self._sched_keys.setdefault(key, _SchedKeyState())
+            ks = self._ltab.state(key)
             if not entry.in_key_queue:
                 # retry of a task still queued (e.g. node-death fail of
                 # a queued lease head) must not enqueue a second copy —
@@ -1534,22 +1636,22 @@ class CoreWorker:
         multiple leases, latency from per-lease pipelining."""
         while True:
             with self._lock:
-                ks = self._sched_keys.get(key)
+                ks = self._ltab.get(key)
                 if ks is None:
                     return
                 desired = min(len(ks.queue),
                               self.MAX_PENDING_LEASE_REQUESTS)
                 if ks.requests_in_flight >= desired:
                     return
-                ks.requests_in_flight += 1
+                self._ltab.claim_slot(ks)
             self._request_lease_for_key(key, nm=nm)
             nm = None
 
     def _release_request_slot(self, key) -> None:
         with self._lock:
-            ks = self._sched_keys.get(key)
-            if ks is not None and ks.requests_in_flight > 0:
-                ks.requests_in_flight -= 1
+            ks = self._ltab.get(key)
+            if ks is not None:
+                self._ltab.release_slot(ks, event="slot_release")
 
     def _locality_info(self, arg_ids: List[ObjectID]):
         """(node id hex -> resident arg bytes, oid -> (store, size)) from
@@ -1593,7 +1695,7 @@ class CoreWorker:
         with self._lock:
             entry = self.tasks.get(task_id.hex())
             if entry is not None:
-                ks = self._sched_keys.get(entry.sched_key)
+                ks = self._ltab.get(entry.sched_key)
                 if ks is not None:
                     # the queued request is gone at the sending NM: the
                     # slot we hold is no longer parked anywhere until
@@ -1605,7 +1707,7 @@ class CoreWorker:
                     old = (tuple(from_address) if from_address
                            else tuple(entry.lease_node)
                            if entry.lease_node else None)
-                    ks.parked_at[old] = ks.parked_at.get(old, 0) - 1
+                    self._ltab.unpark(ks, old)
         if entry is None:
             return
         # The old queued request is gone at the NM: re-enter the request
@@ -1628,7 +1730,7 @@ class CoreWorker:
         without popping; releases the caller's request slot and returns
         None when the queue has no live work."""
         with self._lock:
-            ks = self._sched_keys.get(key)
+            ks = self._ltab.get(key)
             if ks is None:
                 return None
             while ks.queue:
@@ -1639,8 +1741,7 @@ class CoreWorker:
                 ks.queue.popleft()
                 if entry is not None:
                     entry.in_key_queue = False
-            if ks.requests_in_flight > 0:
-                ks.requests_in_flight -= 1
+            self._ltab.release_slot(ks, event="slot_release_drained")
             return None
 
     def _request_lease_for_key(self, key: bytes, nm=None) -> None:
@@ -1697,11 +1798,9 @@ class CoreWorker:
                     # now parked at this NM (the grant or a respill
                     # unparks it)
                     with self._lock:
-                        ks = self._sched_keys.get(key)
+                        ks = self._ltab.get(key)
                         if ks is not None:
-                            addr = tuple(nm_cur.address)
-                            ks.parked_at[addr] = \
-                                ks.parked_at.get(addr, 0) + 1
+                            self._ltab.park(ks, tuple(nm_cur.address))
                     return
                 if kind == "infeasible":
                     verdict = str(payload)
@@ -1711,7 +1810,7 @@ class CoreWorker:
             if verdict is None:
                 verdict = "too many spillbacks"
             with self._lock:
-                ks = self._sched_keys.get(key)
+                ks = self._ltab.get(key)
                 if ks is not None:
                     try:
                         ks.queue.remove(task_hex)
@@ -1732,22 +1831,31 @@ class CoreWorker:
                           nm_address: Optional[Tuple[str, int]] = None
                           ) -> None:
         with self._lock:
+            fresh = self._ltab.note_grant(lease_id)
             named = self.tasks.get(task_id.hex())
+        if not fresh:
+            # at-least-once delivery: the NM re-queues a lease whose
+            # reply failed transiently, but the first delivery may have
+            # landed (reply lost after processing) and already done the
+            # slot/park/lease bookkeeping — hand the duplicate straight
+            # back instead of corrupting the counts
+            self._return_lease(lease_id, None, nm_address=nm_address)
+            return
         key = named.sched_key if named is not None else None
         if key is None:
             # Unknown task (e.g. owner restarted): just hand it back.
             self._return_lease(lease_id, named, nm_address=nm_address)
             return
         with self._lock:
-            ks = self._sched_keys.setdefault(key, _SchedKeyState())
-            if ks.requests_in_flight > 0:
-                ks.requests_in_flight -= 1
+            ks = self._ltab.state(key)
+            self._ltab.release_slot(ks, event="slot_granted")
             # signed: may beat the request's own "queued" reply
-            addr = tuple(nm_address) if nm_address else None
-            ks.parked_at[addr] = ks.parked_at.get(addr, 0) - 1
-            ks.leases[lease_id] = (tuple(worker_address),
-                                   tuple(nm_address) if nm_address
-                                   else None, node_id)
+            self._ltab.unpark(ks, tuple(nm_address) if nm_address
+                              else None)
+            self._ltab.add_lease(
+                ks, lease_id, (tuple(worker_address),
+                               tuple(nm_address) if nm_address
+                               else None, node_id))
         # The grant names the task whose spec rode the request, but any
         # queued task of the same key may run on it (reference
         # OnWorkerIdle drains the SchedulingKey queue).
@@ -1764,6 +1872,46 @@ class CoreWorker:
         if backlog:
             threading.Thread(target=self._kick_key, args=(key,),
                              daemon=True, name="lease-kick").start()
+
+    def ownership_snapshot(self, object_id: Optional[str] = None,
+                           limit: int = 200) -> Dict[str, Any]:
+        """This process's ownership-protocol view: live RefState rows
+        (every object with a live claim), per-scheduling-key LeaseState
+        summaries, and the transition ring's tail — the wire form
+        behind `ray_tpu ownership` / /api/ownership / util.state."""
+        with self._lock:
+            if object_id:
+                keys = {h for h in (set(self.objects)
+                                    | set(self.local_refs)
+                                    | set(self.arg_pins)
+                                    | set(self.borrower_pins)
+                                    | set(self._replica_leases)
+                                    | set(self.borrowed))
+                        if h.startswith(object_id)}
+                objs = [self._own.describe(h) for h in sorted(keys)]
+            else:
+                objs = self._own.live_objects()
+            lease_keys = self._ltab.summary()
+            running = {lid: sorted(h[:16] for h in hs)
+                       for lid, hs in self._lease_running.items()}
+            ttl_count = len(self._ttl_pins)
+        snap = _ownership.ring().snapshot(
+            key_prefix=object_id or None, limit=limit)
+        return {
+            "proc_uid": _spans.PROC_UID,
+            "pid": os.getpid(),
+            "label": _spans.process_label(),
+            "node_id": self.node_id_hex,
+            "worker_id": self.worker_id.hex(),
+            "mode": self.mode,
+            "wall_time": time.time(),
+            "objects": objs,
+            "lease_keys": lease_keys,
+            "running_leases": running,
+            "ttl_pins": ttl_count,
+            "transitions": snap["transitions"],
+            "anomalies": snap["anomalies"],
+        }
 
     # Tasks pushed-but-incomplete per lease: 2 = the worker always has
     # the next task queued locally when it finishes one, so the owner's
@@ -1786,7 +1934,7 @@ class CoreWorker:
         lease is never returned) and concurrent pushers (over-depth)."""
         while True:
             with self._lock:
-                ks = self._sched_keys.get(key)
+                ks = self._ltab.get(key)
                 info = ks.leases.get(lease_id) if ks is not None else None
                 inflight = ks.lease_inflight.get(lease_id, 0) \
                     if ks is not None else 0
@@ -1799,23 +1947,67 @@ class CoreWorker:
                     action = "noop"
                 else:
                     worker_address, nm_addr, node_id = info
-                    # pop the next live queued task (inline: same lock)
+                    # pop the next live queued task (inline: same lock).
+                    # When pipelining BEHIND a running task (inflight >=
+                    # 1), never pick a task that PRODUCES a pending arg
+                    # of anything running on this lease: the runner may
+                    # be blocked in get() on exactly that object, and
+                    # normal tasks execute on one thread — queueing the
+                    # producer behind its blocked consumer deadlocks the
+                    # worker permanently (found by the ownership
+                    # fuzzer's kill schedules via retry re-ordering).
+                    # Skipped candidates keep their queue position; a
+                    # fresh lease (the enqueue path keeps request slots
+                    # covering the backlog) runs them elsewhere.
+                    unsafe_producers: set = set()
+                    if inflight > 0:
+                        # TRANSITIVE closure over pending args: the
+                        # runner may wait X <- E <- F, and pushing F
+                        # behind it deadlocks just as surely as pushing
+                        # E (walk bounded by live dependency chains)
+                        frontier = list(
+                            self._lease_running.get(lease_id, ()))
+                        seen_t = set(frontier)
+                        while frontier:
+                            re_ = self.tasks.get(frontier.pop())
+                            if re_ is None:
+                                continue
+                            for aid in re_.spec.arg_object_refs:
+                                if self.objects.get(
+                                        aid.hex(),
+                                        (None,))[0] != PENDING:
+                                    continue
+                                p = aid.task_id().hex()
+                                if p not in seen_t:
+                                    seen_t.add(p)
+                                    unsafe_producers.add(p)
+                                    frontier.append(p)
                     task = None
+                    skipped: List[str] = []
                     while ks.queue:
                         h = ks.queue.popleft()
                         e2 = self.tasks.get(h)
+                        if e2 is not None and not e2.done \
+                                and h in unsafe_producers:
+                            skipped.append(h)
+                            continue
                         if e2 is not None:
                             e2.in_key_queue = False
                         if e2 is not None and not e2.done:
                             task = (h, e2)
                             break
+                    for h in reversed(skipped):
+                        ks.queue.appendleft(h)
                     if task is None:
                         if inflight == 0:
-                            ks.leases.pop(lease_id, None)
-                            ks.lease_inflight.pop(lease_id, None)
+                            self._ltab.drop_lease(ks, lease_id)
                             action = "return_drained"
                         else:
-                            action = "noop"
+                            # skipped-only backlog: make sure lease
+                            # requests still cover it so the skipped
+                            # producers run on ANOTHER worker (their
+                            # blocked consumer holds this one)
+                            action = "kick" if skipped else "noop"
                     elif getattr(task[1].spec, "max_calls", 0) \
                             and inflight >= 1:
                         # no pipelining under max_calls recycling: the
@@ -1831,9 +2023,7 @@ class CoreWorker:
                         entry.node_id_hex = node_id
                         if nm_addr is not None:
                             entry.lease_node = nm_addr
-                        ks.lease_inflight[lease_id] = inflight + 1
-                        self._lease_running.setdefault(
-                            lease_id, set()).add(task_hex)
+                        self._ltab.incr_inflight(ks, lease_id, task_hex)
                         action = "push"
             if action == "return_untracked":
                 # lease not tracked (already dropped): return via the
@@ -1842,6 +2032,11 @@ class CoreWorker:
                 return
             if action == "return_drained":
                 self._return_lease(lease_id, None, nm_address=nm_addr)
+                return
+            if action == "kick":
+                threading.Thread(target=self._kick_key, args=(key,),
+                                 daemon=True,
+                                 name="pipeline-skip-kick").start()
                 return
             if action != "push":
                 return
@@ -1859,13 +2054,8 @@ class CoreWorker:
                     "w_push_task", spec=entry.spec, lease_id=lease_id)
             except Exception as e:  # noqa: BLE001
                 with self._lock:
-                    ks.leases.pop(lease_id, None)
-                    ks.lease_inflight.pop(lease_id, None)
-                    on_lease = self._lease_running.get(lease_id)
-                    if on_lease is not None:
-                        on_lease.discard(task_hex)
-                        if not on_lease:
-                            self._lease_running.pop(lease_id, None)
+                    self._ltab.drop_lease(ks, lease_id)
+                    self._ltab.drop_running_task(lease_id, task_hex)
                 self._return_lease(lease_id, entry)
                 self._fail_task(task_hex, "WORKER_DIED",
                                 f"push to leased worker failed: {e}",
@@ -1881,12 +2071,22 @@ class CoreWorker:
             nm_addr = entry.lease_node
         else:
             nm_addr = self.nm_address
-        try:
-            self._pool.get(nm_addr).send_oneway("nm_return_worker",
-                                                lease_id=lease_id,
-                                                reuse=reuse)
-        except Exception:  # noqa: BLE001 - NM gone; its leases died with it
-            pass
+        # a LOST return strands the lease at the NM: the worker stays
+        # "leased" and its resources held until process death — so
+        # transient send failures retry with backoff (nm_return_worker
+        # releases a lease id at most once, duplicates are no-ops).
+        # One-way (not .call): this runs inside NM-driven handler
+        # threads, where a blocking call back to the NM can three-way
+        # deadlock on the shared per-address client locks.
+        for delay_s in (0.0, 0.1, 0.4):
+            if delay_s:
+                time.sleep(delay_s)
+            try:
+                self._pool.get(nm_addr).send_oneway(
+                    "nm_return_worker", lease_id=lease_id, reuse=reuse)
+                return
+            except Exception:  # noqa: BLE001 - retried; an NM that
+                continue       # stays gone took its leases with it
 
     def _on_task_done(self, task_id: TaskID, results: List[Tuple],
                       lease_id: Optional[str] = None,
@@ -1920,9 +2120,16 @@ class CoreWorker:
                 self._decr_actor_pending_locked(entry)
                 # dynamic-return children become owned objects of ours,
                 # registered before the generator handle resolves so a
-                # get() of a child ref never races its registration
+                # get() of a child ref never races its registration.
+                # FREED children stay freed: a consumer that already
+                # dropped its ref must not have the batch re-report
+                # resurrect the location (the RefState machine rejects
+                # the FREED->ready edge).
                 for oid, loc in (dynamic_children or []):
-                    self.objects[oid.hex()] = tuple(loc)
+                    if self.objects.get(oid.hex(),
+                                        (PENDING,))[0] != FREED:
+                        self._own.set_location(oid.hex(), tuple(loc),
+                                               event="dynamic_child")
                     ev = self.object_events.get(oid.hex())
                     if ev is not None:  # recovery getters waiting
                         ev.set()
@@ -1954,10 +2161,12 @@ class CoreWorker:
             with self._lock:
                 # keep location unless already freed
                 if self.objects.get(oid.hex(), (PENDING,))[0] != FREED:
-                    self.objects[oid.hex()] = tuple(loc)
+                    self._own.set_location(oid.hex(), tuple(loc),
+                                           event="resolve")
                 ev = self.object_events.get(oid.hex())
                 if ev is not None:
                     ev.set()
+        self._free_refless_returns(entry)
         self._unpin_args(entry.spec.arg_object_refs)
         self.task_events.record(h, state="FINISHED", ts_finished=_ev_now())
         _count_task_outcome("finished")
@@ -1965,6 +2174,33 @@ class CoreWorker:
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
         if lease_id is not None:
             self._settle_lease_slot(entry, lease_id, worker_exiting)
+
+    def _free_refless_returns(self, entry: _TaskEntry) -> None:
+        """Free-on-resolve: a result whose every ref died while the
+        task was PENDING has no reachable holder left — the free check
+        at last-ref drop saw PENDING and deferred "until completion",
+        and completion (success OR failure) must re-run it. Without
+        this the result — and, for successes, the eager nested borrows
+        pinning objects at OTHER owners — leaks forever (found by the
+        ownership fuzzer's drop schedules). Generator results free only
+        when the handle is refless too: unreferenced children are
+        otherwise still reachable through a live generator's handle."""
+        with self._lock:
+            handle_hex = entry.return_ids[0].hex() \
+                if entry.return_ids else None
+            generator = bool(entry.spec.dynamic_returns
+                             or entry.dynamic_arrived)
+            handle_refless = handle_hex is not None and \
+                self.local_refs.get(handle_hex, 0) == 0 and \
+                self.arg_pins.get(handle_hex, 0) == 0
+            if not generator or handle_refless:
+                victims = [oid.hex() for oid in entry.return_ids]
+                victims += [c.hex()
+                            for c in entry.dynamic_arrived.values()]
+                for h2 in victims:
+                    if self.local_refs.get(h2, 0) == 0 and \
+                            self.arg_pins.get(h2, 0) == 0:
+                        self._maybe_free_locked(h2)
 
     def _settle_lease_slot(self, entry: Optional[_TaskEntry],
                            lease_id: str, worker_exiting: bool) -> None:
@@ -1976,15 +2212,8 @@ class CoreWorker:
         key = entry.sched_key if entry is not None else None
         task_hex = entry.spec.task_id.hex() if entry is not None else None
         with self._lock:
-            on_lease = self._lease_running.get(lease_id)
-            if on_lease is not None and task_hex is not None:
-                on_lease.discard(task_hex)
-                if not on_lease:
-                    self._lease_running.pop(lease_id, None)
-            ks = self._sched_keys.get(key) if key is not None else None
-            if ks is not None and lease_id in ks.lease_inflight:
-                ks.lease_inflight[lease_id] = max(
-                    0, ks.lease_inflight[lease_id] - 1)
+            self._ltab.settle_inflight(self._ltab.get(key), lease_id,
+                                       task_hex)
         if worker_exiting:
             self._drop_lease(key, lease_id)
             self._return_lease(lease_id, entry, reuse=False)
@@ -1997,10 +2226,9 @@ class CoreWorker:
     def _drop_lease(self, key: Optional[bytes], lease_id: str) -> None:
         """Forget a held lease (it is being returned/retired)."""
         with self._lock:
-            ks = self._sched_keys.get(key) if key is not None else None
+            ks = self._ltab.get(key)
             if ks is not None:
-                ks.leases.pop(lease_id, None)
-                ks.lease_inflight.pop(lease_id, None)
+                self._ltab.drop_lease(ks, lease_id)
 
     def _on_dynamic_child(self, task_id: TaskID, child: ObjectID,
                           loc: Tuple) -> None:
@@ -2011,7 +2239,8 @@ class CoreWorker:
             if entry is None:
                 return
             if self.objects.get(child.hex(), (PENDING,))[0] != FREED:
-                self.objects[child.hex()] = tuple(loc)
+                self._own.set_location(child.hex(), tuple(loc),
+                                       event="dynamic_child")
             entry.dynamic_arrived[child.return_index()] = child
             entry.dynamic_event.set()
             ev = self.object_events.get(child.hex())
@@ -2029,9 +2258,17 @@ class CoreWorker:
             # worker) may differ from the task the lease was granted
             # for — the lease→running map has the truth.
             with self._lock:
-                running = self._lease_running.pop(lease_id, None)
+                running = self._ltab.pop_running(lease_id)
             if running:
-                fail_hexes = sorted(running)
+                # SUBMISSION order, not hex order: the retries re-enter
+                # the key queue in this order, and submission order is
+                # topological for data dependencies — a dependent
+                # re-queued ahead of its dependency can end up pipelined
+                # behind it on one single-threaded worker and deadlock
+                fail_hexes = sorted(
+                    running,
+                    key=lambda th: (self.tasks[th].submit_seq
+                                    if th in self.tasks else 0))
             entry = self.tasks.get(fail_hexes[0])
             if entry is not None and entry.sched_key is not None:
                 self._drop_lease(entry.sched_key, lease_id)
@@ -2067,10 +2304,15 @@ class CoreWorker:
         blob = pickle.dumps(err)
         for oid in entry.return_ids:
             with self._lock:
-                self.objects[oid.hex()] = (ERROR, blob)
+                if self.objects.get(oid.hex(), (PENDING,))[0] != FREED:
+                    self._own.set_location(oid.hex(), (ERROR, blob),
+                                           event="fail")
                 ev = self.object_events.get(oid.hex())
                 if ev is not None:
                     ev.set()
+        # same refless-free sweep as the success path: a failed
+        # fire-and-forget task must not leak its (ERROR, blob) entry
+        self._free_refless_returns(entry)
         self._unpin_args(entry.spec.arg_object_refs)
         self.task_events.record(task_hex, state="FAILED",
                                 ts_finished=_ev_now(),
@@ -2158,7 +2400,8 @@ class CoreWorker:
                 blob = pickle.dumps(
                     exc.ActorDiedError(actor_id.hex(), state.death_cause))
                 for oid in return_ids:
-                    self.objects[oid.hex()] = (ERROR, blob)
+                    self._own.set_location(oid.hex(), (ERROR, blob),
+                                           event="actor_dead")
                 return [ObjectRef(oid, self.address) for oid in return_ids]
             # backpressure bound checked ATOMICALLY with the increment:
             # an unlocked pre-check would let concurrent submitters
@@ -2172,7 +2415,8 @@ class CoreWorker:
             spec.sequence_number = state.seq
             state.seq += 1
             for oid in return_ids:
-                self.objects[oid.hex()] = (PENDING,)
+                self._own.set_location(oid.hex(), (PENDING,),
+                                       event="submit")
                 self.object_events[oid.hex()] = threading.Event()
             self.tasks[spec.task_id.hex()] = _TaskEntry(
                 spec=spec, retries_left=0, return_ids=return_ids)
@@ -2184,6 +2428,10 @@ class CoreWorker:
                 state.resolving = True
             else:
                 need_resolve = False
+        # register the caller's refs BEFORE the push: a fast completion
+        # must never observe local_refs == 0 and free a live result
+        # (see submit_task)
+        refs_out = [ObjectRef(oid, self.address) for oid in return_ids]
         self.task_events.record(
             spec.task_id.hex(), state="SUBMITTED", ts_submitted=_ev_now(),
             name=f"{method_name} [actor {actor_id.hex()[:8]}]",
@@ -2195,7 +2443,7 @@ class CoreWorker:
         elif need_resolve:
             threading.Thread(target=self._resolve_actor,
                              args=(actor_id,), daemon=True).start()
-        return [ObjectRef(oid, self.address) for oid in return_ids]
+        return refs_out
 
     def _push_actor_task(self, addr: Optional[Tuple[str, int]],
                          spec: TaskSpec) -> None:
@@ -2348,61 +2596,83 @@ class CoreWorker:
     def _on_add_ref(self, oid_hex: str,
                     borrower: Optional[Tuple[str, int]] = None) -> None:
         with self._lock:
-            self.arg_pins[oid_hex] = self.arg_pins.get(oid_hex, 0) + 1
             if borrower is not None:
-                by = self.borrower_pins.setdefault(oid_hex, {})
-                addr = tuple(borrower)
-                by[addr] = by.get(addr, 0) + 1
+                # borrower registration and its backing arg pin move
+                # together inside the table (borrower_pins <= arg_pins
+                # holds by construction)
+                self._own.add_borrower(oid_hex, tuple(borrower))
+            else:
+                self._own.pin_arg(oid_hex, event="pin_arg")
 
     def _on_remove_ref(self, oid_hex: str,
                        borrower: Optional[Tuple[str, int]] = None) -> None:
         with self._lock:
             if borrower is not None:
-                by = self.borrower_pins.get(oid_hex)
-                if by is not None:
-                    addr = tuple(borrower)
-                    left = by.get(addr, 0) - 1
-                    if left <= 0:
-                        by.pop(addr, None)
-                        if not by:
-                            self.borrower_pins.pop(oid_hex, None)
-                    else:
-                        by[addr] = left
-            n = self.arg_pins.get(oid_hex, 0) - 1
-            if n <= 0:
-                self.arg_pins.pop(oid_hex, None)
-                if self.local_refs.get(oid_hex, 0) == 0:
-                    self._maybe_free_locked(oid_hex)
+                n = self._own.release_borrower(oid_hex, tuple(borrower))
+                if n is None:
+                    # unmatched (this borrower holds no pin here — e.g.
+                    # the dead-borrower sweep already released it, or a
+                    # duplicate release): decrementing arg_pins anyway
+                    # would free a pin some OTHER claimant holds. The
+                    # table recorded the anomaly; drop the release.
+                    return
             else:
-                self.arg_pins[oid_hex] = n
+                n = self._own.unpin_arg(oid_hex, strict=False,
+                                        event="unpin_arg")
+            if n <= 0 and self.local_refs.get(oid_hex, 0) == 0:
+                self._maybe_free_locked(oid_hex)
+
+    def _on_claims(self, oid_hexes: List[str]) -> Dict[str, bool]:
+        with self._lock:
+            return self._own.claims(list(oid_hexes))
 
     def _sweep_dead_borrowers(self) -> None:
-        """Drop pins held by borrowers that died without releasing."""
+        """Reconcile borrower pins against reality: pins of DEAD
+        borrowers are dropped outright; LIVE borrowers are asked which
+        pinned objects they still claim (cw_claims) and disclaimed pins
+        are released — the safety net for a release whose sends were
+        all lost (without it a transient outage leaks the pin at a live
+        owner forever). Safe against in-flight releases: a late
+        cw_remove_ref for a reconciled pin is dropped as unmatched."""
         with self._lock:
-            addrs = {a for by in self.borrower_pins.values() for a in by}
-        dead = []
-        for addr in addrs:
+            by_addr: Dict[Tuple[str, int], List[str]] = {}
+            for h, by in self.borrower_pins.items():
+                for a in by:
+                    by_addr.setdefault(a, []).append(h)
+        for addr, oids in by_addr.items():
+            claims: Optional[Dict[str, bool]] = None
+            dead = False
             try:
-                self._pool.get(addr).call("cw_ping")
+                claims = self._pool.get(addr).call("cw_claims",
+                                                   oid_hexes=oids)
             except Exception:  # noqa: BLE001
                 self._pool.invalidate(addr)
-                dead.append(addr)
-        for addr in dead:
-            logger.info("borrower %s died; releasing its pins", addr)
-            with self._lock:
-                for oid_hex, by in list(self.borrower_pins.items()):
-                    count = by.pop(addr, 0)
-                    if not by:
-                        self.borrower_pins.pop(oid_hex, None)
-                    if count <= 0:
-                        continue
-                    n = self.arg_pins.get(oid_hex, 0) - count
-                    if n <= 0:
-                        self.arg_pins.pop(oid_hex, None)
-                        if self.local_refs.get(oid_hex, 0) == 0:
+                try:
+                    self._pool.get(addr).call("cw_ping")
+                except Exception:  # noqa: BLE001
+                    dead = True
+            if dead:
+                logger.info("borrower %s died; releasing its pins", addr)
+                with self._lock:
+                    for oid_hex, n in self._own.sweep_borrower(addr):
+                        if n <= 0 and \
+                                self.local_refs.get(oid_hex, 0) == 0:
                             self._maybe_free_locked(oid_hex)
-                    else:
-                        self.arg_pins[oid_hex] = n
+                continue
+            if not isinstance(claims, dict):
+                continue  # borrower alive but claims unavailable
+            disclaimed = [h for h in oids if claims.get(h) is False]
+            if not disclaimed:
+                continue
+            logger.info("borrower %s disclaims %d pinned object(s); "
+                        "reconciling lost release(s)", addr,
+                        len(disclaimed))
+            with self._lock:
+                for oid_hex, n in self._own.sweep_borrower(
+                        addr, only=disclaimed,
+                        event="borrower_disclaimed"):
+                    if n <= 0 and self.local_refs.get(oid_hex, 0) == 0:
+                        self._maybe_free_locked(oid_hex)
 
     def _on_node_event(self, message: Any) -> None:
         """GCS "node" channel: fail (and retry) in-flight normal tasks
@@ -2431,14 +2701,13 @@ class CoreWorker:
             # (over-counting self-heals — surplus grants with an empty
             # queue hand their lease straight back).
             for e in lost:
-                ks = self._sched_keys.get(e.sched_key)
+                ks = self._ltab.get(e.sched_key)
                 if ks is not None and e.lease_node == dead_nm:
-                    ks.requests_in_flight = 0
+                    self._ltab.reset_slots(ks, event="node_death_reset")
                     # surgical: only the dead NM's parked entry dies —
                     # counts parked at live NMs (and their pending
                     # grants) keep balancing each other
-                    ks.parked_at.pop(
-                        tuple(dead_nm) if dead_nm else None, None)
+                    self._ltab.drop_parked(ks, dead_nm)
                     if ks.queue:
                         kick_keys.add(e.sched_key)
             # Sweep EVERY key's parked_at for the dead NM, not only the
@@ -2454,10 +2723,10 @@ class CoreWorker:
             # with the NM.
             if dead_nm is not None:
                 for key, ks in self._sched_keys.items():
-                    n = ks.parked_at.pop(dead_nm, 0)
+                    n = self._ltab.drop_parked(ks, dead_nm)
                     if n > 0:
-                        ks.requests_in_flight = max(
-                            0, ks.requests_in_flight - n)
+                        self._ltab.release_slots(
+                            ks, n, event="dead_nm_slot_release")
                         if ks.queue:
                             kick_keys.add(key)
         for e in lost:
@@ -2551,11 +2820,11 @@ class CoreWorker:
             if item is None or len(item) == 1:
                 continue
             try:
-                if len(item) == 3 and item[0] == "store_delete":
+                if item[0] == "store_delete":
                     self._pool.get(item[1]).send_oneway(
                         "store_delete", object_ids=[item[2]])
                 else:
-                    owner_addr, oid_hex = item
+                    owner_addr, oid_hex = item[:2]
                     self._pool.get(owner_addr).call(
                         "cw_remove_ref", oid_hex=oid_hex,
                         borrower=self.address)
@@ -2568,8 +2837,7 @@ class CoreWorker:
         # evict them (a SIGKILLed process leaks its leases until the
         # store itself is torn down — graceful exits should not)
         with self._lock:
-            leases = dict(self._replica_leases)
-            self._replica_leases.clear()
+            leases = self._own.drain_replica_leases()
         for h, n in leases.items():
             try:
                 self.store.unpin(h, count=n)
@@ -3001,17 +3269,20 @@ class _Executor:
         transit pins immediately instead of waiting out a TTL."""
         lease_id = getattr(spec, "_lease_id", None)
         try:
-            if worker_exiting or nested_refs:
-                # BLOCKING when this process is about to exit (max_calls
-                # recycling: the owner must record the result before the
-                # NM's worker-death report can race in, else a task that
-                # succeeded gets retried — side effects twice) AND when
-                # ObjectRefs ride the result: the owner registers its
-                # eager nested borrows inside this call, so on return
-                # the transit pins may drop — a one-way report delayed
-                # in flight (chaos `delay` on this path) could otherwise
-                # arrive after the pins' TTL and find the nested objects
-                # freed (ADVICE r5).
+            return self._report_done_once(spec, results, lease_id,
+                                          dynamic_children,
+                                          worker_exiting, nested_refs)
+        except Exception:  # noqa: BLE001 - transient send failure
+            pass
+        # A LOST completion report strands the task at its owner forever
+        # (the owner keeps waiting, its arg pins never release — the
+        # permanent-leak class the ownership fuzzer's drop schedules
+        # exercise). cw_task_done is duplicate-safe, so retry the report
+        # BLOCKING with backoff; only an owner that stays unreachable
+        # loses its results (and they are moot with it).
+        for delay_s in (0.1, 0.4, 1.0):
+            time.sleep(delay_s)
+            try:
                 self.cw._pool.get(spec.owner_address).call(
                     "cw_task_done", task_id=spec.task_id,
                     results=results, lease_id=lease_id,
@@ -3019,19 +3290,42 @@ class _Executor:
                     worker_exiting=worker_exiting,
                     nested_refs=nested_refs)
                 return True
-            # one-way: the worker moves on to its next task without
-            # waiting out the owner's bookkeeping round trip (send
-            # failures still raise; a dead owner is the only loss case
-            # and its results are moot)
-            self.cw._pool.get(spec.owner_address).send_oneway(
-                "cw_task_done", task_id=spec.task_id, results=results,
-                lease_id=lease_id, dynamic_children=dynamic_children,
-                worker_exiting=worker_exiting, nested_refs=nested_refs)
-            return False
-        except Exception:  # noqa: BLE001
-            logger.warning("owner %s unreachable for task result",
-                           spec.owner_address)
-            return False
+            except Exception:  # noqa: BLE001 - retried below
+                continue
+        logger.warning("owner %s unreachable for task result",
+                       spec.owner_address)
+        return False
+
+    def _report_done_once(self, spec: TaskSpec, results: List[Tuple],
+                          lease_id, dynamic_children,
+                          worker_exiting: bool, nested_refs) -> bool:
+        if worker_exiting or nested_refs:
+            # BLOCKING when this process is about to exit (max_calls
+            # recycling: the owner must record the result before the
+            # NM's worker-death report can race in, else a task that
+            # succeeded gets retried — side effects twice) AND when
+            # ObjectRefs ride the result: the owner registers its
+            # eager nested borrows inside this call, so on return
+            # the transit pins may drop — a one-way report delayed
+            # in flight (chaos `delay` on this path) could otherwise
+            # arrive after the pins' TTL and find the nested objects
+            # freed (ADVICE r5).
+            self.cw._pool.get(spec.owner_address).call(
+                "cw_task_done", task_id=spec.task_id,
+                results=results, lease_id=lease_id,
+                dynamic_children=dynamic_children,
+                worker_exiting=worker_exiting,
+                nested_refs=nested_refs)
+            return True
+        # one-way: the worker moves on to its next task without
+        # waiting out the owner's bookkeeping round trip (send
+        # failures still raise; a dead owner is the only loss case
+        # and its results are moot)
+        self.cw._pool.get(spec.owner_address).send_oneway(
+            "cw_task_done", task_id=spec.task_id, results=results,
+            lease_id=lease_id, dynamic_children=dynamic_children,
+            worker_exiting=worker_exiting, nested_refs=nested_refs)
+        return False
 
     def _report_error(self, spec: TaskSpec, err: Exception,
                       worker_exiting: bool = False) -> None:

@@ -265,6 +265,10 @@ class GcsServer:
             # lockdep plane: traced-lock snapshots + order graphs
             # (`ray_tpu locks`, dashboard /api/locks; util/locks.py)
             "locks_collect": self.locks_collect,
+            # ownership protocol plane: RefState/LeaseState + transition
+            # rings (`ray_tpu ownership`, dashboard /api/ownership;
+            # _private/ownership.py)
+            "ownership_collect": self.ownership_collect,
             # debug plane: attributed-log fan-out + crash postmortems
             # (`ray_tpu logs`, dashboard /api/logs + /api/postmortems)
             "logs_query": self.logs_query,
@@ -809,6 +813,56 @@ class GcsServer:
                 "objects_dropped": sum(
                     int(s.get("objects_dropped") or 0)
                     for s in proc_snaps),
+                "unreachable": unreachable}
+
+    # ---- ownership protocol plane (see _private/ownership.py) -----------
+
+    OWNERSHIP_COLLECT_TIMEOUT_S = 5.0
+
+    def ownership_collect(self, object_id: Optional[str] = None,
+                          limit: int = 200,
+                          timeout: Optional[float] = None
+                          ) -> Dict[str, Any]:
+        """Cluster ownership gather: every process's RefState/LeaseState
+        view + transition-ring tail (node managers bundle their store's
+        leased/pinned entries and held NM leases; workers and drivers
+        answer directly) under one overall deadline. Reply names the
+        nodes that did not answer — a missing claimant is only
+        meaningful when coverage was complete."""
+        from ray_tpu._private import spans as spans_lib
+        t = float(timeout) if timeout else self.OWNERSHIP_COLLECT_TIMEOUT_S
+        kwargs: Dict[str, Any] = {"limit": limit}
+        if object_id is not None:
+            kwargs["object_id"] = object_id
+        nm_replies, cw_replies, unreachable = \
+            spans_lib.gather_cluster_snapshots(
+                self, "nm_ownership_snapshot", "cw_ownership_snapshot",
+                timeout=t, grace_s=1.0, call_kwargs=kwargs)
+        proc_snaps: List[Dict[str, Any]] = []
+        node_snaps: List[Dict[str, Any]] = []
+        for _addr, reply, _t0, _t1 in nm_replies:
+            node_snaps.append({k: v for k, v in reply.items()
+                               if k != "worker_snaps"})
+            proc_snaps.extend(reply.get("worker_snaps", ()))
+        proc_snaps.extend(snap for _a, snap, _t0, _t1 in cw_replies)
+        proc_snaps = spans_lib.dedupe_by_uid(proc_snaps)
+        # anomaly totals dedupe by PROCESS, not snapshot: with an
+        # in-process head node the NM and the driver share one
+        # transition ring, and summing both snapshots would double-count
+        # every event (per-uid max: the two reads race the same
+        # monotonically-growing counters)
+        per_uid: Dict[Any, Dict[str, int]] = {}
+        for snap in proc_snaps + node_snaps:
+            uid = snap.get("proc_uid")
+            tgt = per_uid.setdefault(uid, {})
+            for ev, n in (snap.get("anomalies") or {}).items():
+                tgt[ev] = max(tgt.get(ev, 0), int(n))
+        anomalies: Dict[str, int] = {}
+        for counts in per_uid.values():
+            for ev, n in counts.items():
+                anomalies[ev] = anomalies.get(ev, 0) + n
+        return {"ts": time.time(), "procs": proc_snaps,
+                "nodes": node_snaps, "anomalies": anomalies,
                 "unreachable": unreachable}
 
     # ---- lockdep plane (see ray_tpu/util/locks.py) ----------------------

@@ -22,6 +22,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import ownership as _ownership
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, WorkerID
@@ -85,6 +86,10 @@ class _PendingLease:
     reply_to: Tuple[str, int]    # requesting core worker's RPC address
     acquired: Optional[ResourceSet] = None
     submitted_at: float = field(default_factory=time.monotonic)
+    # grant replies that failed transiently; bounded re-grants keep a
+    # momentary connection blip from stranding the owner's parked
+    # request forever (an owner that stays unreachable is dropped)
+    grant_failures: int = 0
 
 
 class NodeManager:
@@ -150,7 +155,10 @@ class NodeManager:
         self._prekill_dumps: Dict[str, Dict[str, Any]] = {}
         self.idle: Dict[str, List[str]] = {}            # runtime env key -> ids
         self.pending: List[_PendingLease] = []
-        self.leases: Dict[str, str] = {}                # lease id -> worker id hex
+        # lease id -> worker id hex; grant/release funnel through the
+        # ownership protocol module so every NM-side lease transition
+        # lands in the ring (`ray_tpu ownership`)
+        self.leases = _ownership.NMLeases()
         self._starting = 0
         self._starting_by_key: Dict[str, int] = {}
         self.num_args_prefetched = 0
@@ -182,6 +190,7 @@ class NodeManager:
             "nm_profile_workers": self.profile_workers,
             "nm_profile_collect": self.profile_collect,
             "nm_memory_snapshot": self.memory_snapshot,
+            "nm_ownership_snapshot": self.ownership_snapshot,
             "nm_locks_snapshot": self.locks_snapshot,
             "nm_drain": self.drain,
         }, host=host)
@@ -597,8 +606,8 @@ class NodeManager:
                     ids.remove(wid)
             running = handle.current_task
             lease_id = handle.lease_id
-            if lease_id is not None and lease_id in self.leases:
-                del self.leases[lease_id]
+            if lease_id is not None:
+                self.leases.release(lease_id, event="worker_died")
             if running is not None and not handle.blocked:
                 # blocked workers already released their resources
                 self.available.add(self._effective_resources(running))
@@ -807,7 +816,7 @@ class NodeManager:
                 handle.lease_id = pl.lease_id
                 handle.current_task = pl.spec
                 handle.task_started_at = time.time()
-                self.leases[pl.lease_id] = handle.worker_id.hex()
+                self.leases.grant(pl.lease_id, handle.worker_id.hex())
                 granted.append((pl, handle))
             self.pending = remaining
         for key, renv in spawns:
@@ -824,9 +833,24 @@ class NodeManager:
                     node_id=self.node_id.hex(),
                     nm_address=self.address)
             except Exception:  # noqa: BLE001
-                logger.warning("lease reply to %s failed; reclaiming",
-                               pl.reply_to)
-                self.return_worker(pl.lease_id)
+                pl.grant_failures += 1
+                if pl.grant_failures <= 2:
+                    # transient reply loss: the owner still holds a
+                    # request slot parked here and would stall forever
+                    # if we silently dropped the lease — reclaim the
+                    # worker and re-queue the lease for a fresh grant
+                    logger.warning(
+                        "lease reply to %s failed (attempt %d); "
+                        "re-queueing", pl.reply_to, pl.grant_failures)
+                    self.return_worker(pl.lease_id)
+                    with self._lock:
+                        pl.acquired = None
+                        self.pending.append(pl)
+                    self._dispatch()
+                else:
+                    logger.warning("lease reply to %s failed; reclaiming",
+                                   pl.reply_to)
+                    self.return_worker(pl.lease_id)
 
     def _prefetch_args(self, specs: List[TaskSpec]) -> None:
         """Pull the batch's remote args into the local store while the
@@ -867,7 +891,7 @@ class NodeManager:
 
     def return_worker(self, lease_id: str, reuse: bool = True) -> None:
         with self._lock:
-            wid = self.leases.pop(lease_id, None)
+            wid = self.leases.release(lease_id)
             if wid is None:
                 return
             handle = self.workers.get(wid)
@@ -1227,6 +1251,45 @@ class NodeManager:
         return {"node_id": self.node_id.hex(),
                 "store_addr": list(self.store.address),
                 "store": self.store.list_objects(),
+                "worker_snaps": [snap for _a, snap, _t0, _t1 in pulled],
+                "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
+
+    OWNERSHIP_WORKER_TIMEOUT_S = 3.0
+
+    def ownership_snapshot(self, object_id: Optional[str] = None,
+                           limit: int = 200) -> Dict[str, Any]:
+        """Ownership-protocol gather for this node: the daemon's own
+        transition ring (NM lease grants + store reader leases live in
+        this process), the NM's held leases, the store's leased/pinned
+        entries, plus every registered worker's cw_ownership_snapshot —
+        one RPC hop below the GCS `ownership_collect` fan-out."""
+        from ray_tpu._private import spans as spans_lib
+        ring_snap = _ownership.ring().snapshot(
+            key_prefix=object_id or None, limit=limit)
+        with self._lock:
+            worker_addrs = [h.address for h in self.workers.values()
+                            if h.registered and h.address is not None]
+            nm_leases = {lid: wid[:12] for lid, wid in
+                         self.leases.items()}
+        store_held = [e for e in self.store.list_objects()
+                      if (e.get("pinned") or 0) > 0
+                      or (e.get("leases") or 0) > 0]
+        if object_id:
+            store_held = [e for e in store_held
+                          if e["object_id"].startswith(object_id)]
+        kwargs = {"limit": limit}
+        if object_id is not None:
+            kwargs["object_id"] = object_id
+        pulled = spans_lib.pull_snapshots(
+            worker_addrs, "cw_ownership_snapshot",
+            timeout=self.OWNERSHIP_WORKER_TIMEOUT_S, call_kwargs=kwargs)
+        return {"proc_uid": spans_lib.PROC_UID,
+                "node_id": self.node_id.hex(),
+                "store_addr": list(self.store.address),
+                "nm_leases": nm_leases,
+                "store_held": store_held,
+                "transitions": ring_snap["transitions"],
+                "anomalies": ring_snap["anomalies"],
                 "worker_snaps": [snap for _a, snap, _t0, _t1 in pulled],
                 "worker_addrs": [list(a) for a, _r, _t0, _t1 in pulled]}
 

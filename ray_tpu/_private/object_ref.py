@@ -31,7 +31,8 @@ def collect_serialized_refs(out: list):
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_address", "_registered", "__weakref__")
+    __slots__ = ("_id", "_owner_address", "_registered", "_cw_epoch",
+                 "__weakref__")
 
     def __init__(self, object_id: ObjectID,
                  owner_address: Optional[Tuple[str, int]] = None,
@@ -39,12 +40,22 @@ class ObjectRef:
         self._id = object_id
         self._owner_address = tuple(owner_address) if owner_address else None
         self._registered = False
+        self._cw_epoch = None
         if _register:
             from ray_tpu._private import worker as worker_mod
             w = worker_mod.global_worker_or_none()
             if w is not None:
                 w.core_worker.add_local_ref(self)
                 self._registered = True
+                # the release must reach the CoreWorker INSTANCE that
+                # counted the add: after a shutdown+reinit, a stale ref
+                # GC'd late would otherwise double-release against the
+                # NEW worker's reference table (the ownership state
+                # machine rejects that as an illegal transition).
+                # Compared by EPOCH, not a weakref: a ref dying inside
+                # a garbage cycle has its weakrefs cleared before
+                # __del__ runs, which silently skipped the release.
+                self._cw_epoch = w.core_worker.epoch
 
     @property
     def id(self) -> ObjectID:
@@ -77,7 +88,8 @@ class ObjectRef:
             try:
                 from ray_tpu._private import worker as worker_mod
                 w = worker_mod.global_worker_or_none()
-                if w is not None:
+                if w is not None and \
+                        w.core_worker.epoch == self._cw_epoch:
                     w.core_worker.remove_local_ref(self)
             except Exception:  # noqa: BLE001 - interpreter shutdown
                 pass

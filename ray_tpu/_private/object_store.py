@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import chaos as chaos_lib
+from ray_tpu._private import ownership as _ownership
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import spans as _spans
 from ray_tpu.util.locks import TracedLock
@@ -461,14 +462,16 @@ class StoreServer:
                 if len(ready) >= num_required:
                     if pin:
                         for oid in ready:
-                            self._objects[oid].leases += 1
+                            _ownership.store_lease(self._objects[oid],
+                                                   oid)
                     return ready
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     if pin:
                         for oid in ready:
-                            self._objects[oid].leases += 1
+                            _ownership.store_lease(self._objects[oid],
+                                                   oid)
                     return ready
                 blocked[0] = True
                 self._sealed_cv.wait(timeout=min(remaining or 1.0, 1.0))
@@ -521,7 +524,7 @@ class StoreServer:
         with self._lock:
             e = self._objects.get(object_id)
             if e is not None:
-                e.leases += 1
+                _ownership.store_lease(e, object_id)
 
     def unpin(self, object_id: str, count: int = 1) -> None:
         """Release reader lease(s); fires any chaos eviction deferred
@@ -546,7 +549,7 @@ class StoreServer:
             if e is None:
                 self._deferred_evict.discard(object_id)
                 return
-            e.leases = max(0, e.leases - count)
+            _ownership.store_unlease(e, object_id, count)
             if e.leases == 0 and object_id in self._deferred_evict:
                 self._deferred_evict.discard(object_id)
                 self._delete_locked(object_id)
@@ -592,7 +595,7 @@ class StoreServer:
                         self._restore_locked(object_id)
                         e = self._objects[object_id]
                     if lease:
-                        e.leases += 1
+                        _ownership.store_lease(e, object_id)
                     return self._descriptor(e)
                 in_flight = self._pulls_in_flight.get(object_id)
                 if in_flight is None:
@@ -637,7 +640,7 @@ class StoreServer:
         with self._lock:
             e = self._objects[object_id]
             if lease:
-                e.leases += 1
+                _ownership.store_lease(e, object_id)
             return self._descriptor(e)
 
     def list_objects(self) -> List[Dict[str, Any]]:

@@ -238,6 +238,20 @@ _IDEMPOTENT_METHODS = frozenset({
     # silently return an empty profile.
     "memory_collect", "nm_memory_snapshot", "cw_memory_snapshot",
     "nm_profile_workers",
+    # ownership-plane reads (RefState/LeaseState + transition-ring
+    # snapshots)
+    "ownership_collect", "nm_ownership_snapshot",
+    "cw_ownership_snapshot",
+    # ownership-protocol writes that are duplicate-safe BY DESIGN, so a
+    # retry after a sent-but-reply-lost attempt cannot corrupt state:
+    # cw_task_done/cw_task_failed dedup on the owner's entry.done (a
+    # duplicate settle is a recorded no-op in the lease machine),
+    # nm_return_worker releases a lease id at most once. A lost
+    # completion report used to strand the task (and its arg pins)
+    # forever — the ownership fuzzer's drop schedules hit exactly this.
+    "cw_task_done", "cw_task_failed", "nm_return_worker",
+    # pure read: the borrower's current claim set (anti-entropy sweep)
+    "cw_claims",
 })
 
 

@@ -14,6 +14,9 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
     GET /api/profile  — task-attributed cluster flamegraph (sampling
                         profiler fan-out; ?duration=&hz=&format=
                         speedscope|folded|raw&device=1 + id filters)
+    GET /api/ownership — ownership protocol: RefState/LeaseState rows,
+                        held leases, transition-ring tails
+                        (?object=<hex prefix>&limit=N)
     GET /api/memory   — owner-attributed cluster object table
                         (?group_by=callsite|actor|node|owner&top=N)
     GET /api/locks    — runtime lockdep: per-process traced-lock stats
@@ -295,6 +298,15 @@ class DashboardHead:
             # traced-lock stats + acquisition-order graphs
             return s.locks(timeout=(float(params["timeout"])
                                     if "timeout" in params else None))
+        if route == "/api/ownership":
+            # ownership protocol plane (_private/ownership.py):
+            # ?object=<hex prefix> explains one object's state +
+            # transitions; &limit=N caps per-process rows
+            return s.ownership(
+                object_id=params.get("object"),
+                limit=int(params["limit"]) if "limit" in params else 200,
+                timeout=(float(params["timeout"])
+                         if "timeout" in params else None))
         if route == "/api/memory":
             # cluster object table (_private/memory_plane.py):
             # ?group_by=callsite|actor|node|owner&top=N

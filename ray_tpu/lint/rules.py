@@ -1,6 +1,6 @@
-"""graftlint rule set: 17 framework-aware checks.
+"""graftlint rule set: 18 framework-aware checks.
 
-Each rule has a stable id (RT001..RT017), a one-line rationale, and a
+Each rule has a stable id (RT001..RT018), a one-line rationale, and a
 `check(ctx)` generator yielding Findings. Rules are deliberately
 conservative: a finding should be actionable, and intentional
 exceptions are silenced in-place with `# graftlint: disable=RTxxx`
@@ -845,6 +845,98 @@ class UnboundedWaitInServingPath(Rule):
                     f"sheds instead of hanging")
 
 
+class OwnershipBookkeepingDiscipline(Rule):
+    id = "RT018"
+    name = "ownership-bookkeeping-discipline"
+    rationale = ("the ownership protocol's count dicts (refcounts, pins, "
+                 "borrower registrations, reader leases, lease "
+                 "slots/parked/pipeline accounting) are state machines "
+                 "whose invariants live in _private/ownership.py — a "
+                 "direct mutation elsewhere bypasses the transition() "
+                 "choke point, so double-releases and negative counts "
+                 "corrupt silently instead of raising, and the "
+                 "transition ring no longer explains the object")
+
+    # Attribute names that ARE ownership-protocol state wherever they
+    # appear in the framework (chosen to be distinctive; `leases` and
+    # `pinned` exist only on protocol objects here).
+    PROTECTED = frozenset({
+        "local_refs", "arg_pins", "borrower_pins", "borrowed",
+        "replica_leases", "_replica_leases", "nested_borrows",
+        "_nested_borrows", "ttl_pins", "_ttl_pins", "_lease_running",
+        "lease_inflight", "requests_in_flight", "parked_at", "leases",
+        "pinned",
+    })
+
+    _MUTATORS = frozenset({
+        "pop", "popitem", "setdefault", "clear", "update", "append",
+        "appendleft", "extend", "remove", "discard", "add", "insert",
+        "popleft",
+    })
+
+    _EXEMPT_SUFFIX = ("_private/ownership.py", "_private\\ownership.py")
+
+    def _protected_attr(self, node: ast.AST) -> Optional[str]:
+        """The protected attribute a mutation target reaches, if any:
+        `x.arg_pins` itself or `x.arg_pins[...]`."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) and node.attr in self.PROTECTED:
+            return node.attr
+        return None
+
+    def _msg(self, attr: str, how: str) -> str:
+        return (f"direct {how} of ownership-protocol state `{attr}` "
+                f"outside _private/ownership.py bypasses the "
+                f"transition() choke point — route it through the "
+                f"RefTable/LeaseTable/store-ledger methods (or suppress "
+                f"with `# graftlint: disable=RT018` if this attribute "
+                f"is not protocol state)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.path.replace("\\", "/").endswith(
+                self._EXEMPT_SUFFIX[0]):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    attr = self._protected_attr(tgt)
+                    if attr is None:
+                        continue
+                    if isinstance(tgt, ast.Attribute):
+                        # plain rebinding: aliasing another component's
+                        # table (`self.arg_pins = self._own.arg_pins`)
+                        # and constructing a ledger from the ownership
+                        # module are the two legitimate forms
+                        if isinstance(node.value, ast.Attribute):
+                            continue
+                        if isinstance(node.value, ast.Call):
+                            fname = ctx.call_name(node.value) or ""
+                            if "ownership" in fname:
+                                continue
+                    yield self.finding(ctx, node,
+                                       self._msg(attr, "assignment"))
+            elif isinstance(node, ast.AugAssign):
+                attr = self._protected_attr(node.target)
+                if attr is not None:
+                    yield self.finding(
+                        ctx, node, self._msg(attr, "augmented assignment"))
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    attr = self._protected_attr(tgt)
+                    if attr is not None:
+                        yield self.finding(ctx, node,
+                                           self._msg(attr, "delete"))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in self._MUTATORS:
+                attr = self._protected_attr(node.func.value)
+                if attr is not None:
+                    yield self.finding(
+                        ctx, node,
+                        self._msg(attr, f"`.{node.func.attr}()` call"))
+
+
 # Concurrency layer (class-level guard maps + lock-order graph) lives
 # in its own module; the rules plug into the same catalogue.
 from ray_tpu.lint.concurrency import (BlockingUnderLock,  # noqa: E402
@@ -857,6 +949,7 @@ ALL_RULES: List[Rule] = [
     WallClockDuration(), MetricNameConvention(), BarePrintInFramework(),
     SilentExceptionSwallow(), MixedGuardAccess(), BlockingUnderLock(),
     LockOrderCycle(), UnboundedWaitInServingPath(),
+    OwnershipBookkeepingDiscipline(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

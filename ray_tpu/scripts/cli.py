@@ -315,6 +315,75 @@ def cmd_locks(args) -> int:
     return 0
 
 
+def cmd_ownership(args) -> int:
+    """Ownership protocol plane (see README "Ownership protocol"):
+    per-process RefState rows (what holds each object alive), lease
+    slot/parked/pipeline accounting per scheduling key, node managers'
+    held leases + store reader leases, and the transition-ring tail —
+    `--object <hex prefix>` makes one stuck object explain itself."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    out = s.ownership(object_id=args.object, limit=args.limit,
+                      timeout=args.timeout)
+    if args.format == "json":
+        print(json.dumps(out, default=str))
+        return 0
+    if out.get("anomalies"):
+        print("!! protocol anomalies (unmatched/illegal transitions):")
+        for ev, n in sorted(out["anomalies"].items()):
+            print(f"   {ev}: {n}")
+    for node in out.get("nodes", ()):
+        held = node.get("store_held") or []
+        leases = node.get("nm_leases") or {}
+        print(f"\n== node {str(node.get('node_id'))[:12]}: "
+              f"{len(leases)} held lease(s), "
+              f"{len(held)} leased/pinned store object(s)")
+        if held:
+            _print_table(
+                [{"object_id": e["object_id"][:20], "size": e.get("size"),
+                  "pinned": e.get("pinned"), "leases": e.get("leases"),
+                  "spilled": e.get("spilled")} for e in held[:20]],
+                ["object_id", "size", "pinned", "leases", "spilled"])
+    for snap in out.get("procs", ()):
+        objs = snap.get("objects") or []
+        keys = [k for k in (snap.get("lease_keys") or ())
+                if k["queued"] or k["requests_in_flight"] or k["leases"]
+                or k["inflight"]]
+        if not objs and not keys and not args.verbose:
+            continue
+        print(f"\n== {snap.get('label')} (pid {snap.get('pid')}, "
+              f"{snap.get('mode')})")
+        if objs:
+            _print_table(
+                [{"object_id": r["object_id"][:20], "loc": r["loc"],
+                  "refs": r["local_refs"], "pins": r["arg_pins"],
+                  "borrowers": len(r["borrower_pins"]),
+                  "leases": r["replica_leases"],
+                  "borrowed_from": (":".join(map(str, r["borrowed_from"]))
+                                    if r["borrowed_from"] else "-")}
+                 for r in objs[:args.limit]],
+                ["object_id", "loc", "refs", "pins", "borrowers",
+                 "leases", "borrowed_from"])
+        if keys:
+            _print_table(
+                [{"key": k["key"], "queued": k["queued"],
+                  "slots": k["requests_in_flight"],
+                  "parked": k["parked"], "leases": k["leases"],
+                  "inflight": sum(k["inflight"].values())}
+                 for k in keys],
+                ["key", "queued", "slots", "parked", "leases",
+                 "inflight"])
+        if args.object or args.verbose:
+            for t in (snap.get("transitions") or ())[-args.limit:]:
+                print(f"  {t['seq']:>6} {t['kind']:<13} "
+                      f"{str(t['key'])[:16]:<16} {t['event']:<22} "
+                      f"{t['old']} -> {t['new']}"
+                      + (f"  [{t['detail']}]" if t.get("detail")
+                         else ""))
+    _warn_unreachable(list(out.get("unreachable") or []))
+    return 0
+
+
 def cmd_profile(args) -> int:
     """Cluster flamegraph (see README "Profiling & memory
     attribution"): sample every process for --duration seconds at
@@ -735,6 +804,23 @@ def main(argv=None) -> int:
                    help="jax profiler traces on device-hosting workers "
                         "(reports xplane dirs) instead of CPU sampling")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("ownership", help="ownership protocol: RefState/"
+                                         "LeaseState per process, held "
+                                         "leases + store reader leases, "
+                                         "transition ring tail")
+    p.add_argument("--address", default=None)
+    p.add_argument("--object", default=None,
+                   help="object id hex prefix: explain this object's "
+                        "state + last transitions")
+    p.add_argument("--limit", type=int, default=200,
+                   help="max transitions/rows per process")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="overall fan-out deadline (seconds)")
+    p.add_argument("--verbose", action="store_true",
+                   help="print every process + its transition tail")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.set_defaults(fn=cmd_ownership)
 
     p = sub.add_parser("locks", help="runtime lockdep: per-process "
                                      "traced-lock stats + acquisition-"

@@ -459,6 +459,23 @@ def profile(duration: float = 5.0, hz: Optional[float] = None,
     return out
 
 
+def ownership(object_id: Optional[str] = None, limit: int = 200,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Cluster ownership-protocol view (`ray_tpu ownership`, dashboard
+    /api/ownership; _private/ownership.py): every process's live
+    RefState rows (what holds each object alive — local refs, arg/
+    transit pins, borrower registrations, replica reader leases),
+    per-scheduling-key LeaseState summaries (request slots, parked
+    counts, held leases, pipeline depth), node managers' held leases +
+    store reader-lease/pin residency, and each process's bounded
+    transition-ring tail — so a stuck object explains itself.
+    `object_id` (hex prefix) restricts rows and transitions to one
+    object. Anomaly counts (`unmatched:*` / `illegal:*` transitions)
+    are aggregated cluster-wide; unreachable nodes are named."""
+    return _gcs().call("ownership_collect", object_id=object_id,
+                       limit=limit, timeout=timeout)
+
+
 def locks(timeout: Optional[float] = None) -> Dict[str, Any]:
     """Cluster lockdep snapshot (`ray_tpu locks`, dashboard
     /api/locks): every process's traced locks (hold counts/times,

@@ -49,3 +49,36 @@ def ray_start(ray_session):
     if not ray_session.is_initialized():
         ray_session.init(num_cpus=4, ignore_reinit_error=True)
     return ray_session
+
+
+def assert_ownership_drains(timeout_s: float = 15.0) -> None:
+    """Post-test leak canary (ownership protocol): with the test's work
+    done, the driver's lease request slots, pipeline depths and running
+    sets must drain to zero (_private/ownership.py — the ADVICE-r5
+    stall-leak class). Cheap (no cluster fan-out); used as a teardown
+    assertion by the fault-injection suites, where a leak would
+    otherwise hide until some later test stalls."""
+    import gc
+    import time
+
+    import ray_tpu
+    from ray_tpu._private import ownership
+    from ray_tpu._private import worker as worker_mod
+
+    if not ray_tpu.is_initialized():
+        return  # the test tore its cluster down; nothing to leak into
+    w = worker_mod.global_worker_or_none()
+    if w is None or w.core_worker is None:
+        return
+    cw = w.core_worker
+    deadline = time.monotonic() + timeout_s
+    leaks = []
+    while time.monotonic() < deadline:
+        gc.collect()
+        with cw._lock:
+            leaks = ownership.lease_drain_report(cw._ltab)
+        if not leaks:
+            return
+        time.sleep(0.25)
+    pytest.fail("ownership drains-to-zero canary failed: "
+                + "; ".join(leaks))

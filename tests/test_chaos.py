@@ -31,6 +31,10 @@ def chaos_session(ray_start):
         chaos.clear()
     except Exception:  # noqa: BLE001 - test tore its own cluster down
         pass
+    # post-quiesce leak canary: whatever faults this test injected, the
+    # driver's ownership/lease accounting must drain back to zero
+    from tests.conftest import assert_ownership_drains
+    assert_ownership_drains()
 
 
 def _fired(rule_id):

@@ -18,6 +18,16 @@ from ray_tpu.util import state as state_api
 from ray_tpu.util.actor_manager import FaultTolerantActorManager
 
 
+@pytest.fixture(autouse=True)
+def ownership_drain_canary():
+    """Every kill/restart test must leave the ownership protocol's
+    lease accounting drained — a leaked request slot or running-lease
+    entry here is the stall class ADVICE r5 found (see conftest)."""
+    yield
+    from tests.conftest import assert_ownership_drains
+    assert_ownership_drains()
+
+
 def _find_worker_pid(predicate, timeout=30):
     deadline = time.time() + timeout
     while time.time() < deadline:

@@ -946,7 +946,7 @@ def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == [f"RT00{i}" for i in range(1, 10)] + \
         ["RT010", "RT011", "RT012", "RT013", "RT014", "RT015", "RT016",
-         "RT017"]
+         "RT017", "RT018"]
     assert all(r.rationale for r in ALL_RULES)
 
 
@@ -1015,6 +1015,83 @@ def test_rt017_non_serving_paths_exempt():
     for path in ("ray_tpu/_private/core_worker.py",
                  "tools/bench_serve.py", "ray_tpu/data/dataset.py"):
         assert "RT017" not in _rt017_hits(RT017_POS, path), path
+
+
+# ---- RT018 ownership-bookkeeping discipline --------------------------------
+
+RT018_POS_SUBSCRIPT = """
+    class Worker:
+        def grab(self, h):
+            self.arg_pins[h] = self.arg_pins.get(h, 0) + 1
+"""
+
+RT018_POS_AUGASSIGN = """
+    def claim(ks):
+        ks.requests_in_flight += 1
+"""
+
+RT018_POS_MUTATOR_CALL = """
+    class Worker:
+        def drop(self, h):
+            self.local_refs.pop(h, None)
+"""
+
+RT018_POS_STORE_LEASE = """
+    def take(entry):
+        entry.leases += 1
+"""
+
+RT018_POS_PLAIN_ASSIGN = """
+    def reset(ks):
+        ks.requests_in_flight = 0
+"""
+
+RT018_POS_DEL = """
+    def forget(self, lease_id):
+        del self.leases[lease_id]
+"""
+
+RT018_SUPPRESSED = """
+    class Worker:
+        def drop(self, h):
+            # graftlint: disable=RT018 — test fake, not protocol state
+            self.local_refs.pop(h, None)
+"""
+
+
+@pytest.mark.parametrize("src", [
+    RT018_POS_SUBSCRIPT, RT018_POS_AUGASSIGN, RT018_POS_MUTATOR_CALL,
+    RT018_POS_STORE_LEASE, RT018_POS_PLAIN_ASSIGN, RT018_POS_DEL])
+def test_rt018_direct_mutation_flagged(src):
+    assert "RT018" in rules_hit(src)
+
+
+def test_rt018_suppressed():
+    assert "RT018" not in rules_hit(RT018_SUPPRESSED)
+
+
+def test_rt018_ownership_module_exempt():
+    hits = {f.rule_id for f in lint_source(
+        textwrap.dedent(RT018_POS_SUBSCRIPT),
+        "ray_tpu/_private/ownership.py")}
+    assert "RT018" not in hits
+
+
+def test_rt018_reads_and_aliases_fine():
+    src = """
+        from ray_tpu._private import ownership
+
+        class Worker:
+            def __init__(self):
+                self._own = ownership.RefTable()
+                # aliasing the table's dict preserves the read surface
+                self.arg_pins = self._own.arg_pins
+                self.leases = ownership.NMLeases()
+
+            def peek(self, h):
+                return self.arg_pins.get(h, 0), len(self.leases)
+    """
+    assert "RT018" not in rules_hit(src)
 
 
 # ---- RT014 mixed-guard attribute access -----------------------------------
