@@ -89,6 +89,31 @@ class Config:
     watchdog_serve_p99_s = _define("watchdog_serve_p99_s", 2.0, float)
     watchdog_serve_error_rate = _define(
         "watchdog_serve_error_rate", 0.1, float)
+    # Serve ingress fleet (serve/_private/proxy_fleet/): admission
+    # control + load shedding at the per-node asyncio proxies. A
+    # deployment admits up to replicas x max_concurrent_queries
+    # in-flight requests plus this many queued beyond capacity before
+    # shedding (503 + Retry-After / RESOURCE_EXHAUSTED); -1 on the
+    # deployment means "use this default". Rate limit is a per-proxy
+    # per-deployment token bucket in requests/s (0 = unlimited).
+    serve_max_queued_per_deployment = _define(
+        "serve_max_queued_per_deployment", 128, int)
+    serve_rate_limit_rps = _define("serve_rate_limit_rps", 0.0, float)
+    # Retry-After seconds advertised on shed responses.
+    serve_shed_retry_after_s = _define(
+        "serve_shed_retry_after_s", 1.0, float)
+    # Proxy drain: max wait for in-flight requests to finish before a
+    # draining proxy gives up and reports itself drained anyway.
+    serve_drain_timeout_s = _define("serve_drain_timeout_s", 30.0, float)
+    # Proxy-side request coalescing into @serve.batch deployments: max
+    # requests fused into one replica submit, and how long the first
+    # request in a forming batch waits for stragglers.
+    serve_coalesce_max_batch = _define("serve_coalesce_max_batch", 32, int)
+    serve_coalesce_wait_s = _define("serve_coalesce_wait_s", 0.002, float)
+    # SLO watchdog: shed fraction of a harvest window's admitted+shed
+    # request delta above this sustains a `serve_shed_burn` alert.
+    watchdog_serve_shed_rate = _define(
+        "watchdog_serve_shed_rate", 0.5, float)
     # Debug plane (_private/log_plane.py + log_monitor.py): per-worker
     # in-memory tail index depth, driver-stream flood control (per-source
     # token bucket), and crash-postmortem bundle sizes.

@@ -355,7 +355,8 @@ class Watchdog:
                  lock_hold_s: float = 5.0,
                  lock_waiters: int = 1,
                  serve_p99_s: float = 2.0,
-                 serve_error_rate: float = 0.1) -> None:
+                 serve_error_rate: float = 0.1,
+                 serve_shed_rate: float = 0.5) -> None:
         self._emit = emit
         self.cooldown_s = cooldown_s
         self.wait_edge_age_s = wait_edge_age_s
@@ -365,11 +366,15 @@ class Watchdog:
         self.lock_waiters = lock_waiters
         self.serve_p99_s = serve_p99_s
         self.serve_error_rate = serve_error_rate
+        self.serve_shed_rate = serve_shed_rate
         # serve SLO probes: last cumulative per-deployment request
-        # histogram / per-(deployment, code) request counts; the probe
-        # judges per-harvest DELTAS so an old breach can't alert forever
+        # histogram / per-(deployment, code) request counts (and shed
+        # counts, for the shed-burn probe); the probe judges
+        # per-harvest DELTAS so an old breach can't alert forever
         self._prev_serve_hist: Dict[str, Dict[str, Any]] = {}
         self._prev_serve_req: Dict[Tuple[str, str], float] = {}
+        self._prev_serve_shed: Dict[str, float] = {}
+        self._prev_serve_admitted: Dict[str, float] = {}
         self._last_alert: Dict[Tuple[str, str], float] = {}
         # lease probe: uid -> (leaked-slot count, monotonic ts it was
         # first seen stuck at that value)
@@ -809,6 +814,15 @@ class Watchdog:
                 ok = False  # counter churn: skip the whole round
                 break
             dep, code = key
+            # 503 = admission shed (Retry-After contract): an overload
+            # signal with its own probe (serve_shed_burn), not an error
+            # burning the availability budget — excluded from BOTH
+            # numerator and denominator (errors judged against
+            # ADMITTED traffic; a brownout must not dilute a real 5xx
+            # burn happening underneath it). The only 503 source in
+            # this stack is the ingress admission plane.
+            if code == "503":
+                continue
             rec = deltas.setdefault(dep, {"total": 0.0, "errors": 0.0})
             rec["total"] += d
             if code.startswith("5"):
@@ -829,6 +843,60 @@ class Watchdog:
                     f"(error-rate SLO {100 * self.serve_error_rate:.0f}"
                     f"%)", severity="ERROR", deployment=dep,
                     value=rate)
+
+    def _probe_serve_shed(self, snaps: List[Dict[str, Any]]) -> None:
+        """``serve_shed_burn``: sustained load shedding at the ingress
+        fleet. Judges per-harvest DELTAS of
+        ``ray_tpu_serve_shed_total`` against the same window's total
+        offered load (admitted ``requests_total`` + shed): a shed
+        fraction above `serve_shed_rate` means clients are being
+        browned out faster than the Retry-After contract can absorb —
+        scale the deployment (or raise its admission limits) before
+        goodput collapses. First-appearance keys baseline like the
+        other serve probes; windows under SERVE_MIN_REQUESTS offered
+        requests are noise and skipped."""
+        shed: Dict[str, float] = {}
+        admitted: Dict[str, float] = {}
+        for snap in snaps:
+            for m in snap.get("metrics", ()):
+                if m["name"] == "ray_tpu_serve_shed_total":
+                    for s in m["series"]:
+                        dep = s["tags"].get("deployment", "?")
+                        shed[dep] = shed.get(dep, 0.0) + s["value"]
+                elif m["name"] == "ray_tpu_serve_requests_total":
+                    for s in m["series"]:
+                        dep = s["tags"].get("deployment", "?")
+                        admitted[dep] = admitted.get(dep, 0.0) \
+                            + s["value"]
+        prev_shed, self._prev_serve_shed = self._prev_serve_shed, shed
+        prev_req = self._prev_serve_admitted
+        self._prev_serve_admitted = dict(admitted)
+        for dep, shed_now in shed.items():
+            shed_before = prev_shed.get(dep)
+            if shed_before is None:
+                continue  # baseline round for this deployment
+            d_shed = shed_now - shed_before
+            d_req = admitted.get(dep, 0.0) - prev_req.get(dep, 0.0)
+            if d_shed < 0 or d_req < 0:
+                continue  # proxy churn reset a counter: re-baseline
+            # requests_total ALREADY includes sheds (they respond 503
+            # at the proxy, where the counter lives) — offered load is
+            # d_req itself; the max() only guards a legacy proxy that
+            # sheds without counting
+            offered = max(d_req, d_shed)
+            if offered < self.SERVE_MIN_REQUESTS or d_shed <= 0:
+                continue
+            rate = d_shed / offered
+            if rate > self.serve_shed_rate:
+                self._alert(
+                    "serve_shed_burn", dep,
+                    f"deployment {dep!r}: ingress shed {d_shed:g} of "
+                    f"{offered:g} offered requests ({100 * rate:.0f}%) "
+                    f"over the last harvest window (shed-rate SLO "
+                    f"{100 * self.serve_shed_rate:.0f}%) — sustained "
+                    f"overload; scale the deployment or raise its "
+                    f"admission limits", severity="ERROR",
+                    deployment=dep, value=rate)
 
     def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
         for node in unreachable:
@@ -851,6 +919,7 @@ class Watchdog:
                                                  unreachable_nodes),
                       lambda: self._probe_locks(snaps),
                       lambda: self._probe_serve_slo(snaps),
+                      lambda: self._probe_serve_shed(snaps),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:
@@ -890,7 +959,8 @@ class MetricsPlane:
             lock_hold_s=Config.watchdog_lock_hold_s,
             lock_waiters=Config.watchdog_lock_waiters,
             serve_p99_s=Config.watchdog_serve_p99_s,
-            serve_error_rate=Config.watchdog_serve_error_rate)
+            serve_error_rate=Config.watchdog_serve_error_rate,
+            serve_shed_rate=Config.watchdog_serve_shed_rate)
         self._harvest_hist = get_or_create(
             Histogram, "ray_tpu_metrics_harvest_seconds",
             description="wall time of one cluster metrics harvest "
@@ -1078,7 +1148,8 @@ class MetricsPlane:
                   lock_hold_s: Optional[float] = None,
                   lock_waiters: Optional[int] = None,
                   serve_p99_s: Optional[float] = None,
-                  serve_error_rate: Optional[float] = None
+                  serve_error_rate: Optional[float] = None,
+                  serve_shed_rate: Optional[float] = None
                   ) -> Dict[str, Any]:
         """Runtime tuning (ops + tests): adjust the sample interval and
         watchdog thresholds without restarting the GCS."""
@@ -1102,6 +1173,8 @@ class MetricsPlane:
             self.watchdog.serve_p99_s = float(serve_p99_s)
         if serve_error_rate is not None:
             self.watchdog.serve_error_rate = float(serve_error_rate)
+        if serve_shed_rate is not None:
+            self.watchdog.serve_shed_rate = float(serve_shed_rate)
         return {"interval_s": self.interval_s,
                 "cooldown_s": self.watchdog.cooldown_s,
                 "wait_edge_age_s": self.watchdog.wait_edge_age_s,
@@ -1111,7 +1184,8 @@ class MetricsPlane:
                 "lock_hold_s": self.watchdog.lock_hold_s,
                 "lock_waiters": self.watchdog.lock_waiters,
                 "serve_p99_s": self.watchdog.serve_p99_s,
-                "serve_error_rate": self.watchdog.serve_error_rate}
+                "serve_error_rate": self.watchdog.serve_error_rate,
+                "serve_shed_rate": self.watchdog.serve_shed_rate}
 
     def stop(self) -> None:
         self._stopped = True

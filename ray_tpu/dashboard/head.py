@@ -356,6 +356,10 @@ class DashboardHead:
                 slowest=(int(params["slowest"])
                          if "slowest" in params else None),
                 timeout=float(params.get("timeout", 10.0)))
+        if route == "/api/serve/fleet":
+            # ingress fleet: per-node proxies, health/drain state,
+            # admission snapshots (CLI: `ray_tpu serve fleet`)
+            return s.serve_fleet()
         if route == "/api/wait_graph":
             # live actor waits-for edges + deadlocks-detected counter
             # (runtime counterpart of graftlint RT001)

@@ -51,6 +51,11 @@ BASE_PANELS: List[Dict[str, Any]] = [
     {"title": "Serve replica queue depth", "type": "timeseries",
      "targets": [{"expr": "sum by (deployment) "
                           "(ray_tpu_serve_replica_queue_depth)"}]},
+    # Ingress fleet admission control (serve/_private/proxy_fleet/):
+    # shed rate next to admitted rate = the brownout picture
+    {"title": "Serve shed/sec by deployment+reason", "type": "timeseries",
+     "targets": [{"expr": "sum by (deployment, reason) "
+                          "(rate(ray_tpu_serve_shed_total[1m]))"}]},
 ]
 
 

@@ -1,6 +1,6 @@
-"""graftlint rule set: 18 framework-aware checks.
+"""graftlint rule set: 19 framework-aware checks.
 
-Each rule has a stable id (RT001..RT018), a one-line rationale, and a
+Each rule has a stable id (RT001..RT019), a one-line rationale, and a
 `check(ctx)` generator yielding Findings. Rules are deliberately
 conservative: a finding should be actionable, and intentional
 exceptions are silenced in-place with `# graftlint: disable=RTxxx`
@@ -937,6 +937,65 @@ class OwnershipBookkeepingDiscipline(Rule):
                         self._msg(attr, f"`.{node.func.attr}()` call"))
 
 
+class BlockingCallInAsync(Rule):
+    id = "RT019"
+    name = "blocking-call-in-async"
+    rationale = ("a blocking call (time.sleep, ray_tpu.get/wait, raw "
+                 "socket/file/subprocess ops) directly inside an "
+                 "`async def` body stalls the whole event loop: every "
+                 "other coroutine on that loop — every other request "
+                 "on an ingress proxy — freezes for the call's "
+                 "duration; bridge through run_in_executor or the "
+                 "done-callback bridge (proxy_fleet/async_bridge.py) "
+                 "instead")
+
+    # beyond the shared blocking registry: calls that read files or
+    # hit the network synchronously (the "raw file read" class)
+    _EXTRA_DOTTED = frozenset({
+        "open", "urllib.request.urlopen", "requests.get",
+        "requests.post", "requests.put", "requests.delete",
+        "socket.socket", "socket.getaddrinfo",
+    })
+
+    def _nearest_fn(self, ctx: ModuleContext, node: ast.AST):
+        fns = ctx.enclosing_functions(node)
+        return fns[0] if fns else None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        from ray_tpu.lint.concurrency import match_blocking_call
+        async_fns = [n for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.AsyncFunctionDef)]
+        for fn in async_fns:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # only calls whose NEAREST enclosing function is this
+                # async def: a sync closure/lambda shipped to
+                # run_in_executor is the bridge pattern, not a finding
+                if self._nearest_fn(ctx, node) is not fn:
+                    continue
+                # a call under an `await` expression is (part of) an
+                # async call chain — asyncio.Event.wait(),
+                # asyncio.wait_for(x.wait(), t) — not a thread block
+                if any(isinstance(a, ast.Await)
+                       for a in ctx.ancestors(node)):
+                    continue
+                desc = match_blocking_call(ctx, node)
+                if desc is None:
+                    dotted = ctx.call_name(node)
+                    if dotted in self._EXTRA_DOTTED:
+                        desc = f"{dotted}()"
+                if desc is None:
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"blocking {desc} inside async def "
+                    f"'{fn.name}' stalls the event loop (and every "
+                    f"request riding it) — await an async "
+                    f"equivalent, run_in_executor, or the "
+                    f"done-callback bridge")
+
+
 # Concurrency layer (class-level guard maps + lock-order graph) lives
 # in its own module; the rules plug into the same catalogue.
 from ray_tpu.lint.concurrency import (BlockingUnderLock,  # noqa: E402
@@ -949,7 +1008,7 @@ ALL_RULES: List[Rule] = [
     WallClockDuration(), MetricNameConvention(), BarePrintInFramework(),
     SilentExceptionSwallow(), MixedGuardAccess(), BlockingUnderLock(),
     LockOrderCycle(), UnboundedWaitInServingPath(),
-    OwnershipBookkeepingDiscipline(),
+    OwnershipBookkeepingDiscipline(), BlockingCallInAsync(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

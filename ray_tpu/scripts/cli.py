@@ -621,6 +621,25 @@ def cmd_serve(args) -> int:
     per-stage latency breakdowns."""
     _connect(args)
     from ray_tpu.util import state as s
+    if args.serve_cmd == "fleet":
+        out = s.serve_fleet()
+        if args.format == "json":
+            print(json.dumps(out, default=str))
+            return 0
+        print(f"ingress fleet: enabled={out.get('enabled')} "
+              f"version={out.get('version')}")
+        for p in out.get("proxies", ()):
+            adm = p.get("admission") or {}
+            sheds = p.get("shed_total", "?")
+            print(f"  node {p['node_id'][:12]}  http:{p['http_port']}"
+                  f"{'  grpc:' + str(p['grpc_port']) if p.get('grpc_port') else ''}"
+                  f"  {'DRAINING' if p.get('draining') else ('healthy' if p.get('healthy') else 'UNHEALTHY')}"
+                  f"  inflight={p.get('inflight', '?')} shed={sheds}")
+            for dep, a in adm.items():
+                print(f"    {dep}: inflight={a['inflight']:g}"
+                      f"/{a['capacity']:g}+{a['max_queued']:g}"
+                      f"{('  rate=' + format(a['rate_limit_rps'], 'g') + '/s') if a['rate_limit_rps'] else ''}")
+        return 0
     if args.serve_cmd != "requests":
         raise SystemExit(f"unknown serve command {args.serve_cmd!r}")
     out = s.serve_requests(deployment=args.deployment,
@@ -890,10 +909,10 @@ def main(argv=None) -> int:
                    help="list recent crash postmortems")
     p.set_defaults(fn=cmd_logs)
 
-    p = sub.add_parser("serve", help="serve request telemetry: slow + "
-                                     "errored request capture "
-                                     "(see README)")
-    p.add_argument("serve_cmd", choices=["requests"])
+    p = sub.add_parser("serve", help="serve ops: request telemetry "
+                                     "(requests) + ingress fleet "
+                                     "state (fleet) — see README")
+    p.add_argument("serve_cmd", choices=["requests", "fleet"])
     p.add_argument("--address", default=None)
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--deployment", default=None,

@@ -1,0 +1,1 @@
+"""Serve-internal subsystems (reference python/ray/serve/_private)."""

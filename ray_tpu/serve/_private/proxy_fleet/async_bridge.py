@@ -1,0 +1,44 @@
+"""ObjectRef -> asyncio bridge: await task results without per-request
+threads.
+
+The threading proxy parked one handler thread per request in
+`ray_tpu.get` — its thread pool was the throughput ceiling (VERDICT
+Weak §8). Here the core worker's completion callback
+(`CoreWorker.add_done_callback`, PR-12) wakes the proxy's event loop
+instead: the event loop never blocks on remote work, and a node's whole
+ingress runs on ONE loop thread plus a small bounded submit pool for
+the handle's (blocking) routing calls.
+
+reference parity: serve/_private/proxy.py drives handles through
+asyncio natively; this bridge is the equivalent seam for a sync core
+worker API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+
+async def await_ref(ref: Any, loop: asyncio.AbstractEventLoop,
+                    timeout: Optional[float] = None) -> None:
+    """Block THIS COROUTINE (never the loop) until `ref` resolves.
+
+    Raises asyncio.TimeoutError past `timeout`. Resolution includes
+    error results — the subsequent materialize surfaces them."""
+    from ray_tpu._private import worker as worker_mod
+    fut: "asyncio.Future" = loop.create_future()
+
+    def _done() -> None:  # fires on a completion-handling thread
+        try:
+            loop.call_soon_threadsafe(_resolve)
+        except RuntimeError:  # loop already closed (proxy stopping)
+            pass
+
+    def _resolve() -> None:
+        if not fut.done():
+            fut.set_result(None)
+
+    cw = worker_mod.global_worker().core_worker
+    cw.add_done_callback(ref, _done)
+    await asyncio.wait_for(fut, timeout)

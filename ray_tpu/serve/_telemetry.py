@@ -101,10 +101,31 @@ def _queue_hist():
         boundaries=LATENCY_BOUNDARIES, tag_keys=("deployment",))
 
 
+def _shed_counter():
+    from ray_tpu.util.metrics import Counter, get_or_create
+    return get_or_create(
+        Counter, "ray_tpu_serve_shed_total",
+        description="serve ingress requests shed by admission control "
+                    "(503 + Retry-After / RESOURCE_EXHAUSTED), by "
+                    "deployment and reason (capacity | rate_limit)",
+        tag_keys=("deployment", "reason"))
+
+
 def count_request(deployment: str, code: Any) -> None:
     try:
         _counter().inc(tags={"deployment": deployment,
                              "code": str(code)})
+    except Exception:  # noqa: BLE001 - telemetry must never fail a request
+        pass
+
+
+def count_shed(deployment: str, reason: str) -> None:
+    """One shed decision at an ingress proxy — first-class RED (the
+    serve_shed_burn watchdog probe judges this counter's per-harvest
+    delta against admitted traffic)."""
+    try:
+        _shed_counter().inc(tags={"deployment": deployment,
+                                  "reason": reason})
     except Exception:  # noqa: BLE001 - telemetry must never fail a request
         pass
 

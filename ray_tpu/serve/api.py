@@ -67,6 +67,12 @@ class Deployment:
     max_concurrent_queries: int = 16
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     autoscaling_config: Optional[AutoscalingConfig] = None
+    # Ingress admission control (proxy_fleet/admission.py): requests
+    # admitted beyond replica capacity before the proxies shed with
+    # 503 + Retry-After (-1 = Config.serve_max_queued_per_deployment),
+    # and a per-proxy token-bucket rate limit in req/s (0 = unlimited).
+    max_queued_requests: int = -1
+    rate_limit_rps: float = 0.0
 
     def options(self, **kwargs: Any) -> "Deployment":
         import copy
@@ -91,7 +97,9 @@ class Application:
 def deployment(_func_or_class: Any = None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 16,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               autoscaling_config: Optional[AutoscalingConfig] = None):
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               max_queued_requests: int = -1,
+               rate_limit_rps: float = 0.0):
     """@serve.deployment decorator (reference api.py:deployment)."""
 
     def wrap(target: Any) -> Deployment:
@@ -101,7 +109,9 @@ def deployment(_func_or_class: Any = None, *, name: Optional[str] = None,
             num_replicas=num_replicas,
             max_concurrent_queries=max_concurrent_queries,
             ray_actor_options=dict(ray_actor_options or {}),
-            autoscaling_config=autoscaling_config)
+            autoscaling_config=autoscaling_config,
+            max_queued_requests=max_queued_requests,
+            rate_limit_rps=rate_limit_rps)
 
     if _func_or_class is not None:
         return wrap(_func_or_class)
@@ -194,6 +204,17 @@ class DeploymentHandle:
             self._replicas = replicas
             self._exists = bool(info.get("exists", True))
             self._max_queries = info.get("max_concurrent_queries", 0)
+            # admission/coalescing hints for the ingress fleet (the
+            # proxy derives per-deployment shed limits from these)
+            self._routing_extra = {
+                "replica_count": len(replicas),
+                "max_concurrent_queries":
+                    info.get("max_concurrent_queries", 0) or 16,
+                "max_queued_requests":
+                    info.get("max_queued_requests", -1),
+                "rate_limit_rps": info.get("rate_limit_rps", 0.0),
+                "coalesce": bool(info.get("coalesce", False)),
+            }
             live = {r._actor_id.hex() for r in replicas}
             self._in_flight = {k: v for k, v in self._in_flight.items()
                                if k in live}
@@ -310,6 +331,19 @@ class DeploymentHandle:
     def remote(self, *args: Any, **kwargs: Any):
         return self._submit(args, kwargs, model_id="", stream=False)
 
+    # ---- shared in-flight/probe accounting (router load estimates:
+    # _submit and _submit_batch must never diverge here) -------------
+    def _track_inflight(self, key: str) -> None:
+        with self._lock:
+            self._in_flight[key] = self._in_flight.get(key, 0) + 1
+            self._probe_delta[key] = self._probe_delta.get(key, 0) + 1
+
+    def _untrack_inflight(self, key: str) -> None:
+        with self._lock:
+            self._in_flight[key] = max(
+                0, self._in_flight.get(key, 1) - 1)
+            self._probe_delta[key] = self._probe_delta.get(key, 1) - 1
+
     def _submit(self, args: tuple, kwargs: Dict[str, Any], *,
                 model_id: str, stream: bool):
         from ray_tpu._private import spans as _spans_lib
@@ -320,10 +354,7 @@ class DeploymentHandle:
             self._refresh()
             replica = self._pick(model_id)
             key = replica._actor_id.hex()
-            with self._lock:
-                self._in_flight[key] = self._in_flight.get(key, 0) + 1
-                self._probe_delta[key] = \
-                    self._probe_delta.get(key, 0) + 1
+            self._track_inflight(key)
             if stream:
                 method = replica.handle_request_stream.options(
                     num_returns="streaming")
@@ -334,10 +365,7 @@ class DeploymentHandle:
             ref = method.remote(args, kwargs, model_id, time.time())
 
         def _done() -> None:
-            with self._lock:
-                self._in_flight[key] = max(
-                    0, self._in_flight.get(key, 1) - 1)
-                self._probe_delta[key] = self._probe_delta.get(key, 1) - 1
+            self._untrack_inflight(key)
             # one request_seconds observation per request, handle-side:
             # covers proxy AND direct-handle traffic without double
             # counting, and a request the proxy abandoned at its
@@ -355,6 +383,37 @@ class DeploymentHandle:
             # stream must not inflate the replica's load counters)
             cw.add_done_callback(ref.handle, _done)
             return _StreamingResponse(ref)
+        cw.add_done_callback(ref, _done)
+        return ref
+
+    def _submit_batch(self, items: List[Any]):
+        """Proxy-coalesced submit: N single-positional requests as ONE
+        handle_request_batch task (see proxy_fleet _Coalescer /
+        Replica.handle_request_batch). Routed like any request (P2C);
+        in-flight accounting counts the one task, request_seconds
+        observes once per fused item on completion."""
+        from ray_tpu._private import spans as _spans_lib
+        from ray_tpu.serve import _telemetry
+        t_submit = time.monotonic()
+        n = len(items)
+        with _spans_lib.span("serve.handle.submit",
+                             deployment=self.deployment_name,
+                             batch=n):
+            self._refresh()
+            replica = self._pick("")
+            key = replica._actor_id.hex()
+            self._track_inflight(key)
+            ref = replica.handle_request_batch.remote(
+                list(items), "", time.time())
+
+        def _done() -> None:
+            self._untrack_inflight(key)
+            dur = time.monotonic() - t_submit
+            for _ in range(n):
+                _telemetry.observe_request(self.deployment_name, dur)
+
+        import ray_tpu._private.worker as worker_mod
+        cw = worker_mod.global_worker().core_worker
         cw.add_done_callback(ref, _done)
         return ref
 
@@ -444,6 +503,12 @@ def run(app: Any, *, name: Optional[str] = None) -> DeploymentHandle:
     d = app.deployment
     controller = _get_or_create_controller()
     import cloudpickle
+    # proxy-side coalescing eligibility: a @serve.batch-decorated
+    # __call__ means single-positional ingress requests can fuse into
+    # one replica submit (proxy_fleet _Coalescer)
+    coalesce = bool(getattr(
+        getattr(d.func_or_class, "__call__", None),
+        "_serve_batch", False))
     ray_tpu.get(controller.deploy.remote(
         name=name or d.name,
         target_blob=cloudpickle.dumps(d.func_or_class),
@@ -451,7 +516,10 @@ def run(app: Any, *, name: Optional[str] = None) -> DeploymentHandle:
         num_replicas=d.num_replicas,
         max_concurrent_queries=d.max_concurrent_queries,
         ray_actor_options=d.ray_actor_options,
-        autoscaling=d.autoscaling_config), timeout=300)
+        autoscaling=d.autoscaling_config,
+        max_queued_requests=d.max_queued_requests,
+        rate_limit_rps=d.rate_limit_rps,
+        coalesce=coalesce), timeout=300)
     return DeploymentHandle(name or d.name, controller)
 
 
@@ -480,22 +548,99 @@ def shutdown() -> None:
         pass
 
 
+def _local_fleet_proxy(status: Dict[str, Any]) -> Any:
+    """The calling node's proxy actor out of a fleet status (falls back
+    to any healthy proxy — a driver on a proxyless node still gets an
+    ingress handle)."""
+    from ray_tpu.serve._private.proxy_fleet.fleet import (
+        PROXY_NAME_PREFIX)
+    my_node = ray_tpu.get_runtime_context().get_node_id()
+    proxies = status.get("proxies", [])
+    # prefer local, healthy, NOT-draining (a mid-roll fleet: the
+    # draining proxy still serves, but its replacement is the one
+    # whose port survives this round)
+    ordered = sorted(proxies,
+                     key=lambda p: (p["node_id"] != my_node,
+                                    bool(p.get("draining", False)),
+                                    not p.get("healthy", False)))
+    for p in ordered:
+        try:
+            return ray_tpu.get_actor(
+                f"{PROXY_NAME_PREFIX}{p['node_id'][:12]}",
+                namespace=_NAMESPACE)
+        except Exception:  # noqa: BLE001 - raced a dying proxy
+            continue
+    raise RuntimeError(f"ingress fleet started no proxies: {status}")
+
+
 def start_http(port: int = 8000,
                request_timeout_s: Optional[float] = None) -> Any:
-    """Start the HTTP ingress actor (reference proxy.py HTTPProxy): POST
-    /<deployment> with a JSON body calls the deployment and returns the
-    JSON result. `request_timeout_s` bounds each request's handle wait
-    (default Config.serve_request_timeout_s; timeouts surface as 504).
-    The actor gets a unique cluster name (SERVE_PROXY_HTTP_*, namespace
-    "serve") so the request-telemetry query plane can enumerate it."""
-    import uuid as _uuid
+    """Start the ingress fleet's HTTP side (reference serve.start +
+    proxy_state): ONE asyncio proxy per alive node, with admission
+    control, load shedding, and drain-safe rolling updates (README
+    "Serve at scale"). POST/GET /<deployment> with a JSON body calls
+    the deployment and returns the JSON result; `request_timeout_s`
+    bounds each request's handle wait (default
+    Config.serve_request_timeout_s; timeouts surface as 504). To serve
+    gRPC off the same per-node event loops, arm the fleet with
+    serve.start_fleet(grpc_port=...); serve.start_grpc remains the
+    LEGACY standalone gRPC actor.
 
-    from ray_tpu.serve.proxy import HTTPProxyActor
-    cls = ray_tpu.remote(HTTPProxyActor)
-    proxy = cls.options(
-        num_cpus=0.1,
-        name=f"SERVE_PROXY_HTTP_{_uuid.uuid4().hex[:8]}",
-        namespace=_NAMESPACE).remote(
-        port, request_timeout_s=request_timeout_s)
-    ray_tpu.get(proxy.ready.remote(), timeout=60)
-    return proxy
+    Returns the LOCAL node's proxy actor (API-compatible with the old
+    single threading proxy: .ready / .stop / .requests_snapshot);
+    fleet-wide state lives behind serve.fleet_status(). Config changes
+    roll the fleet node-by-node (drain-first) on subsequent reconcile
+    rounds. Proxies self-register as named actors
+    (SERVE_PROXY_FLEET_<node>, namespace "serve") so the
+    request-telemetry query plane can enumerate them."""
+    controller = _get_or_create_controller()
+    last: Optional[Exception] = None
+    for _attempt in range(3):
+        # bounded 3-attempt name-release retry, one call per attempt —
+        # not a serialization of independent work
+        status = ray_tpu.get(  # graftlint: disable=RT002
+            controller.start_proxy_fleet.remote(
+                http_port=port, request_timeout_s=request_timeout_s),
+            timeout=120)
+        try:
+            return _local_fleet_proxy(status)
+        except RuntimeError as e:
+            # a just-killed predecessor can hold the actor name for a
+            # beat; the next reconcile round starts the replacement
+            last = e
+            time.sleep(1.0)
+    raise last
+
+
+def start_fleet(http_port: Optional[int] = None,
+                grpc_port: Optional[int] = None,
+                request_timeout_s: Optional[float] = None
+                ) -> Dict[str, Any]:
+    """Arm (or reconfigure) the whole ingress fleet explicitly — the
+    superset of start_http that also serves gRPC from each node's
+    event loop (`grpc_port`; shed → RESOURCE_EXHAUSTED with a
+    retry-after metadata hint). Every parameter is keep-if-None, so
+    `serve.start_fleet(grpc_port=9001)` adds gRPC WITHOUT rolling the
+    armed HTTP port. Returns the fleet status (per-node proxies with
+    bound ports). A changed config rolls proxies node-by-node,
+    drain-first."""
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.start_proxy_fleet.remote(
+        http_port=http_port, grpc_port=grpc_port,
+        request_timeout_s=request_timeout_s), timeout=120)
+
+
+def fleet_status() -> Dict[str, Any]:
+    """Ingress fleet state: per-node proxies, ports, health, drain
+    flags (CLI: `ray_tpu serve fleet`)."""
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.fleet_status.remote(), timeout=30)
+
+
+def drain_proxy(node_id: str) -> bool:
+    """Drain one node's ingress proxy (stop accepting → finish
+    in-flight → deregister) ahead of node removal. Returns False if the
+    node has no proxy."""
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.drain_proxy.remote(node_id),
+                       timeout=120)

@@ -87,8 +87,7 @@ def batch(_func: Callable = None, *, max_batch_size: int = 10,
     def deco(fn: Callable) -> Callable:
         attr = f"__serve_batch_queue_{fn.__name__}"
 
-        @functools.wraps(fn)
-        def wrapper(self, item: Any):
+        def _ensure_queue(self):
             q = getattr(self, attr, None)
             if q is None:
                 # the module-level lock guards first-call queue init.
@@ -103,9 +102,23 @@ def batch(_func: Callable = None, *, max_batch_size: int = 10,
                         q = _mod._BatchQueue(fn, self, max_batch_size,
                                              batch_wait_timeout_s)
                         setattr(self, attr, q)
-            return q.submit(item).result()
+            return q
+
+        @functools.wraps(fn)
+        def wrapper(self, item: Any):
+            return _ensure_queue(self).submit(item).result()
+
+        def _submit_many(self, items: List[Any]) -> List[Future]:
+            """Enqueue a proxy-coalesced batch WITHOUT blocking between
+            items (every item must be in the queue before anyone waits,
+            or the fused forward pass degenerates to per-item passes).
+            Used by Replica.handle_request_batch; returns the futures
+            in item order."""
+            q = _ensure_queue(self)
+            return [q.submit(i) for i in items]
 
         wrapper._serve_batch = True  # type: ignore[attr-defined]
+        wrapper._serve_batch_submit_many = _submit_many  # type: ignore[attr-defined]
         return wrapper
 
     if _func is not None:

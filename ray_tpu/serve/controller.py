@@ -200,6 +200,85 @@ class Replica:
             with self._lock:
                 self._in_flight -= 1
 
+    def handle_request_batch(self, requests: List[Any],
+                             model_id: str = "",
+                             submit_ts=None) -> List[tuple]:
+        """Proxy-coalesced execution: `requests` is a list of single
+        positional args fused by an ingress proxy (proxy_fleet
+        _Coalescer) into ONE task submit. A @serve.batch-decorated
+        __call__ gets every item enqueued BEFORE any result is awaited,
+        so the whole proxy batch lands in one fused forward pass;
+        plain callables run the items in order (still one task's
+        overhead instead of N). Returns [(ok, result-or-error), ...] —
+        per-item errors must not fail the co-batched strangers."""
+        from ray_tpu._private import spans as spans_lib
+        from ray_tpu._private.config import Config
+        self._record_queue_time(submit_ts)
+        with self._lock:
+            self._in_flight += 1
+            self._total += len(requests)
+        _current_model_id.value = model_id
+        out: List[tuple] = []
+        try:
+            with spans_lib.span("serve.replica.execute",
+                                deployment=self.deployment_name,
+                                batch=len(requests)):
+                fn = self._callable
+                if not callable(fn):
+                    raise TypeError(
+                        f"deployment target {fn!r} is not callable")
+                # class deployments only: the @serve.batch wrapper is
+                # the class's __call__ (function deployments can't
+                # batch — the wrapper needs an owner for its queue)
+                meth = getattr(type(fn), "__call__", None)
+                submit_many = getattr(meth, "_serve_batch_submit_many",
+                                      None)
+                if submit_many is not None:
+                    futs = submit_many(fn, list(requests))
+                    # ONE shared deadline for the whole batch: a
+                    # wedged handler costs one timeout, not N of them
+                    # serially (which would pin this executor slot —
+                    # and block Replica.drain — for N x timeout)
+                    deadline = time.monotonic() \
+                        + Config.serve_request_timeout_s
+                    for f in futs:
+                        try:
+                            out.append((True, f.result(
+                                timeout=max(0.0, deadline
+                                            - time.monotonic()))))
+                        except Exception as e:  # noqa: BLE001
+                            out.append((False,
+                                        f"{type(e).__name__}: {e}"))
+                else:
+                    for item in requests:
+                        try:
+                            out.append((True, fn(item)))
+                        except Exception as e:  # noqa: BLE001
+                            out.append((False,
+                                        f"{type(e).__name__}: {e}"))
+            return out
+        finally:
+            _current_model_id.value = ""
+            with self._lock:
+                self._in_flight -= 1
+
+    @_control_group
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful-shutdown gate (rolling updates): poll until every
+        queued + executing request on the default group has finished.
+        Runs on the control group so it can observe the default group
+        draining; new work stops arriving because the controller bumped
+        the routing snapshot away from this replica first."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if self.ongoing_requests() == 0:
+                with self._lock:
+                    if self._in_flight == 0:
+                        return True
+            _time.sleep(0.05)
+        return False
+
     def handle_request_stream(self, args: tuple,
                               kwargs: Dict[str, Any],
                               model_id: str = "", submit_ts=None):
@@ -264,6 +343,14 @@ class _DeploymentState:
     max_concurrent_queries: int
     ray_actor_options: Dict[str, Any]
     autoscaling: Optional[Any] = None
+    # ingress admission control (proxy_fleet/admission.py): queued
+    # requests admitted beyond replica capacity (-1 = config default)
+    # and a per-proxy token-bucket rate limit (0 = unlimited)
+    max_queued_requests: int = -1
+    rate_limit_rps: float = 0.0
+    # proxy-side request coalescing: True when the deployment's
+    # __call__ is @serve.batch-decorated (detected at serve.run time)
+    coalesce: bool = False
     replicas: List[Any] = field(default_factory=list)
     deleted: bool = False
     # sustained-condition tracking for autoscaling delays
@@ -282,6 +369,8 @@ class ServeController:
     RECONCILE_PERIOD_S = 1.0
 
     def __init__(self) -> None:
+        from ray_tpu.serve._private.proxy_fleet.fleet import (
+            ProxyFleetManager)
         self._deployments: Dict[str, _DeploymentState] = {}
         self._lock = TracedLock("serve_controller")
         self._stop = threading.Event()
@@ -290,8 +379,46 @@ class ServeController:
         # the condition until a watched id advances.
         self._lp_cond = threading.Condition()
         self._snapshots: Dict[str, int] = {}
+        # ingress fleet (proxy_fleet/fleet.py): reconciled on its OWN
+        # thread once start_proxy_fleet arms it — a proxy drain (up to
+        # serve_drain_timeout_s) must never stall replica repair or
+        # autoscaling on the deployment loop
+        self._fleet = ProxyFleetManager()
         threading.Thread(target=self._reconcile_loop, daemon=True,
                          name="serve-reconcile").start()
+        threading.Thread(target=self._fleet_loop, daemon=True,
+                         name="serve-fleet-reconcile").start()
+
+    # ---- ingress fleet ----------------------------------------------
+
+    def _alive_node_ids(self) -> List[str]:
+        from ray_tpu._private import worker as worker_mod
+        gcs = worker_mod.global_worker().core_worker._gcs
+        return [n.node_id.hex() for n in gcs.call("get_all_nodes")
+                if n.alive]
+
+    def start_proxy_fleet(self, http_port: Optional[int] = None,
+                          grpc_port: Optional[int] = None,
+                          request_timeout_s: Optional[float] = None
+                          ) -> Dict[str, Any]:
+        """Arm (or reconfigure) the ingress fleet and reconcile it NOW
+        so the caller gets live endpoints back. Parameters are
+        keep-if-None; a changed config rolls proxies node-by-node on
+        subsequent reconcile rounds."""
+        self._fleet.ensure(http_port=http_port, grpc_port=grpc_port,
+                           request_timeout_s=request_timeout_s)
+        self._fleet.reconcile(self._alive_node_ids())
+        return self._fleet.status()
+
+    def fleet_status(self) -> Dict[str, Any]:
+        return self._fleet.status()
+
+    def drain_proxy(self, node_id: str) -> bool:
+        """Drain + deregister one node's proxy (node-removal path)."""
+        return self._fleet.drain_node(node_id)
+
+    def stop_proxy_fleet(self) -> None:
+        self._fleet.stop_all()
 
     # ---- long-poll push ---------------------------------------------
 
@@ -332,7 +459,10 @@ class ServeController:
                init_kwargs: Dict[str, Any], num_replicas: int,
                max_concurrent_queries: int,
                ray_actor_options: Dict[str, Any],
-               autoscaling: Optional[Any] = None) -> None:
+               autoscaling: Optional[Any] = None,
+               max_queued_requests: int = -1,
+               rate_limit_rps: float = 0.0,
+               coalesce: bool = False) -> None:
         with self._lock:
             old = self._deployments.get(name)
             state = _DeploymentState(
@@ -340,16 +470,25 @@ class ServeController:
                 init_kwargs=init_kwargs, target_replicas=num_replicas,
                 max_concurrent_queries=max_concurrent_queries,
                 ray_actor_options=dict(ray_actor_options),
-                autoscaling=autoscaling)
+                autoscaling=autoscaling,
+                max_queued_requests=max_queued_requests,
+                rate_limit_rps=rate_limit_rps, coalesce=coalesce)
             self._deployments[name] = state
+        # Rolling update, new-first (reference deployment_state rolling
+        # replace): start the NEW replica set, publish it (snapshot
+        # bump pushes every handle onto the new set), and only then
+        # drain + stop the old one — in-flight requests on old replicas
+        # finish instead of dying with the actor, so a redeploy under
+        # load surfaces zero 5xx.
         if old is not None:
-            # redeploy = replace every replica (new code version)
             old.deleted = True
-            with old.op_lock:
-                self._stop_replicas(old.replicas)
-                old.replicas = []
         self._reconcile_one(state)
         self._bump_snapshot(name)
+        if old is not None:
+            with old.op_lock:
+                self._drain_replicas(old.replicas)
+                self._stop_replicas(old.replicas)
+                old.replicas = []
 
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
@@ -373,7 +512,12 @@ class ServeController:
                         "snapshot_id": snap, "exists": False}
             return {"replicas": list(state.replicas),
                     "max_concurrent_queries": state.max_concurrent_queries,
-                    "snapshot_id": snap, "exists": True}
+                    "snapshot_id": snap, "exists": True,
+                    # ingress admission + coalescing hints (the proxy
+                    # fleet derives per-deployment limits from these)
+                    "max_queued_requests": state.max_queued_requests,
+                    "rate_limit_rps": state.rate_limit_rps,
+                    "coalesce": state.coalesce}
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -393,6 +537,10 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._stop.set()
+        try:
+            self._fleet.stop_all()
+        except Exception:  # noqa: BLE001 - proxies die with the cluster
+            logger.exception("proxy fleet stop failed during shutdown")
         with self._lock:
             states = list(self._deployments.values())
             self._deployments.clear()
@@ -423,6 +571,24 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:  # noqa: BLE001 - replica already dead
                 pass
+
+    def _drain_replicas(self, replicas: List[Any]) -> None:
+        """Wait (bounded) for every replica's queued + executing
+        requests to finish before it is stopped — the rolling-update
+        half of the zero-5xx contract. One batched wait bounds the
+        whole drain instead of timeout x replicas."""
+        import ray_tpu
+        from ray_tpu._private.config import Config
+        budget = Config.serve_drain_timeout_s
+        drains = []
+        for r in replicas:
+            try:
+                drains.append(r.drain.remote(budget))
+            except Exception:  # noqa: BLE001 — dead replica has
+                pass           # nothing in flight to wait for
+        if drains:
+            ray_tpu.wait(drains, num_returns=len(drains),
+                         timeout=budget + 15)
 
     def _reconcile_one(self, state: _DeploymentState) -> None:
         import ray_tpu
@@ -455,16 +621,17 @@ class ServeController:
                 ray_tpu.wait(pings, num_returns=len(pings), timeout=120)
             with self._lock:
                 if state.deleted:
-                    pending_stop = alive
-                    state.replicas = []
+                    # deleted while we were reconciling: the DELETER
+                    # (redeploy/delete_deployment) owns these replicas
+                    # — it drains then stops them under op_lock after
+                    # us. Stopping here would skip the drain and kill
+                    # in-flight requests mid-rolling-update.
+                    state.replicas = alive
                     changed = False
                 else:
-                    pending_stop = []
                     changed = [id(r) for r in state.replicas] != \
                         [id(r) for r in alive]
                     state.replicas = alive
-        if pending_stop:  # deleted while we were reconciling
-            self._stop_replicas(pending_stop)
         if changed:  # replica set moved: push to long-poll listeners
             self._bump_snapshot(state.name)
 
@@ -511,3 +678,12 @@ class ServeController:
                 except Exception:  # noqa: BLE001
                     logger.exception("serve reconcile failed for %s",
                                      state.name)
+
+    def _fleet_loop(self) -> None:
+        while not self._stop.wait(self.RECONCILE_PERIOD_S):
+            if not self._fleet.enabled:
+                continue  # don't pay a GCS node-list RPC per second
+            try:          # for a fleet nobody armed
+                self._fleet.reconcile(self._alive_node_ids())
+            except Exception:  # noqa: BLE001
+                logger.exception("serve fleet reconcile failed")

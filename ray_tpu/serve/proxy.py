@@ -1,4 +1,11 @@
-"""HTTP ingress for serve deployments.
+"""LEGACY threading HTTP ingress (compat shim).
+
+The default ingress is now the per-node asyncio proxy fleet
+(serve/_private/proxy_fleet/ — `serve.start_http` starts it); this
+ThreadingHTTPServer actor remains only for callers that import
+HTTPProxyActor directly. Its thread pool caps HTTP at ~500 req/s while
+handles sustain ~1,500 (VERDICT Weak §8, BENCH_SERVE_r07/r08) and it
+has no admission control: new code should go through the fleet.
 
 reference parity: serve/_private/proxy.py:122 (per-node HTTP proxy
 routing requests into deployment handles). POST/GET /<deployment-name>

@@ -946,7 +946,7 @@ def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == [f"RT00{i}" for i in range(1, 10)] + \
         ["RT010", "RT011", "RT012", "RT013", "RT014", "RT015", "RT016",
-         "RT017", "RT018"]
+         "RT017", "RT018", "RT019"]
     assert all(r.rationale for r in ALL_RULES)
 
 
@@ -1595,6 +1595,95 @@ def test_ray_tpu_package_lints_clean():
     pkg = os.path.join(REPO_ROOT, "ray_tpu")
     fs = lint_paths([pkg], cache_path=CACHE_PATH)
     assert fs == [], "\n" + "\n".join(f.format() for f in fs)
+
+
+# ---- RT019 blocking call in async code ------------------------------------
+
+RT019_SLEEP = """
+    import time
+
+    async def handler(req):
+        time.sleep(0.5)
+        return req
+"""
+
+RT019_GET = """
+    import ray_tpu
+
+    async def handler(ref):
+        return ray_tpu.get(ref, timeout=30)
+"""
+
+RT019_WAIT = """
+    import ray_tpu
+
+    async def drain(refs):
+        return ray_tpu.wait(refs, num_returns=len(refs), timeout=5)
+"""
+
+RT019_SOCKET = """
+    async def fetch(sock):
+        return sock.recv(4096)
+"""
+
+RT019_OPEN = """
+    async def load(path):
+        with open(path) as f:
+            return f.read()
+"""
+
+RT019_NEG_EXECUTOR = """
+    import asyncio
+    import ray_tpu
+
+    async def handler(loop, pool, ref):
+        # the bridge pattern: the blocking call lives in a sync
+        # closure shipped to the executor, never on the loop
+        return await loop.run_in_executor(
+            pool, lambda: ray_tpu.get(ref, timeout=30))
+"""
+
+RT019_NEG_AWAITED = """
+    import asyncio
+
+    async def drain(idle, budget):
+        # asyncio primitives: .wait() under await is a coroutine
+        await asyncio.wait_for(idle.wait(), budget)
+"""
+
+RT019_NEG_SYNC_DEF = """
+    import time
+
+    def plain(x):
+        time.sleep(0.1)
+        return x
+"""
+
+RT019_SUPPRESSED = """
+    import time
+
+    async def handler(req):
+        time.sleep(0.5)  # graftlint: disable=RT019
+        return req
+"""
+
+
+def _rt019_hits(src):
+    return {f.rule_id
+            for f in lint_source(textwrap.dedent(src),
+                                 "ray_tpu/serve/_private/x.py")}
+
+
+@pytest.mark.parametrize("src", [RT019_SLEEP, RT019_GET, RT019_WAIT,
+                                 RT019_SOCKET, RT019_OPEN])
+def test_rt019_blocking_in_async_flagged(src):
+    assert "RT019" in _rt019_hits(src)
+
+
+@pytest.mark.parametrize("src", [RT019_NEG_EXECUTOR, RT019_NEG_AWAITED,
+                                 RT019_NEG_SYNC_DEF, RT019_SUPPRESSED])
+def test_rt019_bridge_awaited_sync_and_suppressed_fine(src):
+    assert "RT019" not in _rt019_hits(src)
 
 
 def test_tools_lint_runner_clean():

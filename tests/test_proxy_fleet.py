@@ -1,0 +1,507 @@
+"""Serve ingress fleet: per-node asyncio proxies, admission control,
+load shedding, drain lifecycle, rolling updates (PR 13).
+
+reference parity: serve/_private/proxy.py (asyncio HTTP+gRPC proxy per
+node) + proxy_state.py (fleet lifecycle). Heavy overload sweeps live in
+tools/bench_serve.py and behind `-m slow` here (ROADMAP Health note:
+tier-1 wall time is budgeted).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util import state as state_api
+
+
+@pytest.fixture()
+def serve_session(ray_start):
+    yield ray_start
+    serve.shutdown()
+
+
+def _gcs():
+    from ray_tpu._private import worker as worker_mod
+    return worker_mod.global_worker().core_worker._gcs
+
+
+def _post(port, dep, body=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{dep}",
+        data=json.dumps(body if body is not None else {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+# ---------------------------------------------------------------------
+# Fleet lifecycle
+# ---------------------------------------------------------------------
+
+
+def test_fleet_serves_http_and_grpc_from_one_actor(serve_session):
+    """One AsyncProxyActor per node carries BOTH transports off one
+    event loop; the fleet status + state API surface it."""
+
+    @serve.deployment(name="fleet_echo")
+    def echo(x=0, scale=1):
+        return x * scale
+
+    serve.run(echo)
+    st = serve.start_fleet(http_port=0, grpc_port=0)
+    assert len(st["proxies"]) == 1
+    p = st["proxies"][0]
+    assert p["http_port"] and p["grpc_port"] and p["healthy"]
+    body, headers = _post(p["http_port"], "fleet_echo",
+                          {"x": 21, "scale": 2})
+    assert body == {"result": 42}
+    assert headers.get("X-Request-Id")
+    assert serve.grpc_call(f"127.0.0.1:{p['grpc_port']}",
+                           "fleet_echo", 21, scale=2) == 42
+    # state API enrichment: admission snapshot rides along
+    fleet = state_api.serve_fleet()
+    assert fleet["enabled"] and fleet["proxies"][0]["admission"] \
+        is not None
+
+
+def test_fleet_replaces_killed_proxy_chaos(serve_session):
+    """PR-2 chaos plane proxy-kill rule: the fleet health checks detect
+    the dead proxy and reconcile a replacement; traffic recovers."""
+    from ray_tpu import chaos
+
+    @serve.deployment(name="fleet_kill")
+    def f(x=0):
+        return x + 1
+
+    serve.run(f)
+    proxy = serve.start_http(port=0)
+    port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    assert _post(port, "fleet_kill", {"x": 1})[0] == {"result": 2}
+    rid = chaos.inject("kill_worker", actor_class="AsyncProxyActor",
+                       max_fires=1)
+    try:
+        # the next actor call to the proxy fires the kill
+        try:
+            ray_tpu.get(proxy.ping.remote(), timeout=30)
+        except Exception:  # noqa: BLE001 - died under the call, expected
+            pass
+        # fleet reconcile replaces it (dead proxies replace immediately)
+        deadline = time.monotonic() + 60
+        new_port = None
+        while time.monotonic() < deadline:
+            st = serve.fleet_status()
+            ps = st.get("proxies", [])
+            if ps and ps[0]["healthy"]:
+                new_port = ps[0]["http_port"]
+                try:
+                    if _post(new_port, "fleet_kill",
+                             {"x": 2})[0] == {"result": 3}:
+                        break
+                except Exception:  # noqa: BLE001 - still coming up
+                    pass
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"fleet never replaced killed proxy: "
+                        f"{serve.fleet_status()}")
+    finally:
+        chaos.clear([rid])
+
+
+def test_drain_completes_inflight_then_refuses(serve_session):
+    """Drain lifecycle: in-flight requests finish (no 5xx), the
+    listener closes (new connections refused), the fleet deregisters
+    the proxy."""
+
+    @serve.deployment(name="fleet_slow", num_replicas=2)
+    def slow(x=0):
+        time.sleep(0.4)
+        return x
+
+    serve.run(slow)
+    proxy = serve.start_http(port=0)
+    port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    _post(port, "fleet_slow")  # warm
+    results = []
+
+    def call(i):
+        try:
+            results.append(("ok", _post(port, "fleet_slow",
+                                        {"x": i})[0]["result"]))
+        except Exception as e:  # noqa: BLE001
+            results.append(("err", repr(e)))
+
+    ts = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.15)  # requests in flight on the replicas
+    node = ray_tpu.get_runtime_context().get_node_id()
+    assert serve.drain_proxy(node) is True
+    for t in ts:
+        t.join(timeout=60)
+    # every in-flight request finished with a result, none got 5xx
+    assert [r for r in results if r[0] == "err"] == [], results
+    assert sorted(r[1] for r in results) == [0, 1, 2, 3]
+    # the listener is closed now: a fresh connection is refused
+    with pytest.raises((ConnectionError, urllib.error.URLError,
+                        socket.timeout, OSError)):
+        _post(port, "fleet_slow", timeout=5)
+    # and the fleet shows no proxy for the node (cordoned, no respawn)
+    time.sleep(1.5)
+    assert serve.fleet_status()["proxies"] == []
+
+
+# ---------------------------------------------------------------------
+# Admission control + shedding
+# ---------------------------------------------------------------------
+
+
+def test_shed_carries_retry_after_and_records_everywhere(serve_session):
+    """Satellite: shed responses carry Retry-After, land in the request
+    ring as 503s, and count into ray_tpu_serve_shed_total on the merged
+    exposition."""
+
+    @serve.deployment(name="fleet_shed", num_replicas=1,
+                      max_concurrent_queries=2, max_queued_requests=1)
+    def shed(x=0):
+        time.sleep(0.5)
+        return x
+
+    serve.run(shed)
+    proxy = serve.start_http(port=0)
+    port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    _post(port, "fleet_shed")  # warm: limits learned from routing info
+    outcomes = []
+    lock = threading.Lock()
+
+    def call(i):
+        try:
+            _post(port, "fleet_shed", {"x": i})
+            with lock:
+                outcomes.append((200, None))
+        except urllib.error.HTTPError as e:
+            with lock:
+                outcomes.append((e.code, e.headers.get("Retry-After")))
+
+    ts = [threading.Thread(target=call, args=(i,)) for i in range(10)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    sheds = [o for o in outcomes if o[0] == 503]
+    assert sheds, f"nothing shed: {outcomes}"
+    assert all(ra is not None for _c, ra in sheds), sheds
+    # ring entries: 503s with the shed reason in the error field
+    snap = ray_tpu.get(proxy.requests_snapshot.remote(errors=True),
+                       timeout=30)
+    shed_entries = [e for e in snap if e["code"] == 503]
+    assert shed_entries and all(
+        "shed" in (e["error"] or "") for e in shed_entries)
+    # merged metrics: the shed counter is first-class RED
+    text = state_api.cluster_metrics_text(fresh=True)
+    assert 'ray_tpu_serve_shed_total{' in text
+    line = next(l for l in text.splitlines()
+                if l.startswith("ray_tpu_serve_shed_total")
+                and 'deployment="fleet_shed"' in l)
+    assert 'reason="capacity"' in line
+
+
+def test_rate_limit_sheds_fast(serve_session):
+    """Token-bucket rate limiting: traffic over rate_limit_rps sheds
+    with reason=rate_limit even with idle replicas."""
+
+    @serve.deployment(name="fleet_rated", rate_limit_rps=5.0)
+    def rated(x=0):
+        return x
+
+    serve.run(rated)
+    proxy = serve.start_http(port=0)
+    port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    _post(port, "fleet_rated")  # warm (burst bucket starts full)
+    codes = []
+    for i in range(30):
+        try:
+            _post(port, "fleet_rated", {"x": i})
+            codes.append(200)
+        except urllib.error.HTTPError as e:
+            codes.append(e.code)
+    assert 503 in codes, codes
+    assert codes.count(200) <= 15  # burst (~5) + refill during the loop
+
+
+def test_shed_burn_watchdog_fires(serve_session):
+    """Satellite: the serve_shed_burn SLO probe alerts on sustained
+    shedding within two harvest intervals."""
+
+    @serve.deployment(name="fleet_burn", num_replicas=1,
+                      max_concurrent_queries=1, max_queued_requests=0)
+    def burn(x=0):
+        time.sleep(0.3)
+        return x
+
+    serve.run(burn)
+    proxy = serve.start_http(port=0)
+    port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    _post(port, "fleet_burn")  # warm
+    t_start = time.time()
+    _gcs().call("metrics_configure", interval_s=1.0, cooldown_s=0.1,
+                serve_shed_rate=0.2)
+    stop = [False]
+
+    def load():
+        while not stop[0]:
+            try:
+                _post(port, "fleet_burn", timeout=30)
+            except urllib.error.HTTPError:
+                pass
+
+    threads = [threading.Thread(target=load, daemon=True)
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 25
+        alert = None
+        while time.monotonic() < deadline and alert is None:
+            time.sleep(0.2)
+            for a in state_api.health_alerts():
+                if a.get("probe") == "serve_shed_burn" \
+                        and a.get("ts", 0) >= t_start:
+                    alert = a
+                    break
+        assert alert is not None, "serve_shed_burn never fired"
+        assert alert["severity"] == "ERROR"
+        assert "fleet_burn" in alert["message"]
+    finally:
+        stop[0] = True
+        for t in threads:
+            t.join(timeout=10)
+        _gcs().call("metrics_configure", interval_s=2.0,
+                    cooldown_s=30.0, serve_shed_rate=0.5)
+
+
+# ---------------------------------------------------------------------
+# Rolling updates (chaos drain under load)
+# ---------------------------------------------------------------------
+
+
+def test_chaos_rolling_update_and_proxy_roll_zero_failures(
+        serve_session):
+    """Acceptance: rolling update (every replica replaced) PLUS a
+    proxy drain-replace (fleet config roll), both under live load —
+    zero user-visible request failures. Connection-level retries are
+    the client contract during a proxy roll (drain closes listeners);
+    5xx responses and aborted in-flight requests are failures."""
+
+    @serve.deployment(name="fleet_roll", num_replicas=2)
+    class Roll:
+        def __init__(self, version):
+            self.version = version
+
+        def __call__(self, x=0):
+            time.sleep(0.01)
+            return self.version
+
+    serve.run(Roll.bind("v1"))
+    proxy = serve.start_http(port=0)
+    port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    stop = [False]
+    failures = []
+    successes = [0]
+
+    def load():
+        while not stop[0]:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/fleet_roll",
+                data=json.dumps({"x": 1}).encode())
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    json.loads(resp.read())
+                    successes[0] += 1
+            except urllib.error.HTTPError as e:
+                failures.append(("http", e.code))
+            except Exception:  # noqa: BLE001 — connection-level retry
+                time.sleep(0.05)  # (proxy roll closes conns; clients
+                # reconnect — not a user-visible request failure)
+
+    threads = [threading.Thread(target=load) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.5)
+        # 1) deployment rolling update: all replicas replaced under load
+        serve.run(Roll.bind("v2"))
+        time.sleep(0.5)
+        # 2) proxy rolling update: config change → drain-replace
+        serve.start_fleet(http_port=0, request_timeout_s=90.0)
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            st = serve.fleet_status()
+            ps = st.get("proxies", [])
+            if ps and ps[0]["healthy"] and not ps[0]["draining"]:
+                break
+            time.sleep(0.5)
+        new_port = serve.fleet_status()["proxies"][0]["http_port"]
+        time.sleep(0.5)
+    finally:
+        stop[0] = True
+        for t in threads:
+            t.join(timeout=30)
+    assert not failures, failures
+    assert successes[0] > 20, successes[0]
+    # post-roll: the new proxy serves the new version
+    assert _post(new_port, "fleet_roll")[0] == {"result": "v2"}
+
+
+# ---------------------------------------------------------------------
+# Node join/death (multinode) + heavy overload (slow)
+# ---------------------------------------------------------------------
+
+
+def test_fleet_covers_node_join_and_death():
+    """One proxy per alive node: a joining node gets a proxy within a
+    reconcile round; a dead node's proxy deregisters."""
+    from ray_tpu.cluster_utils import Cluster
+    ray_tpu.shutdown()  # release the session-scoped local cluster
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        c.connect()
+
+        @serve.deployment(name="fleet_multi")
+        def f(x=0):
+            return x
+
+        serve.run(f)
+        serve.start_http(port=0)
+        assert len(serve.fleet_status()["proxies"]) == 1
+        n2 = c.add_node(num_cpus=2)
+        c.wait_for_nodes()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            ps = serve.fleet_status()["proxies"]
+            if len(ps) == 2 and all(p["healthy"] for p in ps):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"no proxy for joined node: "
+                        f"{serve.fleet_status()}")
+        # every proxy serves traffic
+        for p in serve.fleet_status()["proxies"]:
+            assert _post(p["http_port"], "fleet_multi",
+                         {"x": 7})[0] == {"result": 7}
+        c.remove_node(n2, allow_graceful=True)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if len(serve.fleet_status()["proxies"]) == 1:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"dead node's proxy never deregistered: "
+                        f"{serve.fleet_status()}")
+        serve.shutdown()
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_overload_brownout_10x_slow(serve_session):
+    """Heavy sweep (slow marker, ROADMAP wall-time budget): at 10x
+    offered load the fleet browns out — goodput holds near saturation,
+    sheds answer fast with Retry-After, admitted p99 stays bounded."""
+    import queue as queue_mod
+
+    @serve.deployment(name="fleet_heavy", num_replicas=2,
+                      max_concurrent_queries=8, max_queued_requests=16)
+    def heavy(x=0):
+        time.sleep(0.004)
+        return x
+
+    serve.run(heavy)
+    proxy = serve.start_http(port=0)
+    port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    _post(port, "fleet_heavy")
+
+    def stage(workers, seconds, tokens=None):
+        stop = threading.Event()
+        counts = {"ok": 0, "shed": 0, "err": 0}
+        lat = []
+        lock = threading.Lock()
+
+        def worker():
+            import http.client
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            while not stop.is_set():
+                if tokens is not None:
+                    try:
+                        tokens.get(timeout=0.2)
+                    except queue_mod.Empty:
+                        continue
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/fleet_heavy", body=b"1")
+                    r = conn.getresponse()
+                    r.read()
+                    with lock:
+                        if r.status == 200:
+                            counts["ok"] += 1
+                            lat.append(time.perf_counter() - t0)
+                        elif r.status == 503:
+                            counts["shed"] += 1
+                        else:
+                            counts["err"] += 1
+                except Exception:  # noqa: BLE001
+                    with lock:
+                        counts["err"] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=60)
+            conn.close()
+
+        ws = [threading.Thread(target=worker) for _ in range(workers)]
+        t0 = time.perf_counter()
+        for w in ws:
+            w.start()
+        time.sleep(seconds)
+        stop.set()
+        for w in ws:
+            w.join(timeout=30)
+        dt = time.perf_counter() - t0
+        return counts, lat, dt
+
+    counts, lat, dt = stage(12, 4.0)
+    saturation = counts["ok"] / dt
+    # 10x offered via a fat token bucket + worker pool over the limit
+    tokens = queue_mod.Queue(maxsize=128)
+
+    def pace():
+        period = 1.0 / (saturation * 10)
+        nxt = time.perf_counter()
+        while not done.is_set():
+            now = time.perf_counter()
+            while nxt <= now:
+                try:
+                    tokens.put_nowait(1)
+                except queue_mod.Full:
+                    nxt = now  # overflow: client fleet saturated
+                    break
+                nxt += period
+            time.sleep(0.002)
+
+    done = threading.Event()
+    pt = threading.Thread(target=pace, daemon=True)
+    pt.start()
+    counts10, lat10, dt10 = stage(40, 6.0, tokens)
+    done.set()
+    goodput = counts10["ok"] / dt10
+    assert counts10["shed"] > 0, counts10
+    assert goodput >= 0.5 * saturation, (goodput, saturation, counts10)
+    lat10.sort()
+    p99 = lat10[int(0.99 * (len(lat10) - 1))] if lat10 else 0
+    assert p99 < 5.0, p99  # admitted requests answer, never hang
