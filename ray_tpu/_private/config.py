@@ -114,6 +114,18 @@ class Config:
     # request delta above this sustains a `serve_shed_burn` alert.
     watchdog_serve_shed_rate = _define(
         "watchdog_serve_shed_rate", 0.5, float)
+    # Elastic training plane (train/elastic.py): an in-flight gang
+    # reconfiguration older than this raises `elastic_stuck_reconfig` —
+    # a gang that can neither re-form nor fail looks exactly like
+    # training, minus the progress. Size it past the WORST legitimate
+    # reconfiguration, not the typical one: a learner gang stepping
+    # down from target to min can spend elastic_reform_timeout_s
+    # (default 60s) PER attempted world size, and a large-model
+    # reshard adds its state-transfer time on top — raise this (it is
+    # metrics_configure-tunable at runtime) for wide target-min gaps
+    # rather than treating a slow-but-progressing recovery as stuck.
+    watchdog_elastic_reconfig_s = _define(
+        "watchdog_elastic_reconfig_s", 120.0, float)
     # Debug plane (_private/log_plane.py + log_monitor.py): per-worker
     # in-memory tail index depth, driver-stream flood control (per-source
     # token bucket), and crash-postmortem bundle sizes.

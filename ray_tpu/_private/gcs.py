@@ -179,6 +179,12 @@ class GcsServer:
         # dashboard event module): bounded ring of lifecycle records.
         self.cluster_events: List[Dict[str, Any]] = []
         self.CLUSTER_EVENTS_MAX = 4096
+        # autoscaler v2 lifecycle plane: latest instance table + a
+        # bounded ring of lifecycle transitions (autoscaler/v2.py
+        # reports both each pass)
+        self.autoscaler_instances: List[Dict[str, Any]] = []
+        self.autoscaler_events: List[Dict[str, Any]] = []
+        self.AUTOSCALER_EVENTS_MAX = 1024
         # Actor waits-for graph (blocking gets between actors) with
         # cycle-at-insert deadlock detection; see _private/wait_graph.py.
         from ray_tpu._private.wait_graph import WaitGraph
@@ -278,6 +284,15 @@ class GcsServer:
             # structured events (reference ReportEventService)
             "add_events": self.add_events,
             "list_events": self.list_events,
+            # autoscaler v2 (autoscaler/v2.py): lifecycle-event +
+            # instance-table report, served back to `ray_tpu
+            # autoscaler` / util.state.autoscaler_instances() /
+            # /api/autoscaler; each event also lands in the cluster
+            # event log and on the "autoscaler_lifecycle" pubsub
+            # channel (elastic trainers subscribe for membership
+            # changes)
+            "autoscaler_v2_report": self.autoscaler_v2_report,
+            "autoscaler_v2_state": self.autoscaler_v2_state,
             # actor waits-for graph (deadlock detection)
             "wait_graph_add": self.wait_graph_add,
             "wait_graph_remove": self.wait_graph_remove,
@@ -531,7 +546,22 @@ class GcsServer:
 
     def _schedule_actor(self, actor_id_hex: str) -> None:
         spec = self.actor_specs[actor_id_hex]
-        required = spec.required_resources()
+        # PG-scheduled actors are feasible ONLY on the node holding the
+        # committed bundle: match on the bundle-scoped resource names
+        # (the same rewrite the target node manager checks in
+        # _effective_resources) — raw resources would make every node
+        # "feasible" and pin the retry loop to a node that can never
+        # accept the actor.
+        from ray_tpu._private.node_manager import rewrite_resources_for_pg
+        from ray_tpu._private.state import PlacementGroupSchedulingStrategy
+        if isinstance(spec.scheduling_strategy,
+                      PlacementGroupSchedulingStrategy) and \
+                spec.placement_group_id is not None:
+            required = ResourceSet(rewrite_resources_for_pg(
+                spec.resources, spec.placement_group_id.hex(),
+                spec.placement_group_bundle_index))
+        else:
+            required = spec.required_resources()
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             node_id_hex = self._pick_node_for(required, spec)
@@ -982,6 +1012,37 @@ class GcsServer:
         if severity:
             out = [e for e in out if e.get("severity") == severity]
         return out[-limit:]
+
+    # ---- autoscaler v2 lifecycle plane (autoscaler/v2.py) ---------------
+
+    def autoscaler_v2_report(self, instances: List[Dict[str, Any]],
+                             events: List[Dict[str, Any]]) -> None:
+        """One report per autoscaler pass: replace the instance table,
+        append lifecycle transitions to the bounded ring, mirror each
+        into the cluster event log, and push it on the
+        "autoscaler_lifecycle" pubsub channel so elastic trainers can
+        react to membership changes without polling."""
+        with self._lock:
+            self.autoscaler_instances = list(instances)
+            self.autoscaler_events.extend(events)
+            overflow = (len(self.autoscaler_events)
+                        - self.AUTOSCALER_EVENTS_MAX)
+            if overflow > 0:
+                del self.autoscaler_events[:overflow]
+        for evt in events:
+            self._emit(
+                "AUTOSCALER_INSTANCE",
+                f"instance {evt.get('instance_id', '?')} "
+                f"({evt.get('node_type', '?')}): "
+                f"{evt.get('from', '?')} -> {evt.get('to', '?')}"
+                + (f" ({evt['reason']})" if evt.get("reason") else ""),
+                **{k: v for k, v in evt.items() if k != "ts"})
+            self.publish("autoscaler_lifecycle", evt)
+
+    def autoscaler_v2_state(self, limit: int = 200) -> Dict[str, Any]:
+        with self._lock:
+            return {"instances": list(self.autoscaler_instances),
+                    "events": list(self.autoscaler_events[-limit:])}
 
     # ---- actor waits-for graph (deadlock detection) ---------------------
 

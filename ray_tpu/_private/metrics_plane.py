@@ -356,7 +356,8 @@ class Watchdog:
                  lock_waiters: int = 1,
                  serve_p99_s: float = 2.0,
                  serve_error_rate: float = 0.1,
-                 serve_shed_rate: float = 0.5) -> None:
+                 serve_shed_rate: float = 0.5,
+                 elastic_reconfig_s: float = 120.0) -> None:
         self._emit = emit
         self.cooldown_s = cooldown_s
         self.wait_edge_age_s = wait_edge_age_s
@@ -367,6 +368,7 @@ class Watchdog:
         self.serve_p99_s = serve_p99_s
         self.serve_error_rate = serve_error_rate
         self.serve_shed_rate = serve_shed_rate
+        self.elastic_reconfig_s = elastic_reconfig_s
         # serve SLO probes: last cumulative per-deployment request
         # histogram / per-(deployment, code) request counts (and shed
         # counts, for the shed-burn probe); the probe judges
@@ -898,6 +900,41 @@ class Watchdog:
                     f"admission limits", severity="ERROR",
                     deployment=dep, value=rate)
 
+    def _probe_elastic(self, snaps: List[Dict[str, Any]]) -> None:
+        """elastic_stuck_reconfig: a gang reconfiguration
+        (train/elastic.py ReconfigTracker, riding the harvest as
+        `elastic:*` snapshot extras) has been in flight longer than
+        elastic_reconfig_s. The age is computed in the snapshot from
+        the owner's monotonic clock, so a single observation above the
+        threshold is already a sustained stall — no cross-interval
+        state needed; the cooldown dedupes repeats."""
+        for snap in snaps:
+            for key, extra in snap.items():
+                if not key.startswith("elastic:") or \
+                        not isinstance(extra, dict):
+                    continue
+                if not extra.get("in_progress"):
+                    continue
+                age = float(extra.get("age_s", 0.0))
+                if age <= self.elastic_reconfig_s:
+                    continue
+                gang = extra.get("gang", key)
+                # dedup on the per-INSTANCE extra key, not the gang
+                # name: two same-named gangs in one driver must not
+                # share a cooldown (one stuck gang would mute the
+                # other's alert)
+                self._alert(
+                    "elastic_stuck_reconfig",
+                    f"{snap.get('proc_uid', '')}:{key}",
+                    f"elastic gang {gang!r} on {snap.get('proc', '?')} "
+                    f"(pid {snap.get('pid', '?')}) stuck in "
+                    f"reconfiguration phase "
+                    f"{extra.get('phase', '?')!r} for {age:.0f}s "
+                    f"(> {self.elastic_reconfig_s:.0f}s; reason="
+                    f"{extra.get('reason', '?')})",
+                    severity="ERROR", gang=gang,
+                    phase=extra.get("phase"), age_s=age)
+
     def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
         for node in unreachable:
             self._alert(
@@ -920,6 +957,7 @@ class Watchdog:
                       lambda: self._probe_locks(snaps),
                       lambda: self._probe_serve_slo(snaps),
                       lambda: self._probe_serve_shed(snaps),
+                      lambda: self._probe_elastic(snaps),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:
@@ -960,7 +998,8 @@ class MetricsPlane:
             lock_waiters=Config.watchdog_lock_waiters,
             serve_p99_s=Config.watchdog_serve_p99_s,
             serve_error_rate=Config.watchdog_serve_error_rate,
-            serve_shed_rate=Config.watchdog_serve_shed_rate)
+            serve_shed_rate=Config.watchdog_serve_shed_rate,
+            elastic_reconfig_s=Config.watchdog_elastic_reconfig_s)
         self._harvest_hist = get_or_create(
             Histogram, "ray_tpu_metrics_harvest_seconds",
             description="wall time of one cluster metrics harvest "
@@ -1149,7 +1188,8 @@ class MetricsPlane:
                   lock_waiters: Optional[int] = None,
                   serve_p99_s: Optional[float] = None,
                   serve_error_rate: Optional[float] = None,
-                  serve_shed_rate: Optional[float] = None
+                  serve_shed_rate: Optional[float] = None,
+                  elastic_reconfig_s: Optional[float] = None
                   ) -> Dict[str, Any]:
         """Runtime tuning (ops + tests): adjust the sample interval and
         watchdog thresholds without restarting the GCS."""
@@ -1175,6 +1215,8 @@ class MetricsPlane:
             self.watchdog.serve_error_rate = float(serve_error_rate)
         if serve_shed_rate is not None:
             self.watchdog.serve_shed_rate = float(serve_shed_rate)
+        if elastic_reconfig_s is not None:
+            self.watchdog.elastic_reconfig_s = float(elastic_reconfig_s)
         return {"interval_s": self.interval_s,
                 "cooldown_s": self.watchdog.cooldown_s,
                 "wait_edge_age_s": self.watchdog.wait_edge_age_s,
@@ -1185,7 +1227,9 @@ class MetricsPlane:
                 "lock_waiters": self.watchdog.lock_waiters,
                 "serve_p99_s": self.watchdog.serve_p99_s,
                 "serve_error_rate": self.watchdog.serve_error_rate,
-                "serve_shed_rate": self.watchdog.serve_shed_rate}
+                "serve_shed_rate": self.watchdog.serve_shed_rate,
+                "elastic_reconfig_s":
+                    self.watchdog.elastic_reconfig_s}
 
     def stop(self) -> None:
         self._stopped = True

@@ -17,9 +17,12 @@ from ray_tpu.autoscaler.demand_scheduler import (NodeType,  # noqa: F401
                                                  PlacementGroupDemand,
                                                  get_nodes_to_launch)
 from ray_tpu.autoscaler.v2 import (AutoscalerV2,  # noqa: F401
-                                   ClusterStatusReader, InstanceManager)
+                                   ClusterStatusReader, Instance,
+                                   InstanceLifecycleError,
+                                   InstanceManager)
 
 __all__ = ["NodeProvider", "LocalNodeProvider", "FakeMultiNodeProvider",
            "GKETPUNodeProvider", "StandardAutoscaler", "NodeType",
            "PlacementGroupDemand", "get_nodes_to_launch",
-           "AutoscalerV2", "InstanceManager", "ClusterStatusReader"]
+           "AutoscalerV2", "Instance", "InstanceLifecycleError",
+           "InstanceManager", "ClusterStatusReader"]

@@ -14,6 +14,8 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
     GET /api/profile  — task-attributed cluster flamegraph (sampling
                         profiler fan-out; ?duration=&hz=&format=
                         speedscope|folded|raw&device=1 + id filters)
+    GET /api/autoscaler — autoscaler v2 lifecycle: instance table +
+                       recent transitions (autoscaler/v2.py)
     GET /api/ownership — ownership protocol: RefState/LeaseState rows,
                         held leases, transition-ring tails
                         (?object=<hex prefix>&limit=N)
@@ -298,6 +300,11 @@ class DashboardHead:
             # traced-lock stats + acquisition-order graphs
             return s.locks(timeout=(float(params["timeout"])
                                     if "timeout" in params else None))
+        if route == "/api/autoscaler":
+            # autoscaler v2 lifecycle plane (autoscaler/v2.py):
+            # instance table + recent lifecycle transitions
+            return s.autoscaler_instances(
+                limit=int(params["limit"]) if "limit" in params else 200)
         if route == "/api/ownership":
             # ownership protocol plane (_private/ownership.py):
             # ?object=<hex prefix> explains one object's state +

@@ -11,14 +11,29 @@ replicated params after every step, so there is no unsound weight
 averaging and Adam semantics match single-learner training exactly.
 On TPU pods each learner process contributes its chips and the psum
 rides ICI; in chip-free CI the same code runs over multi-process CPU.
+
+Elastic mode (elastic_min_learners set): the gang survives member
+death and explicit resizes. The driver keeps a host-side state cache
+(params/opt state, refreshed every `state_refresh_every` successful
+updates, default 1 — the gang's durable checkpoint); when an update
+loses an actor or
+reconfigure() is called, the gang is drained, re-spawned at the new
+world size (bounded by elastic_reform_timeout_s, stepping down toward
+elastic_min_learners when capacity is short), the cached state is
+re-replicated over the new mesh (reshard: each rank re-slices its data
+shard by the new world), and the update is retried — with the same
+elastic.* span sequence + reconfiguration metrics as the train plane
+(train/elastic.py).
 """
 
 from __future__ import annotations
 
-import uuid
+import logging
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 class _MeshLearnerActor:
@@ -83,55 +98,174 @@ class _MeshLearnerActor:
         self.learner.set_state(s)
 
 
-def _free_port() -> int:
-    from ray_tpu._private.rpc import find_free_port
-    return find_free_port()
+from ray_tpu.train.elastic import free_port as _free_port
 
 
 class LearnerGroup:
     def __init__(self, learner_factory: Callable[[], Any],
-                 num_learners: int = 0, seed: int = 0):
-        self._num_learners = num_learners
+                 num_learners: int = 0, seed: int = 0, *,
+                 elastic_min_learners: Optional[int] = None,
+                 elastic_reform_timeout_s: float = 60.0,
+                 state_refresh_every: int = 1):
+        self._num_learners = num_learners   # achieved world size
+        self._target_learners = num_learners  # what re-forms aim for
+        self._factory = learner_factory
+        self._seed = seed
+        self._elastic_min = elastic_min_learners
+        self._reform_timeout_s = elastic_reform_timeout_s
+        # How many updates between durable-cache refreshes. The cache
+        # fetch pulls the FULL params+opt state from rank 0 to the
+        # driver, so for large models every-update (the default, exact
+        # continuity) can dominate step time; N>1 trades that cost for
+        # losing up to N-1 updates when a reconfiguration falls back to
+        # an older cache (the caller retries only the failed update).
+        if state_refresh_every < 1:
+            raise ValueError("state_refresh_every must be >= 1")
+        self._state_refresh_every = state_refresh_every
+        self._updates_since_refresh = 0
+        self._ckpt_state: Optional[Dict[str, Any]] = None
+        self._tracker = None
+        if elastic_min_learners is not None:
+            if num_learners == 0:
+                raise ValueError(
+                    "elastic_min_learners requires a remote gang "
+                    "(num_learners >= 1)")
+            if not (1 <= elastic_min_learners <= num_learners):
+                raise ValueError(
+                    f"elastic_min_learners={elastic_min_learners} not in "
+                    f"[1, num_learners={num_learners}]")
+            from ray_tpu.train.elastic import ReconfigTracker
+            self._tracker = ReconfigTracker("learner")
         if num_learners == 0:
             self._local = learner_factory()
             self._local.build(seed=seed)
             self._actors: List[Any] = []
             return
-        import ray_tpu
-
         self._local = None
-        # Fresh worker processes for the gang: the unique runtime-env key
-        # gives them their own worker-pool bucket, so jax.distributed
-        # initializes before any other jax use in those processes.
-        # One host (CPU) device per gang process: the virtual-device test
-        # flag (--xla_force_host_platform_device_count=8) would otherwise
-        # leak in and force per-process shard sizes to be divisible by 8.
-        # Preserve any other XLA_FLAGS the operator set (TPU tuning flags
-        # etc.) — only the host-device-count flag is replaced.
-        import os
-        import re
-        flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "",
-                       os.environ.get("XLA_FLAGS", "")).strip()
-        gang_env = {"env_vars": {
-            "RAY_TPU_LEARNER_GANG": uuid.uuid4().hex,
-            "XLA_FLAGS": (flags + " "
-                          "--xla_force_host_platform_device_count=1"
-                          ).strip(),
-        }}
+        self._actors = self._spawn_gang(num_learners)
+        if self._tracker is not None:
+            # the gang's durable fallback until the first update lands
+            self._ckpt_state = self.get_state()
+
+    @property
+    def elastic(self) -> bool:
+        return self._tracker is not None
+
+    def _spawn_gang(self, world: int) -> List[Any]:
+        """Spawn + rendezvous one gang generation of `world` fresh
+        processes. Each formation gets its OWN runtime-env pool key
+        (train.elastic.gang_runtime_env): jax.distributed must
+        initialize before any other jax use, so a re-form can never
+        reuse a previous generation's processes."""
+        import ray_tpu
+        from ray_tpu.train.elastic import gang_runtime_env
+        gang_env = gang_runtime_env("RAY_TPU_LEARNER_GANG")
         coordinator = f"127.0.0.1:{_free_port()}"
         actor_cls = ray_tpu.remote(_MeshLearnerActor)
-        self._actors = [
+        actors = [
             actor_cls.options(num_cpus=1, runtime_env=gang_env).remote(
-                learner_factory, coordinator, num_learners, rank, seed)
-            for rank in range(num_learners)
+                self._factory, coordinator, world, rank, self._seed)
+            for rank in range(world)
         ]
         # Barrier on gang readiness (rank 0 hosts the coordinator; all
         # ranks block in jax.distributed.initialize until every peer is
-        # up — mirror of the reference's process-group rendezvous).
-        ray_tpu.get([a.ping.remote() for a in self._actors], timeout=300)
+        # up — mirror of the reference's process-group rendezvous). On
+        # failure the attempt's actors must die HERE: the caller's
+        # _kill_gang only sees self._actors, and a leaked attempt would
+        # sit blocked in jax.distributed holding its CPUs — making every
+        # smaller world size infeasible too.
+        try:
+            ray_tpu.get([a.ping.remote() for a in actors],
+                        timeout=self._reform_timeout_s
+                        if self.elastic else 300)
+        except BaseException:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001 - actor already dead
+                    pass
+            raise
+        return actors
 
     def __len__(self) -> int:
         return max(1, self._num_learners)
+
+    # ---- elastic reconfiguration ------------------------------------
+    def reconfigure(self, num_learners: Optional[int] = None,
+                    reason: str = "manual") -> int:
+        """Re-form the gang at `num_learners` (default: the target
+        world size) from the cached state; returns the achieved world
+        size. An explicit `num_learners` also becomes the new target.
+        Elastic gangs only."""
+        if not self.elastic:
+            raise RuntimeError("reconfigure() requires elastic mode "
+                               "(elastic_min_learners)")
+        if num_learners is not None:
+            # validate BEFORE persisting: a rejected target must not
+            # poison later worker_death recoveries
+            if num_learners < self._elastic_min:
+                raise ValueError(
+                    f"target {num_learners} below elastic_min_learners="
+                    f"{self._elastic_min}")
+            self._target_learners = num_learners
+        return self._elastic_reconfigure(
+            reason, target=num_learners or self._target_learners)
+
+    def _elastic_reconfigure(self, reason: str, target: int) -> int:
+        import ray_tpu
+        if not (self._elastic_min <= target):
+            raise ValueError(
+                f"target {target} below elastic_min_learners="
+                f"{self._elastic_min}")
+        rec = self._tracker.start(reason,
+                                  world_size=len(self._actors))
+        try:
+            with rec.phase("drain"):
+                self._kill_gang()
+            with rec.phase("checkpoint") as attrs:
+                attrs["cached"] = self._ckpt_state is not None
+            achieved: Optional[int] = None
+            with rec.phase("reform"):
+                # step down toward the min when capacity is short; each
+                # attempt is bounded by elastic_reform_timeout_s
+                last_err: Optional[BaseException] = None
+                for world in range(target, self._elastic_min - 1, -1):
+                    try:
+                        self._actors = self._spawn_gang(world)
+                        achieved = world
+                        break
+                    except Exception as e:  # noqa: BLE001 - rendezvous
+                        last_err = e        # timeout / spawn failure
+                        self._kill_gang()
+                if achieved is None:
+                    raise RuntimeError(
+                        f"elastic learner re-form infeasible: no world "
+                        f"size in [{self._elastic_min}, {target}] "
+                        f"became ready within "
+                        f"{self._reform_timeout_s:.0f}s per attempt "
+                        f"({last_err!r})")
+            self._num_learners = achieved
+            with rec.phase("reshard", world_size=achieved):
+                if self._ckpt_state is not None:
+                    ray_tpu.get(
+                        [a.set_state.remote(self._ckpt_state)
+                         for a in self._actors], timeout=600)
+            with rec.phase("resume"):
+                pass  # the caller's retried update is the resume
+            rec.finish(achieved)
+            return achieved
+        except BaseException as e:
+            rec.abort(e)
+            raise
+
+    def _kill_gang(self) -> None:
+        import ray_tpu
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001 - actor already dead
+                pass
+        self._actors = []
 
     # ---- updates ----------------------------------------------------
     def update(self, batch: Dict[str, np.ndarray],
@@ -140,6 +274,30 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.update(batch, minibatch_size, num_iters,
                                       seed)
+        try:
+            return self._update_remote(batch, minibatch_size, num_iters,
+                                       seed)
+        except Exception as e:  # noqa: BLE001 - actor death mid-update
+            from ray_tpu.exceptions import RayTaskError
+            if not self.elastic or isinstance(e, RayTaskError):
+                # a RayTaskError means the update RAN and raised — a
+                # deterministic application error that a gang re-form
+                # would only replay (and miscount as a worker_death
+                # reconfiguration); only infrastructure failures
+                # (actor death, lost worker, timeout) reconfigure
+                raise
+            logger.warning(
+                "elastic learner gang update failed (%r); "
+                "reconfiguring and retrying", e)
+            # aim back at the TARGET, not the achieved size: a gang
+            # that degraded to 3/4 must try for 4 again when capacity
+            # returns, not ratchet down toward the minimum
+            self._elastic_reconfigure("worker_death",
+                                      target=self._target_learners)
+            return self._update_remote(batch, minibatch_size, num_iters,
+                                       seed)
+
+    def _update_remote(self, batch, minibatch_size, num_iters, seed):
         import ray_tpu
         # Same full batch + same seed to every rank: each slices its own
         # equal shard and all ranks enter the jitted collective step the
@@ -157,6 +315,19 @@ class LearnerGroup:
                 out[k] = np.concatenate([np.asarray(s[k]) for s in stats])
             else:
                 out[k] = float(np.mean([s[k] for s in stats]))
+        if self.elastic:
+            # refresh the durable fallback: the state every rank holds
+            # after this (replicated) step — what a reconfiguration
+            # reshards from (paced by state_refresh_every for large
+            # models; a failed fetch just leaves the older cache)
+            self._updates_since_refresh += 1
+            if self._updates_since_refresh >= self._state_refresh_every:
+                try:
+                    self._ckpt_state = ray_tpu.get(
+                        self._actors[0].get_state.remote(), timeout=600)
+                    self._updates_since_refresh = 0
+                except Exception:  # noqa: BLE001 - the NEXT update's
+                    pass           # failure path uses the older cache
         return out
 
     def additional_update(self, **kwargs) -> Dict[str, Any]:
@@ -197,12 +368,10 @@ class LearnerGroup:
         import ray_tpu
         ray_tpu.get([a.set_state.remote(state) for a in self._actors],
                     timeout=600)
+        if self.elastic:
+            self._ckpt_state = state
 
     def shutdown(self) -> None:
-        import ray_tpu
-        for a in self._actors:
-            try:
-                ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001 - actor already dead
-                pass
-        self._actors = []
+        self._kill_gang()
+        if self._tracker is not None:
+            self._tracker.close()

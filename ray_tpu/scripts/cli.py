@@ -315,6 +315,41 @@ def cmd_locks(args) -> int:
     return 0
 
 
+def cmd_autoscaler(args) -> int:
+    """Autoscaler v2 lifecycle plane (see README "Elastic training"):
+    the instance table (QUEUED -> REQUESTED -> ALLOCATED ->
+    RAY_RUNNING -> TERMINATING -> TERMINATED) and recent lifecycle
+    transitions the autoscaler reported to the GCS."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    out = s.autoscaler_instances(limit=args.limit)
+    if args.format == "json":
+        print(json.dumps(out, default=str))
+        return 0
+    instances = out.get("instances") or []
+    if instances:
+        _print_table(
+            [{"instance": i["instance_id"], "type": i["node_type"],
+              "status": i["status"],
+              "node": (i.get("node_id_hex") or "-")[:12],
+              "retries": i.get("retries", 0),
+              "in_state_s": f"{i.get('age_in_state_s', 0):.0f}"}
+             for i in instances],
+            ["instance", "type", "status", "node", "retries",
+             "in_state_s"])
+    else:
+        print("no autoscaler v2 instances reported")
+    events = out.get("events") or []
+    if events:
+        print(f"\nrecent lifecycle transitions ({len(events)}):")
+        for e in events[-args.limit:]:
+            reason = f"  ({e['reason']})" if e.get("reason") else ""
+            print(f"  {e.get('instance_id', '?')} "
+                  f"[{e.get('node_type', '?')}] "
+                  f"{e.get('from', '?')} -> {e.get('to', '?')}{reason}")
+    return 0
+
+
 def cmd_ownership(args) -> int:
     """Ownership protocol plane (see README "Ownership protocol"):
     per-process RefState rows (what holds each object alive), lease
@@ -823,6 +858,16 @@ def main(argv=None) -> int:
                    help="jax profiler traces on device-hosting workers "
                         "(reports xplane dirs) instead of CPU sampling")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("autoscaler", help="autoscaler v2 lifecycle: "
+                                          "instance table + recent "
+                                          "transitions (see README "
+                                          "\"Elastic training\")")
+    p.add_argument("--address", default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--limit", type=int, default=50,
+                   help="max lifecycle transitions to print")
+    p.set_defaults(fn=cmd_autoscaler)
 
     p = sub.add_parser("ownership", help="ownership protocol: RefState/"
                                          "LeaseState per process, held "

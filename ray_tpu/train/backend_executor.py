@@ -6,11 +6,28 @@ CUDA/neuron visibility sharing → here TPU chip visibility), rank mappings
 (:358), start_training (:438), get_next_results (:552),
 get_with_failure_handling (:640) and restart-on-failure (:701,712) bounded
 by FailureConfig.max_failures (air/config.py:377).
+
+Elastic mode (ScalingConfig.elastic_min_workers set): instead of the
+fixed-size restart, worker death / node drain triggers a RECONFIGURATION
+(TorchElastic re-rendezvous semantics): drain the old gang, fall back to
+the latest durable checkpoint, re-form at whatever world size in
+[elastic_min_workers, target] is schedulable within
+elastic_reform_timeout_s, re-init the backend's process group
+(jax.distributed) over the new mesh, re-split dataset shards, and resume
+— each phase recorded as an `elastic.*` span with
+ray_tpu_elastic_reconfigurations_total/_reconfig_seconds metrics and an
+`elastic_stuck_reconfig` watchdog probe (train/elastic.py). Below-target
+gangs keep their unscheduled bundles as replacement probes: the pending
+placement-group demand is what autoscaler v2 feeds its scheduler, and
+the probe turning ready (a replacement node joined) triggers the
+scale-up reconfiguration back toward the target world size.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional
 
@@ -40,16 +57,72 @@ class BackendExecutor:
         # stashed so restarts can re-enter training transparently
         self._train_args: Optional[Dict[str, Any]] = None
         self._latest_checkpoint_dir: Optional[str] = None
+        self._elastic = scaling_config.elastic
+        self._tracker = None
+        self._watch = None
+        self._next_grow_poll = 0.0
+        if self._elastic:
+            from ray_tpu.train.elastic import (MembershipWatch,
+                                               ReconfigTracker)
+            self._tracker = ReconfigTracker("train")
+            self._watch = MembershipWatch()
+            self._watch.subscribe()
+
+    # how long a RECONFIGURING gang waits for straggler bundles once
+    # the minimum is met (TorchElastic proceed-with-survivors: recover
+    # fast at the feasible size, grow when the replacement schedules);
+    # the initial formation instead waits toward the full target
+    RECONFIG_SETTLE_S = 2.0
+
+    # fallback cadence for probing replacement capacity while degraded
+    # when no pubsub capacity event arrived (pubsub can be unavailable
+    # — MembershipWatch.subscribe is best-effort)
+    GROW_POLL_PERIOD_S = 5.0
 
     # ---- lifecycle --------------------------------------------------
     def start(self) -> None:
-        self.worker_group = WorkerGroup(
-            self._scaling.num_workers,
-            self._scaling._resources_per_worker_not_none,
-            self._scaling.placement_strategy)
+        self._form_group()
+        self._mesh_init()
+
+    def _form_group(self, settle_s: Optional[float] = None) -> None:
+        """Create the worker gang + rank contexts (+ TPU visibility).
+        Elastic gangs form at any size in [elastic_min_workers, target]
+        bounded by elastic_reform_timeout_s; infeasible demand raises
+        TrainingWorkerError naming what could not schedule."""
+        target = self._scaling.elastic_target_workers if self._elastic \
+            else self._scaling.num_workers
+        kwargs: Dict[str, Any] = {}
+        if self._elastic:
+            kwargs["min_workers"] = self._scaling.elastic_min_workers
+            kwargs["reform_timeout_s"] = \
+                self._scaling.elastic_reform_timeout_s
+            kwargs["reform_settle_s"] = settle_s
+        gang_env = self._backend.gang_env(self._backend_config,
+                                          num_workers=target)
+        if gang_env:
+            kwargs["runtime_env"] = gang_env
+        try:
+            self.worker_group = WorkerGroup(
+                target,
+                self._scaling._resources_per_worker_not_none,
+                self._scaling.placement_strategy, **kwargs)
+        except TimeoutError as e:
+            raise TrainingWorkerError(
+                f"gang formation infeasible: {e}") from e
+        if self._elastic and len(self.worker_group) < target:
+            logger.warning(
+                "elastic gang formed below target: %d/%d workers "
+                "(min=%d); unscheduled bundles kept as replacement "
+                "probes", len(self.worker_group), target,
+                self._scaling.elastic_min_workers)
         self._contexts = self._build_contexts(self.worker_group)
         if self._scaling.num_tpus_per_worker:
             self._share_tpu_visibility(self.worker_group)
+        if self._watch is not None:
+            self._watch.watch_nodes(list(self.worker_group.node_ids))
+
+    def _mesh_init(self) -> None:
+        """Backend process-group setup (jax.distributed over the gang)."""
         self._backend.on_start(self.worker_group, self._backend_config)
 
     def _build_contexts(self, wg: WorkerGroup) -> List[TrainContext]:
@@ -113,11 +186,23 @@ class BackendExecutor:
             "datasets": datasets,
         }
         self._latest_checkpoint_dir = checkpoint_dir
+        self._init_sessions(checkpoint_dir)
+        self._start_sessions()
+
+    def _init_sessions(self, checkpoint_dir: Optional[str]) -> None:
+        """Session setup on every rank: backend training hook, dataset
+        shard split at the CURRENT world size, per-rank session init
+        with the resume checkpoint (this is where an elastic re-form
+        reshards: shards re-split over the new world, and every rank's
+        loop reloads/reshards model+optimizer state from the durable
+        checkpoint it is handed)."""
+        assert self._train_args is not None
         self._backend.on_training_start(self.worker_group,
                                         self._backend_config)
         import ray_tpu
         # Disjoint per-rank dataset shards (reference backend_executor +
         # session.py:1017 get_dataset_shard contract).
+        datasets = self._train_args.get("datasets")
         shards_per_rank: Optional[List[Dict[str, Any]]] = None
         if datasets:
             world = len(self.worker_group)
@@ -130,12 +215,16 @@ class BackendExecutor:
         refs = []
         for rank, w in enumerate(self.worker_group.workers):
             ctx = self._contexts[rank]
-            ctx.experiment_name = experiment_name
-            ctx.trial_dir = trial_dir
+            ctx.experiment_name = self._train_args["experiment_name"]
+            ctx.trial_dir = self._train_args["trial_dir"]
             refs.append(w.init_session.remote(
-                train_loop, config, ctx, checkpoint_dir,
+                self._train_args["train_loop"],
+                self._train_args["config"], ctx, checkpoint_dir,
                 shards_per_rank[rank] if shards_per_rank else None))
         ray_tpu.get(refs, timeout=120)
+
+    def _start_sessions(self) -> None:
+        import ray_tpu
         ray_tpu.get([w.start_training_session.remote()
                      for w in self.worker_group.workers], timeout=120)
 
@@ -144,12 +233,23 @@ class BackendExecutor:
         """One result per worker, or None when all loops finished.
 
         Worker failures raise TrainingWorkerError after restart budget is
-        exhausted; otherwise the group is restarted from the latest
+        exhausted; otherwise the group is restarted (elastic:
+        reconfigured at the feasible world size) from the latest
         checkpoint and training resumes (reference
         backend_executor.py:552,640-712)."""
         import ray_tpu
         assert self.worker_group is not None
         while True:
+            if self._elastic:
+                lost = self._lost_gang_nodes()
+                if lost:
+                    logger.warning(
+                        "elastic: gang node(s) %s declared dead; "
+                        "reconfiguring", [n[:12] for n in lost])
+                    self._handle_failure(TrainingWorkerError(
+                        f"gang node(s) {[n[:12] for n in lost]} died"))
+                    continue
+                self._maybe_grow()
             try:
                 # the get IS batched; the loop is the restart-retry path
                 results = ray_tpu.get(  # graftlint: disable=RT002
@@ -179,21 +279,117 @@ class BackendExecutor:
                     "number of times")
             return [r for r in results if r is not None]
 
+    # ---- elastic reconfiguration ------------------------------------
+    def _lost_gang_nodes(self) -> List[str]:
+        """Nodes hosting gang members that the GCS declared dead (via
+        the MembershipWatch "node" subscription). A slice preemption
+        takes the host down with the workers — the gang must not wait
+        for a worker RPC to fail (the driver<->worker channel can
+        outlive the node's management plane)."""
+        if self._watch is None or self.worker_group is None:
+            return []
+        lost = self._watch.take_lost_nodes()
+        if not lost:
+            return []
+        gang_nodes = set(self.worker_group.node_ids)
+        return [n for n in lost if n in gang_nodes]
+
+    def _maybe_grow(self) -> None:
+        """Scale-up trigger, checked at step boundaries: a replacement
+        probe became schedulable (a node joined — autoscaler v2 supply
+        or manual), so re-form toward the target world size. The
+        capacity pubsub event triggers the probe poll immediately;
+        otherwise poll at GROW_POLL_PERIOD_S — probe_ready() costs one
+        GCS RPC per pending probe, too much for every step boundary of
+        a long degraded run."""
+        wg = self.worker_group
+        if wg is None or wg.missing_workers() == 0:
+            return
+        event = self._watch.take_capacity_event() \
+            if self._watch is not None else False
+        now = time.monotonic()
+        if not event and now < self._next_grow_poll:
+            return
+        self._next_grow_poll = now + self.GROW_POLL_PERIOD_S
+        if wg.probe_ready():
+            logger.info(
+                "elastic: replacement capacity arrived; growing gang "
+                "%d -> %d workers", len(wg), wg.target_workers)
+            try:
+                self._reconfigure("scale_up")
+            except TrainingWorkerError:
+                raise  # infeasible re-form: a clear terminal verdict
+            except Exception as e:  # noqa: BLE001 - a kill can land
+                # mid-grow (the gang is already drained at that point):
+                # spend the restart budget like any other failure
+                # instead of escaping fit() as a raw crash
+                self._handle_failure(e)
+
     def _handle_failure(self, error: BaseException) -> None:
-        self._num_failures += 1
-        if self._max_failures >= 0 and self._num_failures > self._max_failures:
-            raise TrainingWorkerError(
-                f"training failed after {self._num_failures - 1} "
-                f"restart(s): {error!r}") from error
-        logger.warning(
-            "train worker failure %d/%s (%r); restarting group from "
-            "latest checkpoint", self._num_failures,
-            self._max_failures if self._max_failures >= 0 else "inf", error)
-        self._restart()
+        # a kill can land DURING the recovery itself (chaos loves the
+        # re-form window): recovery failures spend the same restart
+        # budget instead of aborting the run on the first unlucky race
+        while True:
+            self._num_failures += 1
+            if self._max_failures >= 0 and \
+                    self._num_failures > self._max_failures:
+                raise TrainingWorkerError(
+                    f"training failed after {self._num_failures - 1} "
+                    f"restart(s): {error!r}") from error
+            logger.warning(
+                "train worker failure %d/%s (%r); %s from latest "
+                "checkpoint", self._num_failures,
+                self._max_failures if self._max_failures >= 0 else "inf",
+                error,
+                "reconfiguring gang" if self._elastic
+                else "restarting group")
+            try:
+                if self._elastic:
+                    self._reconfigure("worker_death")
+                else:
+                    self._restart()
+                return
+            except TrainingWorkerError:
+                raise  # infeasible re-form: a clear terminal verdict
+            except Exception as e:  # noqa: BLE001 - recovery raced a
+                error = e           # new death; retry on budget
+
+    def _reconfigure(self, reason: str) -> None:
+        """One elastic reconfiguration: drain -> checkpoint -> reform ->
+        reshard -> resume, span-recorded and metered (train/elastic.py).
+        Raises TrainingWorkerError when the re-form is infeasible below
+        elastic_min_workers within the deadline."""
+        assert self._train_args is not None, "no training to reconfigure"
+        rec = self._tracker.start(
+            reason, world_size=len(self.worker_group)
+            if self.worker_group is not None else 0)
+        try:
+            with rec.phase("drain"):
+                self._teardown_group()
+            with rec.phase("checkpoint") as attrs:
+                ckpt = self._latest_checkpoint_dir
+                if ckpt is not None and not os.path.isdir(ckpt):
+                    logger.warning(
+                        "elastic: latest checkpoint %s is gone; "
+                        "resuming from scratch", ckpt)
+                    ckpt = None
+                attrs["checkpoint_dir"] = ckpt or ""
+            with rec.phase("reform"):
+                self._form_group(settle_s=self.RECONFIG_SETTLE_S)
+            with rec.phase("reshard",
+                           world_size=len(self.worker_group)):
+                self._mesh_init()
+                self._init_sessions(ckpt)
+            with rec.phase("resume"):
+                self._start_sessions()
+            rec.finish(len(self.worker_group))
+        except BaseException as e:
+            rec.abort(e)
+            raise
 
     def _restart(self) -> None:
         assert self._train_args is not None, "no training to restart"
-        self.shutdown()
+        self._teardown_group()
         self.start()
         self.start_training(
             self._train_args["train_loop"], self._train_args["config"],
@@ -207,7 +403,7 @@ class BackendExecutor:
         lives so restarts resume from it."""
         self._latest_checkpoint_dir = checkpoint_dir
 
-    def shutdown(self) -> None:
+    def _teardown_group(self) -> None:
         if self.worker_group is not None:
             try:
                 self._backend.on_shutdown(self.worker_group,
@@ -216,3 +412,10 @@ class BackendExecutor:
                 pass
             self.worker_group.shutdown()
             self.worker_group = None
+
+    def shutdown(self) -> None:
+        self._teardown_group()
+        if self._watch is not None:
+            self._watch.unsubscribe()
+        if self._tracker is not None:
+            self._tracker.close()

@@ -23,6 +23,47 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     trainer_resources: Optional[Dict[str, float]] = None
+    # Elastic membership (TorchElastic/Elastic Horovod semantics):
+    # setting elastic_min_workers turns the gang elastic — on worker
+    # death / node drain the run checkpoints and re-forms at any world
+    # size in [elastic_min_workers, elastic_max_workers or
+    # num_workers], resharding state over the new mesh, and grows back
+    # toward the max when replacement capacity arrives (autoscaler v2
+    # lifecycle events / a schedulable replacement probe). None keeps
+    # the classic fixed-size gang.
+    elastic_min_workers: Optional[int] = None
+    elastic_max_workers: Optional[int] = None
+    # How long a re-form may wait for bundles to schedule before either
+    # proceeding at a smaller feasible world size (>= min) or raising
+    # TrainingWorkerError naming the infeasible demand.
+    elastic_reform_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        if self.elastic_max_workers is not None and \
+                self.elastic_min_workers is None:
+            raise ValueError(
+                "elastic_max_workers requires elastic_min_workers")
+        if self.elastic_min_workers is not None:
+            if self.elastic_min_workers < 1:
+                raise ValueError("elastic_min_workers must be >= 1")
+            if self.elastic_min_workers > self.num_workers:
+                raise ValueError(
+                    f"elastic_min_workers={self.elastic_min_workers} > "
+                    f"num_workers={self.num_workers}")
+            if self.elastic_max_workers is not None and \
+                    self.elastic_max_workers < self.num_workers:
+                raise ValueError(
+                    f"elastic_max_workers={self.elastic_max_workers} < "
+                    f"num_workers={self.num_workers}")
+
+    @property
+    def elastic(self) -> bool:
+        return self.elastic_min_workers is not None
+
+    @property
+    def elastic_target_workers(self) -> int:
+        """The world size an elastic gang grows toward."""
+        return self.elastic_max_workers or self.num_workers
 
     @property
     def _resources_per_worker_not_none(self) -> Dict[str, float]:

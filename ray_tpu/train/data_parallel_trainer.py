@@ -133,11 +133,12 @@ class DataParallelTrainer:
     @classmethod
     def restore(cls, path: str, **kwargs) -> "DataParallelTrainer":
         """Resume from the newest checkpoint under a prior run dir
-        (reference base_trainer.py Trainer.restore)."""
-        ckpts = sorted(
-            d for d in os.listdir(path) if d.startswith("checkpoint_"))
-        if not ckpts:
+        (reference base_trainer.py Trainer.restore). Resolution goes
+        through the atomic LATEST pointer (checkpoint_manager.py) so an
+        interrupted save can never be picked as the resume target."""
+        from ray_tpu.train.checkpoint_manager import latest_checkpoint_path
+        latest = latest_checkpoint_path(path)
+        if latest is None:
             raise ValueError(f"no checkpoints under {path}")
-        kwargs.setdefault("resume_from_checkpoint",
-                          Checkpoint(os.path.join(path, ckpts[-1])))
+        kwargs.setdefault("resume_from_checkpoint", Checkpoint(latest))
         return cls(**kwargs)

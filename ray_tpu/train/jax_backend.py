@@ -26,7 +26,13 @@ logger = logging.getLogger(__name__)
 class JaxConfig(BackendConfig):
     """distributed=None (default): initialize jax.distributed only when
     the gang spans more than one process AND TPU chips are attached —
-    single-worker and chip-free CI runs skip the coordinator entirely."""
+    single-worker and chip-free CI runs skip the coordinator entirely.
+
+    coordinator_port=0 picks a fresh free port on worker 0's node for
+    EVERY gang formation. Elastic gangs always do this — the
+    coordinator is re-hosted each re-form while the previous
+    formation's port may still sit in TIME_WAIT, so a fixed value is
+    ignored there (with a warning)."""
 
     distributed: Optional[bool] = None
     coordinator_port: int = 8476
@@ -50,14 +56,47 @@ def _get_node_ip() -> str:
 
 def _init_jax_distributed(coordinator_address: str, num_processes: int,
                           process_id: int) -> None:
+    import os
+
     import jax
+    # Honor an explicit platform pin (the chip-free test ladder sets
+    # JAX_PLATFORMS=cpu): device plugins can re-assert themselves over
+    # the env var, so pin through jax.config like tests/conftest.py.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
 
 
+from ray_tpu.train.elastic import free_port as _free_port
+
+
 class _JaxBackend(Backend):
+    def gang_env(self, backend_config: JaxConfig,
+                 num_workers: int = 1) -> Optional[dict]:
+        """Fresh worker processes per gang formation when jax.distributed
+        is requested: initialize() must run before any other jax use in
+        the process, which reused pool workers cannot guarantee — and an
+        elastic re-form (new world size, new coordinator) needs a clean
+        runtime in every member. The unique key gives each formation its
+        own worker-pool bucket; one host CPU device per process keeps
+        chip-free meshes 1 device/rank (the virtual-device test flag
+        would otherwise leak in).
+
+        distributed=None (auto) must be treated as POSSIBLY distributed
+        for any multi-worker gang: on_start only resolves the TPU probe
+        after the workers exist, and a re-form that reuses pool workers
+        because gang_env guessed "not distributed" would re-run
+        jax.distributed.initialize in a process that already used jax."""
+        if backend_config.distributed is False or \
+                (backend_config.distributed is None and num_workers <= 1):
+            return None
+        from ray_tpu.train.elastic import gang_runtime_env
+        return gang_runtime_env("RAY_TPU_TRAIN_GANG")
+
     def on_start(self, worker_group: WorkerGroup,
                  backend_config: JaxConfig) -> None:
         distributed = backend_config.distributed
@@ -72,7 +111,21 @@ class _JaxBackend(Backend):
         # Rank 0's node hosts the coordinator (reference
         # torch/config.py:106-112 picks MASTER_ADDR from worker 0).
         ip = worker_group.execute_single(0, _get_node_ip)
-        coordinator = f"{ip}:{backend_config.coordinator_port}"
+        port = backend_config.coordinator_port
+        if port and getattr(worker_group, "elastic", False):
+            # a re-form re-hosts the coordinator while the previous
+            # formation's socket may still sit in TIME_WAIT — a fixed
+            # port would fail the reconfiguration with EADDRINUSE and
+            # spend FailureConfig budget on a port collision. Only an
+            # explicitly pinned (non-default) port is worth a warning.
+            if port != JaxConfig.coordinator_port:
+                logger.warning(
+                    "JaxConfig.coordinator_port=%d ignored for the "
+                    "elastic gang: each formation picks a fresh free "
+                    "port", port)
+            port = 0
+        port = port or worker_group.execute_single(0, _free_port)
+        coordinator = f"{ip}:{port}"
         import ray_tpu
         ray_tpu.get([
             w.apply.remote(_init_jax_distributed, coordinator,

@@ -519,6 +519,17 @@ def ownership(object_id: Optional[str] = None, limit: int = 200,
                        limit=limit, timeout=timeout)
 
 
+def autoscaler_instances(limit: int = 200) -> Dict[str, Any]:
+    """Autoscaler v2 lifecycle view (`ray_tpu autoscaler`, dashboard
+    /api/autoscaler; autoscaler/v2.py): the latest instance table
+    (instance id, node type, lifecycle status QUEUED/REQUESTED/
+    ALLOCATED/RAY_RUNNING/TERMINATING/TERMINATED, retries, age in
+    state) plus the most recent `limit` lifecycle transitions the
+    autoscaler reported. Live subscribers use the
+    "autoscaler_lifecycle" pubsub channel instead of polling this."""
+    return _gcs().call("autoscaler_v2_state", limit=limit)
+
+
 def locks(timeout: Optional[float] = None) -> Dict[str, Any]:
     """Cluster lockdep snapshot (`ray_tpu locks`, dashboard
     /api/locks): every process's traced locks (hold counts/times,

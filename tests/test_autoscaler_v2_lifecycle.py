@@ -1,0 +1,384 @@
+"""Autoscaler v2 lifecycle state machine (ISSUE 14 satellite).
+
+Per-transition units over the QUEUED -> REQUESTED -> ALLOCATED ->
+RAY_RUNNING -> TERMINATING -> TERMINATED machine (legal/illegal edges,
+stuck-state timeouts, provider-error retry budget, lifecycle-event
+fan-out) plus a fake-provider scale-up/scale-down integration pass and
+the GCS-side report/state surface (reference
+python/ray/autoscaler/v2/instance_manager tests).
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import FakeMultiNodeProvider, NodeType
+from ray_tpu.autoscaler.autoscaler import ProviderNode
+from ray_tpu.autoscaler.v2 import (ALLOCATED, LEGAL_TRANSITIONS, QUEUED,
+                                   RAY_RUNNING, REQUESTED, TERMINATED,
+                                   TERMINATING, AutoscalerV2,
+                                   ClusterStatus, Instance,
+                                   InstanceLifecycleError,
+                                   InstanceManager)
+
+
+class _FlakyProvider(FakeMultiNodeProvider):
+    """Fails the first `fail_n` create_node calls, then succeeds."""
+
+    def __init__(self, fail_n: int):
+        super().__init__()
+        self.fail_n = fail_n
+        self.attempts = 0
+
+    def create_node(self, resources):
+        self.attempts += 1
+        if self.attempts <= self.fail_n:
+            raise RuntimeError(f"cloud says no (attempt {self.attempts})")
+        return super().create_node(resources)
+
+
+class _FakeReader:
+    def __init__(self):
+        self.status = ClusterStatus()
+
+    def read(self):
+        return self.status
+
+
+CPU2 = NodeType("cpu2", {"CPU": 2})
+
+
+class TestTransitions:
+    def test_happy_path_walk(self):
+        inst = Instance(instance_id="i1", node_type="cpu2")
+        for status in (REQUESTED, ALLOCATED, RAY_RUNNING, TERMINATING,
+                       TERMINATED):
+            inst.set_status(status, reason="walk")
+        assert inst.status == TERMINATED
+        assert inst.status_history == [QUEUED, REQUESTED, ALLOCATED,
+                                       RAY_RUNNING, TERMINATING]
+        assert [t["to"] for t in inst.transitions] == [
+            REQUESTED, ALLOCATED, RAY_RUNNING, TERMINATING, TERMINATED]
+        assert all(t["reason"] == "walk" for t in inst.transitions)
+
+    def test_illegal_edges_raise(self):
+        cases = [
+            (QUEUED, RAY_RUNNING), (QUEUED, ALLOCATED),
+            (REQUESTED, RAY_RUNNING), (ALLOCATED, REQUESTED),
+            (RAY_RUNNING, ALLOCATED), (RAY_RUNNING, QUEUED),
+            (TERMINATING, RAY_RUNNING), (TERMINATED, QUEUED),
+            (TERMINATED, TERMINATING),
+        ]
+        for frm, to in cases:
+            inst = Instance(instance_id="ix", node_type="t")
+            inst.status = frm
+            with pytest.raises(InstanceLifecycleError):
+                inst.set_status(to)
+        # unknown state names are rejected too
+        with pytest.raises(InstanceLifecycleError):
+            Instance(instance_id="iy", node_type="t").set_status("BOOTED")
+
+    def test_edge_table_is_exactly_the_documented_machine(self):
+        # every edge in LEGAL_TRANSITIONS is reachable through
+        # set_status and nothing outside it is
+        for frm, allowed in LEGAL_TRANSITIONS.items():
+            for to in LEGAL_TRANSITIONS:
+                inst = Instance(instance_id="iz", node_type="t")
+                inst.status = frm
+                if to in allowed:
+                    inst.set_status(to)
+                else:
+                    with pytest.raises(InstanceLifecycleError):
+                        inst.set_status(to)
+
+
+class TestRetryBudget:
+    def test_provider_error_requeues_then_succeeds(self):
+        provider = _FlakyProvider(fail_n=2)
+        events = []
+        im = InstanceManager(provider, max_launch_retries=2,
+                             on_event=events.append)
+        inst = im.launch(CPU2)
+        assert inst.status == QUEUED and inst.retries == 1
+        im.drive({"cpu2": CPU2})   # attempt 2: fails, requeued
+        assert inst.status == QUEUED and inst.retries == 2
+        im.drive({"cpu2": CPU2})   # attempt 3: succeeds
+        assert inst.status == ALLOCATED
+        assert provider.attempts == 3
+        # the two failures are visible in the event stream
+        requeues = [e for e in events if e["to"] == QUEUED]
+        assert len(requeues) == 2
+        assert "provider error" in requeues[0]["reason"]
+
+    def test_retry_budget_exhausted_terminates(self):
+        provider = _FlakyProvider(fail_n=99)
+        im = InstanceManager(provider, max_launch_retries=2)
+        inst = im.launch(CPU2)
+        im.drive({"cpu2": CPU2})
+        im.drive({"cpu2": CPU2})   # third failure exceeds the budget
+        assert inst.status == TERMINATED
+        assert "provider error after 3 attempts" in \
+            inst.transitions[-1]["reason"]
+        assert provider.attempts == 3
+        # terminal instances are no longer active nor re-driven
+        assert im.active() == []
+        im.drive({"cpu2": CPU2})
+        assert provider.attempts == 3
+
+
+class TestStuckStates:
+    def test_allocated_never_joins_requeued_on_budget(self):
+        provider = FakeMultiNodeProvider()
+        im = InstanceManager(provider, max_launch_retries=2,
+                             stuck_timeouts={ALLOCATED: 0.05})
+        inst = im.launch(CPU2)
+        assert inst.status == ALLOCATED
+        time.sleep(0.08)
+        im.reconcile(alive_node_ids=[])  # node never registered
+        assert inst.status == TERMINATED
+        assert "stuck in ALLOCATED" in inst.transitions[-1]["reason"]
+        # provider node released + a replacement queued carrying the
+        # retry budget forward
+        assert provider.non_terminated_nodes() == []
+        queued = [i for i in im.instances.values() if i.status == QUEUED]
+        assert len(queued) == 1 and queued[0].retries == 1
+
+    def test_allocated_stuck_without_budget_just_terminates(self):
+        provider = FakeMultiNodeProvider()
+        im = InstanceManager(provider, max_launch_retries=0,
+                             stuck_timeouts={ALLOCATED: 0.05})
+        inst = im.launch(CPU2)
+        time.sleep(0.08)
+        im.reconcile(alive_node_ids=[])
+        assert inst.status == TERMINATED
+        assert [i for i in im.instances.values()
+                if i.status == QUEUED] == []
+
+    def test_terminating_stuck_forced_terminated(self):
+        im = InstanceManager(FakeMultiNodeProvider(),
+                             stuck_timeouts={TERMINATING: 0.05})
+        inst = Instance(instance_id="t1", node_type="cpu2")
+        inst.status = TERMINATING
+        inst.state_since = time.monotonic() - 1.0
+        im.instances[inst.instance_id] = inst
+        im.reconcile(alive_node_ids=[])
+        assert inst.status == TERMINATED
+        assert "stuck in TERMINATING" in inst.transitions[-1]["reason"]
+
+    def test_fresh_states_not_swept(self):
+        im = InstanceManager(FakeMultiNodeProvider(),
+                             stuck_timeouts={ALLOCATED: 30.0})
+        inst = im.launch(CPU2)
+        im.reconcile(alive_node_ids=[])
+        assert inst.status == ALLOCATED
+
+
+class TestLifecycleEvents:
+    def test_event_stream_orders_and_reasons(self):
+        events = []
+        im = InstanceManager(FakeMultiNodeProvider(),
+                             on_event=events.append)
+        inst = im.launch(CPU2)
+        im.reconcile(alive_node_ids=[inst.node_id_hex])
+        im.terminate(inst, reason="test done")
+        tos = [e["to"] for e in events
+               if e["instance_id"] == inst.instance_id]
+        assert tos == [REQUESTED, ALLOCATED, RAY_RUNNING, TERMINATING,
+                       TERMINATED]
+        assert events[-1]["reason"] == "test done"
+        assert all(e["node_type"] == "cpu2" for e in events)
+
+    def test_broken_listener_does_not_stall_scaling(self):
+        im = InstanceManager(FakeMultiNodeProvider())
+
+        def bad(_evt):
+            raise RuntimeError("listener bug")
+        im.add_listener(bad)
+        inst = im.launch(CPU2)
+        assert inst.status == ALLOCATED
+
+    def test_vanished_provider_node_terminates(self):
+        provider = FakeMultiNodeProvider()
+        im = InstanceManager(provider)
+        inst = im.launch(CPU2)
+        # the cloud reclaims the node out from under us
+        provider.terminate_node(inst.provider_node)
+        im.reconcile(alive_node_ids=[])
+        assert inst.status == TERMINATED
+        assert inst.transitions[-1]["reason"] == "provider node vanished"
+
+
+def test_status_reader_nm_outage_transient_vs_sustained():
+    """A TRANSIENT node-manager RPC failure must not make a GCS-alive
+    node read as cluster-dead (reconcile's zombie sweep would terminate
+    the healthy host and its gang) nor as provably idle (scale-down
+    would reap it). SUSTAINED unreachability (nm_unreachable_rounds
+    consecutive polls) still must, or a partitioned zombie host is
+    never reclaimed. Recovery resets the streak."""
+    from types import SimpleNamespace
+
+    from ray_tpu.autoscaler.v2 import ClusterStatusReader
+
+    nid = b"\x01" * 8
+    nm_down = [True]
+
+    class _GcsStub:
+        def call(self, method, **kw):
+            if method == "get_all_nodes":
+                return [SimpleNamespace(alive=True, node_id=nid,
+                                        address=("127.0.0.1", 1))]
+            return []  # list_placement_groups
+
+    class _NMClient:
+        def call(self, method, **kw):
+            if nm_down[0]:
+                raise OSError("nm unreachable")
+            if method == "nm_get_info":
+                return {"available": {"CPU": 2},
+                        "pending_resource_shapes": []}
+            return []  # nm_list_workers
+
+    class _PoolStub:
+        def get(self, addr):
+            return _NMClient()
+
+    reader = ClusterStatusReader.__new__(ClusterStatusReader)
+    reader._gcs = _GcsStub()
+    reader._pool = _PoolStub()
+    reader.nm_unreachable_rounds = 3
+    reader._nm_fail_rounds = {}
+    for _ in range(2):  # transient: alive but unobservable => busy
+        st = reader.read()
+        assert st.alive_node_ids == [nid.hex()]
+        assert st.busy_node_ids == [nid.hex()]
+        assert st.node_available == [] and st.pending_demands == []
+    st = reader.read()  # 3rd consecutive failure: cluster-dead
+    assert st.alive_node_ids == []
+    # NM comes back: streak resets, node fully observable again
+    nm_down[0] = False
+    st = reader.read()
+    assert st.alive_node_ids == [nid.hex()]
+    assert st.busy_node_ids == []
+    assert st.node_available == [{"CPU": 2}]
+    nm_down[0] = True  # and a fresh blip is transient again
+    st = reader.read()
+    assert st.alive_node_ids == [nid.hex()]
+
+
+class TestFakeProviderScaleCycle:
+    """Integration: demand-driven scale-up through the full lifecycle,
+    then idle scale-down, on the instant fake provider."""
+
+    def _scaler(self, **kw):
+        provider = FakeMultiNodeProvider()
+        reader = _FakeReader()
+        scaler = AutoscalerV2(reader, provider, [CPU2],
+                              max_nodes=4, idle_timeout_s=0.0, **kw)
+        return scaler, provider, reader
+
+    def test_scale_up_then_down_full_lifecycle(self):
+        events = []
+        scaler, provider, reader = self._scaler()
+        scaler.im.add_listener(events.append)
+        reader.status.pending_demands = [{"CPU": 1}, {"CPU": 1}]
+        scaler.run_once()
+        insts = list(scaler.im.instances.values())
+        assert len(insts) == 1 and insts[0].status == ALLOCATED
+        # node joins -> RAY_RUNNING; demand drains -> idle -> torn down
+        reader.status.pending_demands = []
+        reader.status.alive_node_ids = [insts[0].node_id_hex]
+        scaler.run_once()
+        scaler.run_once()
+        assert insts[0].status == TERMINATED
+        assert provider.non_terminated_nodes() == []
+        tos = [e["to"] for e in events]
+        assert tos == [REQUESTED, ALLOCATED, RAY_RUNNING, TERMINATING,
+                       TERMINATED]
+        assert "idle" in events[-1]["reason"]
+
+    def test_flaky_provider_retries_across_passes(self):
+        provider = _FlakyProvider(fail_n=1)
+        reader = _FakeReader()
+        scaler = AutoscalerV2(reader, provider, [CPU2], max_nodes=4,
+                              idle_timeout_s=60.0)
+        reader.status.pending_demands = [{"CPU": 1}]
+        scaler.run_once()   # launch fails, instance QUEUED
+        insts = list(scaler.im.instances.values())
+        assert len(insts) == 1 and insts[0].status == QUEUED
+        scaler.run_once()   # drive() retries the queued instance
+        assert insts[0].status == ALLOCATED
+        # QUEUED counted as booting: no second instance was launched
+        assert len(scaler.im.instances) == 1
+
+
+def test_report_and_state_surface(ray_start):
+    """AutoscalerV2 with gcs_address reports: instance table +
+    lifecycle events land in the GCS (util.state.autoscaler_instances,
+    `ray_tpu autoscaler`, /api/autoscaler share this RPC), transitions
+    are mirrored into the cluster event log, and the
+    "autoscaler_lifecycle" pubsub channel pushes to subscribers."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state as state_api
+
+    got = []
+    cw = worker_mod.global_worker().core_worker
+    token = cw.subscribe("autoscaler_lifecycle", got.append)
+    try:
+        provider = FakeMultiNodeProvider()
+        reader = _FakeReader()
+        scaler = AutoscalerV2(
+            reader, provider, [CPU2], max_nodes=2, idle_timeout_s=60.0,
+            gcs_address=ray_tpu.get_gcs_address())
+        reader.status.pending_demands = [{"CPU": 1}]
+        scaler.run_once()
+        out = state_api.autoscaler_instances()
+        assert len(out["instances"]) == 1
+        assert out["instances"][0]["status"] == ALLOCATED
+        tos = [e["to"] for e in out["events"]]
+        assert tos == [REQUESTED, ALLOCATED]
+        # cluster event log mirror
+        events = cw._gcs.call("list_events",
+                              event_type="AUTOSCALER_INSTANCE")
+        assert len(events) >= 2
+        # pubsub push reached the driver subscriber
+        deadline = time.monotonic() + 10
+        while len(got) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert [e["to"] for e in got[:2]] == [REQUESTED, ALLOCATED]
+    finally:
+        cw.unsubscribe("autoscaler_lifecycle", token)
+
+
+def test_status_reader_sees_pending_pg_demand(ray_start):
+    """A PENDING placement group's bundles surface as scheduler demand
+    (the elastic replacement probe -> autoscaler supply loop rides
+    this)."""
+    import ray_tpu
+    from ray_tpu.autoscaler.v2 import ClusterStatusReader
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"elastic_probe_res": 1.0}], strategy="PACK")
+    try:
+        reader = ClusterStatusReader(ray_tpu.get_gcs_address())
+        deadline = time.monotonic() + 10
+        demands = []
+        while time.monotonic() < deadline:
+            demands = reader.read().pending_demands
+            if any("elastic_probe_res" in d for d in demands):
+                break
+            time.sleep(0.1)
+        assert any("elastic_probe_res" in d for d in demands), demands
+    finally:
+        remove_placement_group(pg)
+
+
+def test_provider_node_dataclass_roundtrip():
+    # snapshot shape the state surface serializes
+    im = InstanceManager(FakeMultiNodeProvider())
+    inst = im.launch(CPU2)
+    snap = im.snapshot()[0]
+    assert snap["instance_id"] == inst.instance_id
+    assert snap["status"] == ALLOCATED
+    assert snap["status_history"] == [QUEUED, REQUESTED]
+    assert isinstance(ProviderNode("p1"), ProviderNode)

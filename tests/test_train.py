@@ -144,6 +144,131 @@ class TestDataParallelTrainer:
         assert result.metrics["step"] == 3
 
 
+class TestAtomicCheckpointPersistence:
+    """ISSUE 14 satellite: tmp+fsync+rename persistence with the
+    LATEST pointer updated last — an interrupted save can never leave a
+    torn checkpoint as the resume target."""
+
+    def _mgr_with_one(self, tmp_path):
+        from ray_tpu.train.checkpoint_manager import CheckpointManager
+        run_dir = str(tmp_path / "run")
+        mgr = CheckpointManager(run_dir)
+        src = tmp_path / "src1"
+        src.mkdir()
+        (src / "weights.bin").write_bytes(b"v1" * 100)
+        c = Checkpoint(str(src))
+        c.update_metadata({"step": 1})
+        mgr.register(str(src), {"step": 1})
+        return mgr, run_dir
+
+    def test_pointer_names_complete_checkpoint(self, tmp_path):
+        from ray_tpu.train.checkpoint_manager import (
+            latest_checkpoint_path, read_latest_pointer)
+        mgr, run_dir = self._mgr_with_one(tmp_path)
+        p = read_latest_pointer(run_dir)
+        assert p == os.path.join(run_dir, "checkpoint_000001")
+        assert latest_checkpoint_path(run_dir) == p
+        assert Checkpoint(p).get_metadata() == {"step": 1}
+
+    def test_crash_mid_copy_leaves_previous_target(self, tmp_path,
+                                                   monkeypatch):
+        """The copy dies halfway (a torn worker dir / ENOSPC / kill):
+        no checkpoint_* dir appears, the pointer still names the
+        previous complete checkpoint, and the next persist sweeps the
+        debris and succeeds."""
+        import shutil as shutil_mod
+
+        from ray_tpu.train import checkpoint_manager as cm
+        mgr, run_dir = self._mgr_with_one(tmp_path)
+        src2 = tmp_path / "src2"
+        src2.mkdir()
+        for i in range(4):
+            (src2 / f"part{i}.bin").write_bytes(b"v2" * 50)
+
+        calls = {"n": 0}
+        real = shutil_mod.copyfileobj
+
+        def dying_copy(fin, fout, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise OSError("simulated kill mid-copy")
+            return real(fin, fout, *a, **kw)
+
+        monkeypatch.setattr(shutil_mod, "copyfileobj", dying_copy)
+        with pytest.raises(OSError):
+            mgr.register(str(src2), {"step": 2})
+        monkeypatch.undo()
+        names = [d for d in os.listdir(run_dir)
+                 if d.startswith("checkpoint_")]
+        assert names == ["checkpoint_000001"], names
+        assert cm.latest_checkpoint_path(run_dir) == \
+            os.path.join(run_dir, "checkpoint_000001")
+        # recovery: the next register works and advances the pointer
+        mgr.register(str(src2), {"step": 2})
+        assert not [d for d in os.listdir(run_dir)
+                    if d.startswith(".tmp-")]
+        latest = cm.latest_checkpoint_path(run_dir)
+        assert os.path.basename(latest).startswith("checkpoint_")
+        assert len(os.listdir(latest)) == 4
+
+    def test_crash_between_rename_and_pointer(self, tmp_path,
+                                              monkeypatch):
+        """The worst window: data rename landed, pointer update did
+        not. The pointer (and therefore restore()) still names the
+        previous checkpoint — complete either way, never torn."""
+        from ray_tpu.train import checkpoint_manager as cm
+        mgr, run_dir = self._mgr_with_one(tmp_path)
+        src2 = tmp_path / "src2"
+        src2.mkdir()
+        (src2 / "weights.bin").write_bytes(b"v2" * 100)
+
+        def dying_pointer(name):
+            raise OSError("killed before pointer update")
+
+        monkeypatch.setattr(mgr, "_write_latest_pointer", dying_pointer)
+        with pytest.raises(OSError):
+            mgr.register(str(src2), {"step": 2})
+        monkeypatch.undo()
+        # data dir exists, but the RESUME TARGET is still the old one
+        assert os.path.isdir(os.path.join(run_dir, "checkpoint_000002"))
+        assert cm.read_latest_pointer(run_dir) == \
+            os.path.join(run_dir, "checkpoint_000001")
+        trainer = DataParallelTrainer.restore(
+            run_dir, train_loop_per_worker=lambda: None)
+        assert trainer._resume_from.path == \
+            os.path.join(run_dir, "checkpoint_000001")
+
+    def test_tmp_debris_never_resolves(self, tmp_path):
+        from ray_tpu.train.checkpoint_manager import (
+            CheckpointManager, latest_checkpoint_path)
+        run_dir = str(tmp_path / "run")
+        CheckpointManager(run_dir)  # creates the dir
+        os.makedirs(os.path.join(run_dir, ".tmp-checkpoint_000001-dead"))
+        assert latest_checkpoint_path(run_dir) is None
+        with pytest.raises(ValueError):
+            DataParallelTrainer.restore(run_dir,
+                                        train_loop_per_worker=lambda: 0)
+
+    def test_fresh_manager_resumes_numbering(self, tmp_path):
+        """A restored run reuses the prior run dir with a FRESH manager:
+        numbering must continue past the existing checkpoints (a counter
+        restarting at 0 would os.rename into the non-empty
+        checkpoint_000001 and every save of the resumed run would fail —
+        silently, since fit() treats register OSErrors as a vanished
+        worker dir)."""
+        from ray_tpu.train import checkpoint_manager as cm
+        mgr, run_dir = self._mgr_with_one(tmp_path)
+        src2 = tmp_path / "src2"
+        src2.mkdir()
+        (src2 / "weights.bin").write_bytes(b"v2" * 100)
+        mgr2 = cm.CheckpointManager(run_dir)  # the resumed run's manager
+        mgr2.register(str(src2), {"step": 2})
+        assert cm.read_latest_pointer(run_dir) == \
+            os.path.join(run_dir, "checkpoint_000002")
+        assert (tmp_path / "run" / "checkpoint_000002"
+                / "weights.bin").read_bytes() == b"v2" * 100
+
+
 class TestJaxTrainer:
     @pytest.mark.slow  # wall-time budget (ISSUE 9): ~62s of jit
     # compiles in worker subprocesses; the JaxTrainer surface stays

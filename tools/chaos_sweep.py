@@ -55,6 +55,18 @@ SCHEDULES = {
         {"fault": "delay", "method": "store_*", "delay_ms": 2.0,
          "jitter": True, "probability": 0.5},
     ],
+    # elastic kill -> shrink -> rejoin -> grow drill: seeded kills of
+    # train-gang members while the elastic workload below runs; every
+    # fire forces a full reconfiguration cycle (drain / checkpoint /
+    # re-form at the feasible world size / reshard / resume), and a
+    # kill landing mid-re-form exercises shrink-below-target with the
+    # replacement probe growing the gang back. Use
+    # --cycles/RAY_TPU_SWEEP_ELASTIC_CYCLES for the heavy multi-cycle
+    # variant (tests keep it behind -m slow; tier-1 runs 1 cycle).
+    "elastic": [
+        {"fault": "kill_worker", "actor_class": "RayTrainWorker",
+         "method": "w_*", "probability": 0.02, "max_fires": 2},
+    ],
 }
 
 _SMOKE_WORKLOAD = """
@@ -88,6 +100,77 @@ else:
     raise RuntimeError("put never survived the store-error schedule")
 assert ray_tpu.get(ref, timeout=120).sum() == arr.sum()
 print("SWEEP_WORKLOAD_OK")
+"""
+
+# Elastic drill workload (schedule "elastic"): a 2-worker elastic
+# DataParallelTrainer run to completion under seeded gang-member kills.
+# Cycle count via RAY_TPU_SWEEP_ELASTIC_CYCLES (6 checkpointed steps
+# per cycle); exit 0 requires the run to finish at the full step count
+# AND the driver's ownership plane to drain afterwards (no leaked
+# pins/leases from torn-down gang generations).
+_ELASTIC_WORKLOAD = """
+import os
+import tempfile
+import time
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, DataParallelTrainer, FailureConfig,
+                           RunConfig, ScalingConfig)
+
+cycles = int(os.environ.get("RAY_TPU_SWEEP_ELASTIC_CYCLES", "1"))
+steps_total = 6 * cycles
+base = tempfile.mkdtemp(prefix="elastic_sweep_")
+
+
+def loop(config):
+    ctx = train.get_context()
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt:
+        start = ckpt.get_metadata().get("step", -1) + 1
+    for step in range(start, config["steps"]):
+        if ctx.get_world_rank() == 0:
+            cdir = os.path.join(config["base"], f"wip_{step}")
+            os.makedirs(cdir, exist_ok=True)
+            c = Checkpoint(cdir)
+            c.update_metadata({"step": step})
+            train.report({"step": step,
+                          "world": ctx.get_world_size()}, checkpoint=c)
+        else:
+            train.report({"step": step, "world": ctx.get_world_size()})
+
+
+result = DataParallelTrainer(
+    loop, train_loop_config={"steps": steps_total, "base": base},
+    scaling_config=ScalingConfig(
+        num_workers=2, resources_per_worker={"CPU": 1},
+        elastic_min_workers=1, elastic_reform_timeout_s=10.0),
+    run_config=RunConfig(
+        storage_path=base, name="elastic_sweep",
+        failure_config=FailureConfig(max_failures=10))).fit()
+assert result.error is None, f"elastic run failed: {result.error!r}"
+assert result.metrics["step"] == steps_total - 1, result.metrics
+
+# ownership drain canary: gang teardown/re-form must not leak lease
+# slots or pins (PR 12 invariant, extended to the training plane)
+import gc
+
+from ray_tpu._private import ownership
+from ray_tpu._private import worker as worker_mod
+
+cw = worker_mod.global_worker().core_worker
+deadline = time.monotonic() + 15
+leaks = []
+while time.monotonic() < deadline:
+    gc.collect()
+    with cw._lock:
+        leaks = ownership.lease_drain_report(cw._ltab)
+    if not leaks:
+        break
+    time.sleep(0.25)
+assert not leaks, "ownership leak after elastic cycles: " + "; ".join(leaks)
+print("ELASTIC_WORKLOAD_OK")
 """
 
 _RUNNER = """
@@ -158,7 +241,12 @@ def main() -> int:
     ap.add_argument("--num-seeds", type=int, default=3,
                     help="seeds 1..N when --seeds is not given")
     ap.add_argument("--script", default=None,
-                    help="workload python file (default: built-in smoke)")
+                    help="workload python file (default: built-in smoke;"
+                         " schedule 'elastic' runs the elastic drill)")
+    ap.add_argument("--cycles", type=int, default=1,
+                    help="elastic schedule: training cycles per seed "
+                         "(6 checkpointed steps each; multi-cycle is "
+                         "the heavy drill)")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="per-seed wall clock budget (s)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -166,13 +254,16 @@ def main() -> int:
 
     seeds = [int(s) for s in args.seeds.split(",")] if args.seeds \
         else list(range(1, args.num_seeds + 1))
+    if args.schedule == "elastic":
+        os.environ["RAY_TPU_SWEEP_ELASTIC_CYCLES"] = str(args.cycles)
     script_path = args.script
     tmp = None
     if script_path is None:
         import tempfile
         fd, tmp = tempfile.mkstemp(suffix="_chaos_smoke.py")
         with os.fdopen(fd, "w") as f:
-            f.write(_SMOKE_WORKLOAD)
+            f.write(_ELASTIC_WORKLOAD if args.schedule == "elastic"
+                    else _SMOKE_WORKLOAD)
         script_path = tmp
 
     results = []

@@ -35,6 +35,11 @@ from typing import Any, Dict, List, Optional, Tuple
 # whole task body (including any nested learner.update), and ranking it
 # would let it claim time that belongs to the spans inside it.
 BUCKETS: Dict[str, Tuple[int, Tuple[str, ...]]] = {
+    # elastic reconfiguration phases (train/elastic.py: detect/drain/
+    # checkpoint/reform/reshard/resume) outrank everything: wall time
+    # inside a re-form is recovery cost, not compute/transport, even
+    # when store/rpc spans nest inside it
+    "elastic_reconfig": (4, ("elastic.",)),
     "store_rpc": (3, ("rpc.", "store.", "cw.", "envelope.")),
     "device_feed": (2, ("feed.stage", "feed.ship", "feed.xfer",
                         "feed.unfuse")),
