@@ -126,6 +126,21 @@ class Config:
     # rather than treating a slow-but-progressing recovery as stuck.
     watchdog_elastic_reconfig_s = _define(
         "watchdog_elastic_reconfig_s", 120.0, float)
+    # JAX sentinel probes (util/jax_sentinel.py; static twins are
+    # graftlint RT020/RT021): a step-region label whose kind=recompile
+    # counter grows by >= watchdog_jit_recompiles within one harvest
+    # window — after the label's first compile is older than the warmup
+    # grace — raises `jit_recompile_storm`; host-transfer bytes
+    # accounted INSIDE a step region growing by >=
+    # watchdog_host_transfer_bytes per window raise
+    # `unexpected_host_transfer` (hot steps sync at sanctioned forcing
+    # points outside their jitted bodies). All three are
+    # metrics_configure-tunable at runtime.
+    watchdog_jit_recompiles = _define("watchdog_jit_recompiles", 3, int)
+    watchdog_jit_recompile_warmup_s = _define(
+        "watchdog_jit_recompile_warmup_s", 60.0, float)
+    watchdog_host_transfer_bytes = _define(
+        "watchdog_host_transfer_bytes", float(1 << 20), float)
     # Debug plane (_private/log_plane.py + log_monitor.py): per-worker
     # in-memory tail index depth, driver-stream flood control (per-source
     # token bucket), and crash-postmortem bundle sizes.

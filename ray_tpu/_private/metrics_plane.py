@@ -357,7 +357,10 @@ class Watchdog:
                  serve_p99_s: float = 2.0,
                  serve_error_rate: float = 0.1,
                  serve_shed_rate: float = 0.5,
-                 elastic_reconfig_s: float = 120.0) -> None:
+                 elastic_reconfig_s: float = 120.0,
+                 jit_recompiles: int = 3,
+                 jit_recompile_warmup_s: float = 60.0,
+                 host_transfer_bytes: float = float(1 << 20)) -> None:
         self._emit = emit
         self.cooldown_s = cooldown_s
         self.wait_edge_age_s = wait_edge_age_s
@@ -369,6 +372,12 @@ class Watchdog:
         self.serve_error_rate = serve_error_rate
         self.serve_shed_rate = serve_shed_rate
         self.elastic_reconfig_s = elastic_reconfig_s
+        self.jit_recompiles = jit_recompiles
+        self.jit_recompile_warmup_s = jit_recompile_warmup_s
+        self.host_transfer_bytes = host_transfer_bytes
+        # jax sentinel storm probe: step-region label -> monotonic ts
+        # its first compile series appeared (warmup grace clock)
+        self._jit_first_seen: Dict[str, float] = {}
         # serve SLO probes: last cumulative per-deployment request
         # histogram / per-(deployment, code) request counts (and shed
         # counts, for the shed-burn probe); the probe judges
@@ -935,6 +944,98 @@ class Watchdog:
                     severity="ERROR", gang=gang,
                     phase=extra.get("phase"), age_s=age)
 
+    @staticmethod
+    def _series_tags(key: str) -> Dict[str, str]:
+        """Tags of a flat series key (`name{k=v,...}`). Sentinel labels
+        are span/region names (no commas or braces), so plain splitting
+        is exact for the series this parser is used on."""
+        i = key.find("{")
+        if i < 0 or not key.endswith("}"):
+            return {}
+        out: Dict[str, str] = {}
+        for part in key[i + 1:-1].split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                out[k] = v
+        return out
+
+    def _probe_jax_sentinel(self, series: Dict[str, float]) -> None:
+        """`jit_recompile_storm` / `unexpected_host_transfer`: per-
+        harvest deltas of the jax sentinel's counters
+        (util/jax_sentinel.py; static twins graftlint RT020/RT021).
+
+          - a step-region label whose kind=recompile compile count
+            grows by >= jit_recompiles within one window is recompiling
+            in steady state — a shape/static-arg/donation hazard is
+            making XLA rebuild the step it should be replaying. Labels
+            get a warmup grace (jit_recompile_warmup_s from their first
+            compile): a cold start legitimately compiles several
+            modules under one label across a couple of windows.
+          - host-transfer bytes accounted INSIDE a step region growing
+            by >= host_transfer_bytes per window mean the hot step is
+            forcing device→host syncs it shouldn't (the sanctioned
+            forcing points live outside the regions).
+
+        region="untracked"/fn="untracked" series are never judged —
+        outside a step region a transfer or compile is by definition
+        not on a hot path. First-appearance series BASELINE (prev round
+        None), so a real storm alerts within two harvest intervals."""
+        now = time.monotonic()
+        fns_seen = set()
+        for key in series:
+            if key.startswith("ray_tpu_jit_compiles_total{"):
+                fn = self._series_tags(key).get("fn")
+                if fn:
+                    fns_seen.add(fn)
+                    self._jit_first_seen.setdefault(fn, now)
+        # labels gone from the harvest drop their warmup clocks — the
+        # always-on GCS stays bounded under driver churn (a returning
+        # label just re-enters warmup grace)
+        for fn in [f for f in self._jit_first_seen
+                   if f not in fns_seen]:
+            del self._jit_first_seen[fn]
+        for key, v in series.items():
+            prev = self._prev_series.get(key)
+            if prev is None:
+                continue  # baseline round for this series
+            delta = v - prev
+            if delta <= 0:
+                continue
+            if key.startswith("ray_tpu_jit_compiles_total{"):
+                tags = self._series_tags(key)
+                fn = tags.get("fn", "?")
+                if tags.get("kind") != "recompile" \
+                        or fn == "untracked":
+                    continue
+                first = self._jit_first_seen.get(fn, now)
+                if now - first < self.jit_recompile_warmup_s \
+                        or delta < self.jit_recompiles:
+                    continue
+                self._alert(
+                    "jit_recompile_storm", key,
+                    f"step region {fn!r}: {delta:g} XLA recompile(s) "
+                    f"within one harvest window (total {v:g}) — the "
+                    f"step is recompiling in steady state instead of "
+                    f"replaying its cache; look for shape-varying "
+                    f"args, python scalars traced as constants, or "
+                    f"donation retriggers (static twin: graftlint "
+                    f"RT020)", severity="ERROR", fn=fn, value=delta)
+            elif key.startswith("ray_tpu_host_transfer_bytes_total{"):
+                region = self._series_tags(key).get("region", "?")
+                if region == "untracked":
+                    continue
+                if delta < self.host_transfer_bytes:
+                    continue
+                self._alert(
+                    "unexpected_host_transfer", key,
+                    f"step region {region!r}: {delta:g} bytes forced "
+                    f"device→host within one harvest window "
+                    f"(> {self.host_transfer_bytes:g}) — a hidden "
+                    f".item()/np coercion/device_get is syncing the "
+                    f"hot step (static twin: graftlint RT021; spans: "
+                    f"host_sync.* in `ray_tpu timeline --spans`)",
+                    severity="ERROR", region=region, value=delta)
+
     def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
         for node in unreachable:
             self._alert(
@@ -958,6 +1059,7 @@ class Watchdog:
                       lambda: self._probe_serve_slo(snaps),
                       lambda: self._probe_serve_shed(snaps),
                       lambda: self._probe_elastic(snaps),
+                      lambda: self._probe_jax_sentinel(series),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:
@@ -999,7 +1101,11 @@ class MetricsPlane:
             serve_p99_s=Config.watchdog_serve_p99_s,
             serve_error_rate=Config.watchdog_serve_error_rate,
             serve_shed_rate=Config.watchdog_serve_shed_rate,
-            elastic_reconfig_s=Config.watchdog_elastic_reconfig_s)
+            elastic_reconfig_s=Config.watchdog_elastic_reconfig_s,
+            jit_recompiles=Config.watchdog_jit_recompiles,
+            jit_recompile_warmup_s=(
+                Config.watchdog_jit_recompile_warmup_s),
+            host_transfer_bytes=Config.watchdog_host_transfer_bytes)
         self._harvest_hist = get_or_create(
             Histogram, "ray_tpu_metrics_harvest_seconds",
             description="wall time of one cluster metrics harvest "
@@ -1189,7 +1295,10 @@ class MetricsPlane:
                   serve_p99_s: Optional[float] = None,
                   serve_error_rate: Optional[float] = None,
                   serve_shed_rate: Optional[float] = None,
-                  elastic_reconfig_s: Optional[float] = None
+                  elastic_reconfig_s: Optional[float] = None,
+                  jit_recompiles: Optional[int] = None,
+                  jit_recompile_warmup_s: Optional[float] = None,
+                  host_transfer_bytes: Optional[float] = None
                   ) -> Dict[str, Any]:
         """Runtime tuning (ops + tests): adjust the sample interval and
         watchdog thresholds without restarting the GCS."""
@@ -1217,6 +1326,14 @@ class MetricsPlane:
             self.watchdog.serve_shed_rate = float(serve_shed_rate)
         if elastic_reconfig_s is not None:
             self.watchdog.elastic_reconfig_s = float(elastic_reconfig_s)
+        if jit_recompiles is not None:
+            self.watchdog.jit_recompiles = int(jit_recompiles)
+        if jit_recompile_warmup_s is not None:
+            self.watchdog.jit_recompile_warmup_s = \
+                float(jit_recompile_warmup_s)
+        if host_transfer_bytes is not None:
+            self.watchdog.host_transfer_bytes = \
+                float(host_transfer_bytes)
         return {"interval_s": self.interval_s,
                 "cooldown_s": self.watchdog.cooldown_s,
                 "wait_edge_age_s": self.watchdog.wait_edge_age_s,
@@ -1229,7 +1346,12 @@ class MetricsPlane:
                 "serve_error_rate": self.watchdog.serve_error_rate,
                 "serve_shed_rate": self.watchdog.serve_shed_rate,
                 "elastic_reconfig_s":
-                    self.watchdog.elastic_reconfig_s}
+                    self.watchdog.elastic_reconfig_s,
+                "jit_recompiles": self.watchdog.jit_recompiles,
+                "jit_recompile_warmup_s":
+                    self.watchdog.jit_recompile_warmup_s,
+                "host_transfer_bytes":
+                    self.watchdog.host_transfer_bytes}
 
     def stop(self) -> None:
         self._stopped = True

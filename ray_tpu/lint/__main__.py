@@ -1,7 +1,9 @@
 """graftlint CLI: `python -m ray_tpu.lint [paths...]`.
 
 Exit codes: 0 clean, 1 findings, 2 usage error. `--format=json` emits a
-machine-readable array for CI tooling and dashboards.
+machine-readable object for CI tooling and dashboards: a `graftlint`
+header naming the effective --select/--ignore filter (so a green run is
+auditable — "clean under WHICH rules?"), then the `findings` array.
 """
 
 from __future__ import annotations
@@ -106,7 +108,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     if args.fmt == "json":
-        print(json.dumps([f.to_dict() for f in findings], indent=1))
+        ran = [r.id for r in ALL_RULES
+               if (select is None or r.id in {s.upper()
+                                              for s in select})
+               and (ignore is None or r.id not in {s.upper()
+                                                   for s in ignore})]
+        print(json.dumps({
+            "graftlint": {"select": select, "ignore": ignore,
+                          "rules": ran},
+            "findings": [f.to_dict() for f in findings]}, indent=1))
     else:
         for f in findings:
             print(f.format())

@@ -381,11 +381,21 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 # ---------------------------------------------------------------------
 
 
+# Cached-payload schema: bumped when the cache's SHAPE changes (finding
+# dict fields, project-fact formats) so entries written by an older
+# engine can never be misread, even in the degenerate case where the
+# package sources hash identically (e.g. a revert). 2 = the jaxrules
+# layer's donation/leak fact schemas (RT020..RT023).
+CACHE_SCHEMA = 2
+
+
 def _ruleset_fingerprint() -> str:
-    """Hash of the lint package's own sources: an edited rule must
-    invalidate every cache entry, or stale findings would gate CI."""
+    """Hash of the lint package's own sources (+ CACHE_SCHEMA): an
+    edited rule must invalidate every cache entry, or stale findings
+    would gate CI."""
     import hashlib
     h = hashlib.sha1()
+    h.update(str(CACHE_SCHEMA).encode())
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     for name in sorted(os.listdir(pkg_dir)):
         if name.endswith(".py"):

@@ -1,0 +1,1005 @@
+"""JAX/XLA hot-path rule family: RT020-RT023.
+
+XLA gives speed back silently: a jit cache miss per step (RT020), an
+implicit device->host sync inside the learner loop (RT021), a donated
+buffer read after the call that donated it (RT022), or a pin/lease/slot
+acquired without an exception-safe release (RT023 — the bug class the
+PR 12 chaos fuzzer kept finding by hand). These rules are the static
+half of the pairing whose runtime half is ray_tpu/util/jax_sentinel.py
+(compile counters + transfer accounting on the live learner).
+
+Analysis building blocks shared by the family:
+
+  - a **jit-binding map** per module: names and ``self.<attr>`` slots
+    holding jit-wrapped callables (``f = jax.jit(g)``,
+    ``self._fn = jax.jit(...)``, ``self._table[k] = jax.jit(...)``,
+    ``@jax.jit``-decorated defs), with their literal
+    ``static_argnums``/``donate_argnums`` when declared;
+  - a **device-taint lattice** per function (RT021): values produced by
+    ``jax.*``/``jnp.*`` calls or by calling a jit binding are device
+    values; taint flows through assignment, unpacking, subscripts and
+    arithmetic, and is scrubbed only by the sanctioned forcing point
+    ``jax.device_get`` (or by the flagged coercions themselves);
+  - an **acquire/release event scan** per function (RT023): framework
+    resource pairs (store_pin/store_unpin, lease/unlease, slots,
+    HostStage segments, actor handles in setup paths) are tracked in
+    statement order with try/finally/except coverage, and helper-call
+    releases resolve cross-file through project facts, RT016-style.
+
+RT022/RT023 are project rules (collect_facts + project_check): their
+facts are JSON-able and cache cleanly, and donation/release pairing is
+judged over every linted file so cross-function misuse is still caught
+under incremental runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu.lint.engine import (Finding, JIT_WRAPPERS, ModuleContext,
+                                 _jit_decorated)
+
+
+class _JaxRule:
+    """Duck-typed rule base (same shape as rules.Rule; not imported
+    from there so `import ray_tpu.lint.jaxrules` works standalone
+    without a circular import through the catalogue module)."""
+
+    id: str = "RT000"
+    name: str = ""
+    rationale: str = ""
+
+    def finding(self, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(self.id, ctx.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+# Directories whose code never runs on the training hot path; RT021's
+# sync findings are actionable only where a sync costs a step.
+_EXEMPT_DIR_PARTS = {"tests", "test", "examples", "benchmarks",
+                     "scripts", "tools", "docs"}
+
+# jax host-side APIs whose RESULT is ordinary host data (or whose call
+# is itself the sanctioned explicit forcing point): calling them does
+# not produce a device value, so taint stops here. jax.device_get is
+# deliberately never flagged — it is the ONE blessed way to sync.
+_JAX_HOST_EXACT = {
+    "jax.device_get", "jax.devices", "jax.local_devices",
+    "jax.device_count", "jax.local_device_count", "jax.process_index",
+    "jax.process_count", "jax.default_backend", "jax.eval_shape",
+    "jax.ShapeDtypeStruct", "jax.make_mesh", "jax.clear_caches",
+    "jax.transfer_guard", "jax.named_scope",
+}
+_JAX_HOST_PREFIX = (
+    "jax.tree", "jax.tree_util", "jax.sharding.", "jax.debug.",
+    "jax.profiler.", "jax.monitoring.", "jax.config",
+    "jax.experimental.mesh_utils", "jax.distributed.", "jax.stages",
+)
+
+# Attributes of device arrays that are host metadata, not device data.
+_HOST_META_ATTRS = {"shape", "ndim", "dtype", "size", "sharding",
+                    "device", "nbytes", "itemsize"}
+
+_NUMPY_COERCIONS = {"numpy.asarray", "numpy.array", "np.asarray",
+                    "np.array"}
+
+# ---------------------------------------------------------------------
+# RT023 resource pair registry. Extend by appending — names are the
+# TERMINAL component of the called attribute/function.
+# ---------------------------------------------------------------------
+
+_ACQUIRE_KIND: Dict[str, str] = {
+    "store_pin": "pin", "pin": "pin", "pin_arg": "pin", "pin_refs": "pin",
+    "store_lease": "lease", "lease": "lease",
+    "acquire_slot": "slot", "take_slot": "slot",
+    "_acquire": "stage_slot",
+    "remote": "actor",  # setup paths only, see _SETUP_FN_NAMES
+}
+_RELEASE_KIND: Dict[str, str] = {
+    "store_unpin": "pin", "unpin": "pin", "unpin_arg": "pin",
+    "store_unlease": "lease", "unlease": "lease",
+    "release_slot": "slot", "release_slots": "slot",
+    "_release": "stage_slot",
+    "kill": "actor", "shutdown": "actor", "terminate": "actor",
+}
+# `.remote()` is every task submission, not just actor construction;
+# only treat it as an acquire inside construction/setup functions where
+# a matching kill/shutdown is plausibly owed.
+_SETUP_FN_NAMES = {"__init__", "setup", "start", "_start", "build",
+                   "launch", "_launch", "restart", "_restart"}
+
+
+def _path_exempt(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(p in _EXEMPT_DIR_PARTS for p in parts)
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    """Terminal component of a call target: `self._store.pin` -> 'pin',
+    `unpin` -> 'unpin'. None for anything unnamed."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _self_attr_name(node: ast.AST) -> Optional[str]:
+    """'X' for a `self.X` expression, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _literal_ints(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+class _JitInfo:
+    """One jit-wrapped binding: declared static/donated positions.
+    `static`/`donate` are None when declared with a NON-literal
+    expression — unknown, so the rules stay silent rather than guess."""
+
+    __slots__ = ("static", "donate", "line")
+
+    def __init__(self, static: Optional[Set[int]],
+                 donate: Optional[List[int]], line: int):
+        self.static = static
+        self.donate = donate
+        self.line = line
+
+
+def _info_from_jit_call(call: ast.Call) -> _JitInfo:
+    static: Optional[Set[int]] = set()
+    donate: Optional[List[int]] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            ints = _literal_ints(kw.value)
+            static = set(ints) if ints is not None else None
+        elif kw.arg == "static_argnames":
+            # names affect kwargs, not positions; positions stay as-is
+            continue
+        elif kw.arg == "donate_argnums":
+            donate = _literal_ints(kw.value)
+    return _JitInfo(static, donate, call.lineno)
+
+
+def _decorator_jit_call(node: ast.AST, ctx: ModuleContext
+                        ) -> Optional[ast.Call]:
+    for dec in getattr(node, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            fname = ctx.dotted(dec.func)
+            if fname in JIT_WRAPPERS:
+                return dec
+            if fname in ("functools.partial", "partial") and dec.args \
+                    and ctx.dotted(dec.args[0]) in JIT_WRAPPERS:
+                return dec
+    return None
+
+
+def _jit_bindings(ctx: ModuleContext
+                  ) -> Tuple[Dict[str, _JitInfo], Dict[str, _JitInfo]]:
+    """(names, self_attrs): bindings that hold jit-wrapped callables.
+    A subscripted store (`self._m[k] = jax.jit(...)`) registers the
+    attr as a jit TABLE: `self._m[k](...)` calls are jit calls."""
+    names: Dict[str, _JitInfo] = {}
+    attrs: Dict[str, _JitInfo] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and ctx.call_name(node.value) in JIT_WRAPPERS:
+            info = _info_from_jit_call(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names[t.id] = info
+                    continue
+                a = _self_attr_name(t)
+                if a:
+                    attrs[a] = info
+                    continue
+                if isinstance(t, ast.Subscript):
+                    a = _self_attr_name(t.value)
+                    if a:
+                        attrs[a] = info
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _jit_decorated(node, ctx):
+            dec = _decorator_jit_call(node, ctx)
+            names[node.name] = (_info_from_jit_call(dec) if dec
+                                else _JitInfo(set(), [], node.lineno))
+    return names, attrs
+
+
+def _jit_callee(ctx: ModuleContext, call: ast.Call,
+                names: Dict[str, _JitInfo], attrs: Dict[str, _JitInfo]
+                ) -> Tuple[Optional[str], Optional[_JitInfo]]:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in names:
+        return f.id, names[f.id]
+    a = _self_attr_name(f)
+    if a and a in attrs:
+        return a, attrs[a]
+    if isinstance(f, ast.Subscript):
+        a = _self_attr_name(f.value)
+        if a and a in attrs:
+            return a, attrs[a]
+    return None, None
+
+
+# =====================================================================
+# RT020: recompile hazards
+# =====================================================================
+
+
+class RecompileHazard(_JaxRule):
+    id = "RT020"
+    name = "recompile-hazard"
+    rationale = ("a jit cache miss per step turns an XLA-speed loop into "
+                 "a compile-speed loop: re-wrapping inside a loop, "
+                 "branching on .shape inside a traced body, and varying "
+                 "Python scalars at non-static positions all retrace")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._wrap_in_loop(ctx)
+        yield from self._traced_body_hazards(ctx)
+        yield from self._scalar_args(ctx)
+
+    # -- jit(...) re-wrapped inside a loop ----------------------------
+
+    def _wrap_in_loop(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.call_name(node) in JIT_WRAPPERS):
+                continue
+            if not ctx.loops_between(node):
+                continue
+            # a keyed store (`self._cache[key] = jax.jit(...)`) builds a
+            # compile cache on purpose — each iteration wraps a DIFFERENT
+            # callable once
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Assign) and any(
+                    isinstance(t, ast.Subscript) for t in parent.targets):
+                continue
+            fname = ctx.call_name(node)
+            yield self.finding(
+                ctx, node,
+                f"{fname}(...) inside a loop re-wraps per iteration: "
+                f"each wrap starts an empty compile cache, so every call "
+                f"recompiles — hoist the wrap out of the loop (or key a "
+                f"cache by the static signature)")
+
+    # -- .shape branches / f-strings inside traced bodies -------------
+
+    def _traced_body_hazards(self, ctx: ModuleContext) -> Iterator[Finding]:
+        seen: Set[ast.AST] = set()
+        for fn in ctx.traced_fns:
+            for node in ast.walk(fn):
+                if node in seen:
+                    continue
+                if isinstance(node, (ast.If, ast.While)) \
+                        and self._shape_test(node.test) \
+                        and not self._guard_clause(node):
+                    seen.add(node)
+                    yield self.finding(
+                        ctx, node,
+                        "branching on .shape/.ndim inside a jitted body "
+                        "specializes the trace per shape: every new "
+                        "input shape recompiles — pad/bucket shapes or "
+                        "hoist the branch out of the traced function")
+                elif isinstance(node, ast.JoinedStr) \
+                        and self._dynamic_fstring(node) \
+                        and not self._in_raise_or_assert(ctx, node, fn):
+                    seen.add(node)
+                    yield self.finding(
+                        ctx, node,
+                        "f-string inside a jitted body formats at trace "
+                        "time: a traced value interpolates as its tracer "
+                        "repr (or aborts the trace), and rebuilding the "
+                        "string per call retraces — use jax.debug.print "
+                        "or move formatting out of the traced body")
+
+    @staticmethod
+    def _shape_test(test: ast.AST) -> bool:
+        return any(isinstance(n, ast.Attribute)
+                   and n.attr in ("shape", "ndim")
+                   for n in ast.walk(test))
+
+    @staticmethod
+    def _guard_clause(node: ast.AST) -> bool:
+        """`if x.shape[0] != n: raise ...` validates at trace time —
+        a legitimate, recompile-free pattern."""
+        body = getattr(node, "body", [])
+        return bool(body) and all(isinstance(s, ast.Raise) for s in body)
+
+    @staticmethod
+    def _dynamic_fstring(node: ast.JoinedStr) -> bool:
+        return any(isinstance(v, ast.FormattedValue)
+                   and not isinstance(v.value, ast.Constant)
+                   for v in node.values)
+
+    @staticmethod
+    def _in_raise_or_assert(ctx: ModuleContext, node: ast.AST,
+                            fn: ast.AST) -> bool:
+        for anc in ctx.ancestors(node):
+            if anc is fn:
+                return False
+            if isinstance(anc, (ast.Raise, ast.Assert)):
+                return True
+        return False
+
+    # -- varying Python scalars at non-static positions ---------------
+
+    def _scalar_args(self, ctx: ModuleContext) -> Iterator[Finding]:
+        names, attrs = _jit_bindings(ctx)
+        if not names and not attrs:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee, info = _jit_callee(ctx, node, names, attrs)
+            if info is None or info.static is None:
+                continue  # unknown static set: don't guess
+            loop_vars = self._range_loop_vars(ctx, node)
+            for i, arg in enumerate(node.args):
+                if i in info.static or isinstance(arg, ast.Starred):
+                    continue
+                hazard = self._scalar_hazard(ctx, arg, loop_vars)
+                if hazard:
+                    yield self.finding(
+                        ctx, arg,
+                        f"jitted callable '{callee}' receives {hazard} "
+                        f"at positional arg {i}: every distinct value "
+                        f"retraces and recompiles — declare the arg in "
+                        f"static_argnums if it selects a variant, or "
+                        f"pass it as a device array (jnp.asarray) if "
+                        f"it is data")
+
+    @staticmethod
+    def _range_loop_vars(ctx: ModuleContext, node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(anc, ast.For) and isinstance(anc.iter, ast.Call) \
+                    and ctx.call_name(anc.iter) in ("range",
+                                                    "builtins.range") \
+                    and isinstance(anc.target, ast.Name):
+                out.add(anc.target.id)
+        return out
+
+    @staticmethod
+    def _scalar_hazard(ctx: ModuleContext, arg: ast.AST,
+                       loop_vars: Set[str]) -> Optional[str]:
+        if isinstance(arg, ast.Call) and \
+                ctx.call_name(arg) in ("int", "float", "len"):
+            return f"a Python scalar from {ctx.call_name(arg)}()"
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Name) and n.id in loop_vars:
+                return f"the Python loop counter '{n.id}'"
+        return None
+
+
+# =====================================================================
+# RT021: hidden host syncs
+# =====================================================================
+
+
+class HiddenHostSync(_JaxRule):
+    id = "RT021"
+    name = "hidden-host-sync"
+    rationale = ("`.item()`, float()/int()/bool(), np.asarray and print "
+                 "on a device value block the Python thread until the "
+                 "device catches up — one per step serializes the "
+                 "pipeline; batch reads through a single "
+                 "jax.device_get forcing point instead")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if _path_exempt(ctx.path):
+            return
+        names, attrs = _jit_bindings(ctx)
+        attr_taint = self._attr_taint(ctx, names, attrs)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn in ctx.traced_fns:
+                continue  # host effects in traced code are RT003's beat
+            yield from self._check_fn(ctx, fn, names, attrs, attr_taint)
+
+    # -- device-value production --------------------------------------
+
+    def _produces_device(self, ctx: ModuleContext, expr: ast.AST,
+                         tainted: Set[str], names: Dict[str, _JitInfo],
+                         attrs: Dict[str, _JitInfo],
+                         attr_taint: Set[str]) -> bool:
+        def dev(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.Attribute):
+                if e.attr in _HOST_META_ATTRS:
+                    return False
+                a = _self_attr_name(e)
+                if a is not None:
+                    return a in attr_taint
+                return dev(e.value)
+            if isinstance(e, ast.Subscript):
+                return dev(e.value)
+            if isinstance(e, (ast.Tuple, ast.List)):
+                return any(dev(x) for x in e.elts)
+            if isinstance(e, ast.BinOp):
+                return dev(e.left) or dev(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return dev(e.operand)
+            if isinstance(e, ast.IfExp):
+                return dev(e.body) or dev(e.orelse)
+            if isinstance(e, ast.Call):
+                dn = ctx.call_name(e)
+                if dn is not None:
+                    if dn in _JAX_HOST_EXACT \
+                            or dn.startswith(_JAX_HOST_PREFIX):
+                        return False
+                    if dn in JIT_WRAPPERS:
+                        return False  # returns a callable, not data
+                    if dn.startswith(("jax.", "jax_")) \
+                            or dn.startswith("jax.numpy."):
+                        return True
+                callee, info = _jit_callee(ctx, e, names, attrs)
+                if info is not None:
+                    return True
+                # method on a device receiver (x.sum(), x.astype(...))
+                if isinstance(e.func, ast.Attribute) and dev(e.func.value):
+                    return True
+                return False
+            return False
+        return dev(expr)
+
+    def _attr_taint(self, ctx: ModuleContext, names: Dict[str, _JitInfo],
+                    attrs: Dict[str, _JitInfo]) -> Set[str]:
+        """self-attrs assigned device values anywhere in the module
+        (`self._params, ... = self._update_fn(...)`)."""
+        taint: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not self._produces_device(ctx, node.value, set(),
+                                             names, attrs, taint):
+                    continue
+                for t in node.targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                        else [t]
+                    for e in elts:
+                        a = _self_attr_name(e)
+                        if a and a not in taint:
+                            taint.add(a)
+                            changed = True
+        return taint
+
+    # -- per-function taint + triggers --------------------------------
+
+    def _fn_nodes(self, fn: ast.AST) -> Iterator[ast.AST]:
+        """Walk fn's body skipping nested function subtrees: each def
+        is analyzed with its own taint set."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_fn(self, ctx: ModuleContext, fn: ast.AST,
+                  names: Dict[str, _JitInfo], attrs: Dict[str, _JitInfo],
+                  attr_taint: Set[str]) -> Iterator[Finding]:
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in self._fn_nodes(fn):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    targets, value = [node.target], node.iter
+                if value is None or not self._produces_device(
+                        ctx, value, tainted, names, attrs, attr_taint):
+                    continue
+                for t in targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                        else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Starred):
+                            e = e.value
+                        if isinstance(e, ast.Name) and e.id not in tainted:
+                            tainted.add(e.id)
+                            changed = True
+
+        def dev(e: ast.AST) -> bool:
+            return self._produces_device(ctx, e, tainted, names, attrs,
+                                         attr_taint)
+
+        for node in self._fn_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = ctx.call_name(node)
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args and dev(f.value):
+                yield self.finding(
+                    ctx, node,
+                    "`.item()` on a device value blocks until the device "
+                    "catches up — a hidden sync per call; batch reads "
+                    "through one jax.device_get(...) forcing point")
+            elif dn in ("float", "int", "bool") and len(node.args) == 1 \
+                    and dev(node.args[0]):
+                yield self.finding(
+                    ctx, node,
+                    f"{dn}() coerces a device value through a hidden "
+                    f"device->host sync — force once with "
+                    f"jax.device_get and convert on the host")
+            elif dn in _NUMPY_COERCIONS and node.args \
+                    and any(dev(a) for a in node.args):
+                yield self.finding(
+                    ctx, node,
+                    f"{dn.split('.')[0]}.{dn.split('.')[-1]}() on a "
+                    f"device value is a blocking device->host copy per "
+                    f"call — batch the reads through a single "
+                    f"jax.device_get(...) forcing point")
+            elif dn == "print" and any(dev(a) for a in node.args):
+                yield self.finding(
+                    ctx, node,
+                    "print() of a device value syncs the device on the "
+                    "hot path — jax.device_get first (or jax.debug.print "
+                    "in traced code)")
+            elif dn == "jax.block_until_ready" or (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "block_until_ready"):
+                yield self.finding(
+                    ctx, node,
+                    "block_until_ready() is an explicit device barrier: "
+                    "correct at a staging boundary, a stall anywhere "
+                    "else — if intentional, keep it under a justified "
+                    "`# graftlint: disable=RT021`")
+
+
+# =====================================================================
+# RT022: donation misuse (project rule)
+# =====================================================================
+
+
+class DonationMisuse(_JaxRule):
+    id = "RT022"
+    name = "donation-misuse"
+    rationale = ("donate_argnums hands the input buffer to XLA: reading "
+                 "the donated value after the call sees freed memory "
+                 "(or a runtime error); conversely an update-in-place "
+                 "call that rebinds through itself without donating "
+                 "pays a full extra buffer per step")
+
+    def finding_at(self, path: str, line: int, col: int,
+                   message: str) -> Finding:
+        return Finding(self.id, path, line, col, message)
+
+    # -- facts ---------------------------------------------------------
+
+    def collect_facts(self, ctx: ModuleContext) -> Dict[str, Any]:
+        names, attrs = _jit_bindings(ctx)
+        donors: List[Dict[str, Any]] = []
+        for pool in (names, attrs):
+            for name, info in pool.items():
+                if info.donate:  # literal, non-empty
+                    donors.append({"name": name,
+                                   "donate": list(info.donate),
+                                   "line": info.line})
+        nondonor = {name for pool in (names, attrs)
+                    for name, info in pool.items()
+                    if info.donate == []}
+        calls: List[Dict[str, Any]] = []
+        hints: List[Dict[str, Any]] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = self._callee_terminal(ctx, node)
+            if t is None:
+                continue
+            fn = ctx.enclosing_function(node)
+            rebound = self._rebound_targets(ctx, node)
+            for i, arg in enumerate(node.args):
+                text = self._arg_text(ctx, arg)
+                if text is None:
+                    continue
+                if text in rebound:
+                    # `x, ... = f(x, ...)`: the donated buffer is
+                    # replaced by the result — sanctioned update-in-place
+                    if t in nondonor and not _path_exempt(ctx.path):
+                        hints.append({"callee": t, "arg": text,
+                                      "line": node.lineno,
+                                      "col": node.col_offset})
+                    continue
+                read = self._read_after(ctx, fn, node, text)
+                if read is not None:
+                    calls.append({"callee": t, "pos": i, "arg": text,
+                                  "line": node.lineno,
+                                  "col": node.col_offset,
+                                  "read_line": read})
+        return {"donors": donors, "calls": calls, "hints": hints}
+
+    @staticmethod
+    def _callee_terminal(ctx: ModuleContext, call: ast.Call
+                         ) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Subscript):
+            return _self_attr_name(f.value)
+        dn = ctx.call_name(call)
+        if dn is not None:
+            return dn.split(".")[-1]
+        return _terminal(f)
+
+    @staticmethod
+    def _arg_text(ctx: ModuleContext, arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, ast.Name):
+            return arg.id
+        a = _self_attr_name(arg)
+        return f"self.{a}" if a else None
+
+    def _rebound_targets(self, ctx: ModuleContext,
+                         call: ast.Call) -> Set[str]:
+        """Texts of names/attrs assigned by the statement containing
+        the call (tuple targets flattened)."""
+        stmt = call
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        out: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for e in elts:
+                    text = self._arg_text(ctx, e)
+                    if text:
+                        out.add(text)
+        return out
+
+    def _read_after(self, ctx: ModuleContext, fn: Optional[ast.AST],
+                    call: ast.Call, text: str) -> Optional[int]:
+        """First line after the call where `text` is read again without
+        an intervening rebind; the call's own line when the call sits
+        in a loop (the next iteration re-passes a dead buffer)."""
+        if fn is None:
+            return None
+        end = getattr(call, "end_lineno", None) or call.lineno
+        rebind_line: Optional[int] = None
+        for node in ast.walk(fn):
+            if getattr(node, "lineno", 0) <= end:
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                        else [t]
+                    for e in elts:
+                        if self._arg_text(ctx, e) == text:
+                            if rebind_line is None \
+                                    or node.lineno < rebind_line:
+                                rebind_line = node.lineno
+        first_read: Optional[int] = None
+        for node in ast.walk(fn):
+            if getattr(node, "lineno", 0) <= end:
+                continue
+            if rebind_line is not None and node.lineno >= rebind_line:
+                continue
+            is_read = (isinstance(node, ast.Name) and node.id == text
+                       and isinstance(node.ctx, ast.Load))
+            if not is_read and text.startswith("self."):
+                a = _self_attr_name(node)
+                is_read = (a is not None and f"self.{a}" == text
+                           and isinstance(node.ctx, ast.Load))
+            if is_read and (first_read is None
+                            or node.lineno < first_read):
+                first_read = node.lineno
+        if first_read is not None:
+            return first_read
+        if ctx.loops_between(call):
+            return call.lineno
+        return None
+
+    # -- project analysis ---------------------------------------------
+
+    def project_check(self, facts: Dict[str, Dict[str, Any]]
+                      ) -> Iterator[Finding]:
+        donate_by_name: Dict[str, Set[int]] = {}
+        for fct in facts.values():
+            for d in (fct or {}).get("donors", []):
+                donate_by_name.setdefault(d["name"], set()).update(
+                    d["donate"])
+        for path, fct in facts.items():
+            for c in (fct or {}).get("calls", []):
+                positions = donate_by_name.get(c["callee"])
+                if positions is None or c["pos"] not in positions:
+                    continue
+                yield self.finding_at(
+                    path, c["read_line"], 0,
+                    f"'{c['arg']}' is read here after being passed at "
+                    f"donated position {c['pos']} of '{c['callee']}' "
+                    f"(line {c['line']}): donation hands the buffer to "
+                    f"XLA, so this read sees freed memory — use the "
+                    f"returned value, rebind the name, or drop the "
+                    f"position from donate_argnums")
+            for h in (fct or {}).get("hints", []):
+                yield self.finding_at(
+                    path, h["line"], h["col"],
+                    f"hint: '{h['callee']}' rebinds '{h['arg']}' "
+                    f"through itself without donate_argnums — donating "
+                    f"the position lets XLA reuse the buffer for the "
+                    f"update instead of allocating a fresh one per "
+                    f"step (gate by backend: CPU does not donate)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self.project_check({ctx.path: self.collect_facts(ctx)})
+
+
+# =====================================================================
+# RT023: leak on raise (project rule)
+# =====================================================================
+
+
+class _Acq:
+    __slots__ = ("kind", "line", "col", "risks", "helpers", "close_idx")
+
+    def __init__(self, kind: str, line: int, col: int):
+        self.kind = kind
+        self.line = line
+        self.col = col
+        self.risks: List[Dict[str, Any]] = []
+        self.helpers: List[Dict[str, Any]] = []
+        self.close_idx: Optional[int] = None
+
+
+class LeakOnRaise(_JaxRule):
+    id = "RT023"
+    name = "leak-on-raise"
+    rationale = ("an acquired pin/lease/slot/actor whose matching "
+                 "release is not reached on an exception edge leaks the "
+                 "resource for the owner's lifetime — the bug class the "
+                 "ownership chaos fuzzer keeps re-finding; releases "
+                 "belong in try/finally, a context manager, or an "
+                 "except branch that re-raises")
+
+    def finding_at(self, path: str, line: int, col: int,
+                   message: str) -> Finding:
+        return Finding(self.id, path, line, col, message)
+
+    # -- facts ---------------------------------------------------------
+
+    def collect_facts(self, ctx: ModuleContext) -> Dict[str, Any]:
+        releases: Dict[str, List[str]] = {}
+        records: List[Dict[str, Any]] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            kinds = sorted({_RELEASE_KIND[t] for t in
+                            self._called_terminals(fn)
+                            if t in _RELEASE_KIND})
+            if kinds:
+                releases.setdefault(fn.name, [])
+                for k in kinds:
+                    if k not in releases[fn.name]:
+                        releases[fn.name].append(k)
+            records.extend(self._scan_fn(ctx, fn))
+        return {"releases": releases, "records": records}
+
+    @staticmethod
+    def _called_terminals(fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                t = _terminal(node.func)
+                if t:
+                    out.add(t)
+        return out
+
+    # -- per-function event scan --------------------------------------
+
+    def _scan_fn(self, ctx: ModuleContext, fn: ast.AST
+                 ) -> List[Dict[str, Any]]:
+        setup = fn.name in _SETUP_FN_NAMES
+        seq = [0]
+        open_acqs: List[_Acq] = []
+        records: List[_Acq] = []
+
+        def acquire_kind(call: ast.Call) -> Optional[str]:
+            t = _terminal(call.func)
+            kind = _ACQUIRE_KIND.get(t or "")
+            if kind == "actor" and not setup:
+                return None
+            return kind
+
+        def release_event(kind: str) -> None:
+            for acq in reversed(open_acqs):
+                if acq.kind == kind and acq.close_idx is None:
+                    acq.close_idx = seq[0]
+                    open_acqs.remove(acq)
+                    records.append(acq)
+                    return
+
+        def risk_event(protectors: frozenset, ckinds: frozenset,
+                       line: int) -> None:
+            for acq in open_acqs:
+                if acq.kind in ckinds:
+                    continue
+                acq.risks.append({"idx": seq[0], "line": line,
+                                  "protectors": sorted(protectors)})
+
+        def helper_event(name: str, line: int) -> None:
+            for acq in open_acqs:
+                acq.helpers.append({"idx": seq[0], "name": name,
+                                    "line": line})
+
+        def leaf(node: ast.AST, ckinds: frozenset, chelpers: frozenset,
+                 managed: bool = False) -> None:
+            events: List[Tuple[int, int, str, Any]] = []
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call):
+                    t = _terminal(n.func)
+                    if t in _RELEASE_KIND:
+                        events.append((n.lineno, n.col_offset,
+                                       "release", _RELEASE_KIND[t]))
+                    elif acquire_kind(n):
+                        events.append((n.lineno, n.col_offset,
+                                       "acquire", n))
+                    elif t:
+                        events.append((n.lineno, n.col_offset,
+                                       "call", t))
+                    else:
+                        events.append((n.lineno, n.col_offset,
+                                       "call", "<dynamic>"))
+                elif isinstance(n, (ast.Name, ast.Attribute)) \
+                        and isinstance(getattr(n, "ctx", None), ast.Load):
+                    # a release method handed off as a callback
+                    # (`release_cb=self._release`) transfers release
+                    # responsibility to the callee
+                    t = _terminal(n)
+                    parent = ctx.parent(n)
+                    is_func = isinstance(parent, ast.Call) \
+                        and parent.func is n
+                    if t in _RELEASE_KIND and not is_func:
+                        events.append((n.lineno, n.col_offset,
+                                       "release", _RELEASE_KIND[t]))
+                elif isinstance(n, ast.Raise):
+                    events.append((n.lineno, n.col_offset, "raise", None))
+            for line, _col, ev, payload in sorted(
+                    events, key=lambda e: (e[0], e[1])):
+                seq[0] += 1
+                if ev == "release":
+                    release_event(payload)
+                elif ev == "acquire":
+                    if managed:
+                        continue
+                    call = payload
+                    open_acqs.append(_Acq(acquire_kind(call),
+                                          call.lineno, call.col_offset))
+                elif ev == "call":
+                    risk_event(chelpers, ckinds, line)
+                    helper_event(payload, line)
+                elif ev == "raise":
+                    risk_event(chelpers, ckinds, line)
+
+        def protection(tr: ast.Try) -> Tuple[frozenset, frozenset]:
+            kinds: Set[str] = set()
+            helpers: Set[str] = set()
+            bodies = list(tr.finalbody)
+            for h in tr.handlers:
+                bodies.extend(h.body)
+            for st in bodies:
+                for n in ast.walk(st):
+                    if isinstance(n, ast.Call):
+                        t = _terminal(n.func)
+                        if t in _RELEASE_KIND:
+                            kinds.add(_RELEASE_KIND[t])
+                        elif t:
+                            helpers.add(t)
+            return frozenset(kinds), frozenset(helpers)
+
+        def scan(stmts: List[ast.stmt], ckinds: frozenset,
+                 chelpers: frozenset) -> None:
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Try) or \
+                        st.__class__.__name__ == "TryStar":
+                    pk, ph = protection(st)
+                    scan(st.body, ckinds | pk, chelpers | ph)
+                    for h in st.handlers:
+                        scan(h.body, ckinds, chelpers)
+                    scan(st.orelse, ckinds, chelpers)
+                    scan(st.finalbody, ckinds, chelpers)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    for item in st.items:
+                        leaf(item.context_expr, ckinds, chelpers,
+                             managed=True)
+                    scan(st.body, ckinds, chelpers)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    leaf(st.iter, ckinds, chelpers)
+                    scan(st.body, ckinds, chelpers)
+                    scan(st.orelse, ckinds, chelpers)
+                elif isinstance(st, (ast.While, ast.If)):
+                    leaf(st.test, ckinds, chelpers)
+                    scan(st.body, ckinds, chelpers)
+                    scan(st.orelse, ckinds, chelpers)
+                else:
+                    leaf(st, ckinds, chelpers)
+
+        scan(fn.body, frozenset(), frozenset())
+        records.extend(open_acqs)
+        out = []
+        for acq in records:
+            out.append({"kind": acq.kind, "line": acq.line,
+                        "col": acq.col, "fn": fn.name,
+                        "risks": acq.risks, "helpers": acq.helpers,
+                        "close_idx": acq.close_idx})
+        return out
+
+    # -- project analysis ---------------------------------------------
+
+    def project_check(self, facts: Dict[str, Dict[str, Any]]
+                      ) -> Iterator[Finding]:
+        rel_by_fn: Dict[str, Set[str]] = {}
+        for fct in facts.values():
+            for name, kinds in (fct or {}).get("releases", {}).items():
+                rel_by_fn.setdefault(name, set()).update(kinds)
+
+        def releases(name: str, kind: str) -> bool:
+            if _RELEASE_KIND.get(name) == kind:
+                return True
+            return kind in rel_by_fn.get(name, set())
+
+        for path, fct in facts.items():
+            for rec in (fct or {}).get("records", []):
+                kind = rec["kind"]
+                cutoff = rec["close_idx"]
+                if cutoff is None:
+                    rel_helpers = [h for h in rec["helpers"]
+                                   if releases(h["name"], kind)]
+                    if not rel_helpers:
+                        # no matching release in reach: the resource is
+                        # lifecycle-managed or ownership moved elsewhere
+                        continue
+                    cutoff = rel_helpers[0]["idx"]
+                risky = [r for r in rec["risks"]
+                         if r["idx"] < cutoff
+                         and not any(releases(p, kind)
+                                     for p in r["protectors"])]
+                if not risky:
+                    continue
+                first = risky[0]
+                yield self.finding_at(
+                    path, rec["line"], rec["col"],
+                    f"'{kind}' resource acquired in '{rec['fn']}' can "
+                    f"leak: the statement at line {first['line']} can "
+                    f"raise before the matching release runs — move "
+                    f"the release into try/finally or a context "
+                    f"manager, or release in an except branch before "
+                    f"re-raising")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self.project_check({ctx.path: self.collect_facts(ctx)})

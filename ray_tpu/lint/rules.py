@@ -1,6 +1,6 @@
-"""graftlint rule set: 19 framework-aware checks.
+"""graftlint rule set: 23 framework-aware checks.
 
-Each rule has a stable id (RT001..RT019), a one-line rationale, and a
+Each rule has a stable id (RT001..RT023), a one-line rationale, and a
 `check(ctx)` generator yielding Findings. Rules are deliberately
 conservative: a finding should be actionable, and intentional
 exceptions are silenced in-place with `# graftlint: disable=RTxxx`
@@ -1000,6 +1000,11 @@ class BlockingCallInAsync(Rule):
 # in its own module; the rules plug into the same catalogue.
 from ray_tpu.lint.concurrency import (BlockingUnderLock,  # noqa: E402
                                       LockOrderCycle, MixedGuardAccess)
+# JAX/XLA hot-path layer (recompile hazards, hidden syncs, donation,
+# leak-on-raise) — the static half of the jax_sentinel pairing.
+from ray_tpu.lint.jaxrules import (DonationMisuse,  # noqa: E402
+                                   HiddenHostSync, LeakOnRaise,
+                                   RecompileHazard)
 
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
@@ -1009,6 +1014,8 @@ ALL_RULES: List[Rule] = [
     SilentExceptionSwallow(), MixedGuardAccess(), BlockingUnderLock(),
     LockOrderCycle(), UnboundedWaitInServingPath(),
     OwnershipBookkeepingDiscipline(), BlockingCallInAsync(),
+    RecompileHazard(), HiddenHostSync(), DonationMisuse(),
+    LeakOnRaise(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -138,6 +138,7 @@ def make_train_step(
     _cache: Dict[Any, Callable] = {}
 
     def train_step(state, batch):
+        from ray_tpu.util import jax_sentinel
         key = jax.tree.structure(state)
         fn = _cache.get(key)
         if fn is None:
@@ -148,6 +149,7 @@ def make_train_step(
                 out_shardings=(state_shardings, None),
                 donate_argnums=(0,) if donate else ())
             _cache[key] = fn
-        return fn(state, batch)
+        with jax_sentinel.step_region("train.step"):
+            return fn(state, batch)
 
     return init_state, train_step

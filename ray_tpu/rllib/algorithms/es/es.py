@@ -79,11 +79,15 @@ class ESEvalWorker:
 
     def _episode_return(self, flat_params: np.ndarray,
                         ep_seed: int) -> Tuple[float, int]:
+        import jax
+
         params = self._unravel(flat_params)
         obs, _ = self.env.reset(ep_seed)
         total, steps = 0.0, 0
         for _ in range(self.horizon):
-            action = np.asarray(self._infer(params, np.asarray(obs)))
+            # device_get, not np.asarray: the one sanctioned sync in
+            # the per-step rollout loop
+            action = jax.device_get(self._infer(params, np.asarray(obs)))
             obs, r, term, trunc, _ = self.env.step(action)
             total += float(r)
             steps += 1
@@ -112,14 +116,17 @@ class ES(Algorithm):
 
     def __init__(self, config: "ESConfig"):
         super().__init__(config)
+        import jax
         from jax.flatten_util import ravel_pytree
         import optax
 
         weights = self.learner_group.get_weights()
         flat, self._unravel = ravel_pytree(weights)
         # float32 throughout: jax canonicalizes f64 away (x64 off), so
-        # a wider accumulator here would be silently downcast anyway
-        self._theta = np.asarray(flat, np.float32)
+        # a wider accumulator here would be silently downcast anyway;
+        # theta lives on the host (numpy optimizer loop), so force the
+        # flattened weights across explicitly once
+        self._theta = np.asarray(jax.device_get(flat), np.float32)
         self.dim = self._theta.shape[0]
         self._opt = optax.adam(config.lr)
         self._opt_state = self._opt.init(self._theta)

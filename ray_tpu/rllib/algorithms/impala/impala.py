@@ -205,7 +205,9 @@ class Impala(Algorithm):
                 continue
             try:
                 t0 = _time.perf_counter()
-                with _spans.span("learner.step", steps=steps):
+                from ray_tpu.util import jax_sentinel
+                with _spans.span("learner.step", steps=steps), \
+                        jax_sentinel.step_region("learner.step"):
                     stats = self.learner_group.update(batch)
                 if self._feed is not None:
                     self._feed.add_busy(_time.perf_counter() - t0)

@@ -50,7 +50,11 @@ class PolyakTargetLearner(Learner):
                 lambda t, p: (1.0 - tau) * t + tau * p, target,
                 self._target_subtree(params))
 
-        self._polyak = jax.jit(polyak)
+        # donate the old target: the update rebinds self._target to the
+        # result, so XLA can reuse the MB-scale buffer in place instead
+        # of allocating a fresh tree per update (CPU does not donate)
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        self._polyak = jax.jit(polyak, donate_argnums=donate)
 
     def extra_inputs(self) -> Dict[str, Any]:
         import jax

@@ -160,9 +160,12 @@ class SingleAgentEnvRunner:
             if not self._ma:
                 out = self._explore(self.params, obs, key,
                                     self._explore_inputs)
-                return (np.asarray(out["actions"]),
-                        np.asarray(out["action_logp"]),
-                        np.asarray(out["vf_preds"]))
+                # one forcing point instead of three per-field syncs:
+                # device_get batches the reads into a single blocking
+                # transfer per sampled step
+                return jax.device_get((out["actions"],
+                                       out["action_logp"],
+                                       out["vf_preds"]))
             n = obs.shape[0]
             keys = jax.random.split(key, len(self._module_order))
             actions = None
@@ -172,21 +175,28 @@ class SingleAgentEnvRunner:
                 rows = self._lanes_by_module[mid]
                 out = self._explore_m[mid](self.params[mid], obs[rows],
                                            k, self._explore_inputs)
-                a = np.asarray(out["actions"])
+                # single forcing point per module (not per field)
+                a, lp, v = jax.device_get((out["actions"],
+                                           out["action_logp"],
+                                           out["vf_preds"]))
                 if actions is None:
                     actions = np.zeros((n,) + a.shape[1:], a.dtype)
                 actions[rows] = a
-                logp[rows] = np.asarray(out["action_logp"])
-                vf[rows] = np.asarray(out["vf_preds"])
+                logp[rows] = lp
+                vf[rows] = v
             return actions, logp, vf
 
     def _forward_value(self, obs, lanes=None):
         """V(obs) rows; `lanes` maps each row to its vector lane (for
         module routing when rows are a subset, e.g. truncation
         bootstraps). Defaults to row i == lane i."""
+        import jax
+
         with self._on_cpu():
             if not self._ma:
-                return np.asarray(self._value_only(self.params, obs))
+                # device_get, not np.asarray: the sanctioned forcing
+                # point for the per-step bootstrap read
+                return jax.device_get(self._value_only(self.params, obs))
             if lanes is None:
                 lanes = np.arange(obs.shape[0])
             vf = np.zeros(obs.shape[0], np.float32)
@@ -195,7 +205,7 @@ class SingleAgentEnvRunner:
                 rows = np.array([i for i, m in enumerate(mods)
                                  if m == mid], np.int64)
                 if rows.size:
-                    vf[rows] = np.asarray(
+                    vf[rows] = jax.device_get(
                         self._value_m[mid](self.params[mid], obs[rows]))
             return vf
 

@@ -38,6 +38,10 @@ class SyncVectorEnv:
         episode's reset obs and final_obs[i] holds the true terminal
         observation (needed for correct value bootstrapping on
         truncation)."""
+        # coerce once up front: a device-resident actions array handed
+        # in here would otherwise pay one device->host sync per lane per
+        # step inside the loop (each env coerces its scalar lane)
+        actions = np.asarray(actions)
         obs, rewards, terms, truncs, infos = [], [], [], [], []
         final_obs = [None] * self.num_envs
         for i, (e, a) in enumerate(zip(self.envs, actions)):

@@ -133,23 +133,30 @@ class HostStage:
             plans.append((k, dt, totals.get(dt, 0), tuple(shape), axis))
             totals[dt] = totals.get(dt, 0) + n
         slot = self._acquire()
-        segments: Dict[str, np.ndarray] = {}
-        for dt, n in totals.items():
-            buf = slot.get(dt)
-            if buf is None or buf.size < n:
-                buf = np.empty(max(n, 1), dtype=np.dtype(dt))
-            segments[dt] = buf
-        layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]] = {}
-        for k, dt, off, shape, axis in plans:
-            n = int(np.prod(shape))
-            dest = segments[dt][off:off + n].reshape(shape)
-            parts = [np.asarray(f[k]) for f in frags]
-            if len(parts) == 1:
-                np.copyto(dest, parts[0])
-            else:
-                np.concatenate(parts, axis=axis, out=dest)
-            layout[k] = (dt, off, n, shape)
-            self.bytes_staged += dest.nbytes
+        try:
+            segments: Dict[str, np.ndarray] = {}
+            for dt, n in totals.items():
+                buf = slot.get(dt)
+                if buf is None or buf.size < n:
+                    buf = np.empty(max(n, 1), dtype=np.dtype(dt))
+                segments[dt] = buf
+            layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]] = {}
+            for k, dt, off, shape, axis in plans:
+                n = int(np.prod(shape))
+                dest = segments[dt][off:off + n].reshape(shape)
+                parts = [np.asarray(f[k]) for f in frags]
+                if len(parts) == 1:
+                    np.copyto(dest, parts[0])
+                else:
+                    np.concatenate(parts, axis=axis, out=dest)
+                layout[k] = (dt, off, n, shape)
+                self.bytes_staged += dest.nbytes
+        except BaseException:
+            # the StagedBatch below takes slot ownership; until then a
+            # failed assembly (mismatched frag shape/dtype) must hand
+            # the slot back or the stage permanently loses capacity
+            self._release(slot)
+            raise
         return StagedBatch(segments, layout, release_cb=self._release)
 
 
@@ -220,21 +227,29 @@ class DeviceFeed:
         import jax
         if isinstance(batch, StagedBatch):
             nbytes = batch.nbytes
-            with _spans.span("feed.ship", bytes=nbytes, fused=True):
-                segs = {dt: jax.device_put(seg)
-                        for dt, seg in sorted(batch.segments.items())}
-                # the transfer must land before the slot is reused
-                jax.block_until_ready(list(segs.values()))
-            sig = tuple((k, dt, off, n, shape) for k, (dt, off, n, shape)
-                        in sorted(batch.layout.items()))
-            with _spans.span("feed.unfuse"):
-                dev = self._unfuse_fn(sig)(segs)
-            batch.release()
+            try:
+                with _spans.span("feed.ship", bytes=nbytes, fused=True):
+                    segs = {dt: jax.device_put(seg)
+                            for dt, seg in sorted(batch.segments.items())}
+                    # intentional barrier: the transfer must land before
+                    # the slot is reused # graftlint: disable=RT021
+                    jax.block_until_ready(list(segs.values()))
+                sig = tuple((k, dt, off, n, shape)
+                            for k, (dt, off, n, shape)
+                            in sorted(batch.layout.items()))
+                with _spans.span("feed.unfuse"):
+                    dev = self._unfuse_fn(sig)(segs)
+            finally:
+                # a failed device_put/unfuse must still return the slot
+                # to the stage, or the feed wedges once slots run out
+                batch.release()
             self.fused_batches += 1
             return dev, nbytes
         with _spans.span("feed.ship", fused=False) as _sp:
             dev = jax.device_put(batch)
-            jax.block_until_ready(dev)
+            # intentional barrier: ship measures landed-transfer time,
+            # and nbytes reads need materialized leaves
+            jax.block_until_ready(dev)  # graftlint: disable=RT021
             nbytes = sum(getattr(v, "nbytes", 0)
                          for v in jax.tree_util.tree_leaves(dev))
             _sp["bytes"] = nbytes
@@ -286,7 +301,9 @@ class DeviceFeed:
                 raise
             t1 = time.perf_counter()
             with _spans.span("feed.xfer"):
-                jax.block_until_ready(dev)
+                # intentional barrier: xfer_s attributes residual
+                # transfer time to the consumer-visible wait
+                jax.block_until_ready(dev)  # graftlint: disable=RT021
             t2 = time.perf_counter()
         self.wait_s += t2 - t0
         self.xfer_s += t2 - t1

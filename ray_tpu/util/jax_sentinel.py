@@ -1,0 +1,348 @@
+"""Always-on JAX recompile/transfer sentinel.
+
+Runtime half of the graftlint XLA hot-path pairing (lint/jaxrules.py is
+the static half): the lint rules catch the hazards visible in source —
+recompile-prone call shapes (RT020), hidden device→host syncs (RT021),
+donation misuse (RT022) — and this module catches the ones only the
+live process can see, exporting them through the per-process metrics
+registry so they ride the cluster harvest onto /metrics and the
+watchdog's `jit_recompile_storm` / `unexpected_host_transfer` probes.
+
+Two signals:
+
+  - **compiles** — `jax.monitoring`'s backend-compile duration event
+    fires exactly once per real XLA compilation (silent on cache-warm
+    dispatches), so counting it per step-region label splits clean
+    warmup (`kind="first"`) from the steady-state recompiles that mean
+    a shape/static-arg hazard slipped through (`kind="recompile"`):
+        ray_tpu_jit_compiles_total{fn=<region>, kind=first|recompile}
+  - **host transfers** — the Python-level forcing points on jax arrays
+    (`.item()`, `__array__`/np coercion, `__float__`/`__int__`/
+    `__bool__`) and `jax.device_get` are patched to account the bytes
+    they pull across, tagged by step region:
+        ray_tpu_host_transfer_bytes_total{region=<region>}
+    Inside a region each forcing point also records a flight-recorder
+    span (`host_sync.<via>`) whose duration is the actual blocked wall
+    time, so `tools/perf_report.py` can attribute step time stalled on
+    syncs. As an escalation, RAY_TPU_JAX_SENTINEL_GUARD=log|disallow
+    additionally applies jax's device→host transfer guard for the
+    region scope — "log" names every transfer source C++-side,
+    "disallow" turns hidden syncs into hard errors at the offending
+    line. Off by default: the guard logs the *sanctioned* forcing
+    points too, and one warning per update is operator spam.
+
+Scoping: training loops wrap their step in `step_region(name)` —
+Learner.update, IMPALA's learner loop, and the sharded train_step
+factory already do. Transfers outside any region account under
+region="untracked" and are never judged by the watchdog; transfers
+INSIDE a region are presumed-bad (the lint rules enforce that hot
+paths sync at one sanctioned forcing point) and alert once their
+per-harvest delta crosses `Config.watchdog_host_transfer_bytes`.
+
+Off switch: RAY_TPU_JAX_SENTINEL=0 makes install() refuse and
+step_region() return a shared no-op — nothing is patched, no listener
+registered, call sites pay one flag check. Installation is lazy and
+idempotent; importing this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from time import perf_counter
+from typing import Any, Dict, Optional
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+SNAPSHOT_KEY = "jax_sentinel"
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+_installed = False
+_listener_registered = False
+
+# region label -> lifetime compile count (splits first vs recompile)
+_compiles: Dict[str, int] = {}
+
+_compile_counter: Any = None
+_xfer_counter: Any = None
+
+_orig: Dict[str, Any] = {}
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_JAX_SENTINEL", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+def installed() -> bool:
+    return _installed
+
+
+def current_region() -> Optional[str]:
+    stack = getattr(_tls, "regions", None)
+    return stack[-1] if stack else None
+
+
+# ---------------------------------------------------------------------
+# Accounting funnel
+# ---------------------------------------------------------------------
+
+
+def _account(nbytes: int, via: str, t0: float) -> None:
+    """One observed device→host transfer: count the bytes against the
+    current step region, and inside a region also record the blocked
+    wall time as a host_sync span for perf_report's stall buckets."""
+    if not _installed:
+        return
+    try:
+        region = current_region()
+        _xfer_counter.inc(float(max(0, nbytes)),
+                          tags={"region": region or "untracked"})
+        if region is not None:
+            from ray_tpu._private import spans as _spans
+            _spans.end(f"host_sync.{via}", t0,
+                       bytes=int(nbytes), region=region)
+    except Exception:  # noqa: BLE001 - accounting must never break the
+        pass           # transfer it observes
+
+
+def _in_xfer() -> bool:
+    return getattr(_tls, "in_xfer", False)
+
+
+def _on_event_duration(event: str, duration: float,
+                       **_kw: Any) -> None:
+    """jax.monitoring listener: fires once per real backend compile
+    (warm cache hits are silent), on the dispatching thread — so the
+    thread-local region label attributes it. The listener stays
+    registered for the process lifetime; _installed gates its body."""
+    if event != COMPILE_EVENT or not _installed:
+        return
+    try:
+        fn = current_region() or "untracked"
+        with _lock:
+            n = _compiles.get(fn, 0)
+            _compiles[fn] = n + 1
+        _compile_counter.inc(
+            1.0, tags={"fn": fn,
+                       "kind": "first" if n == 0 else "recompile"})
+    except Exception:  # noqa: BLE001 - telemetry is best-effort
+        pass
+
+
+def _snapshot_extra() -> Dict[str, Any]:
+    """Rides every metrics harvest: which regions this process has
+    compiled under (the watchdog's storm probe names them; operators
+    grep it from `ray_tpu metrics dump`)."""
+    with _lock:
+        return {"installed": _installed, "compiles": dict(_compiles)}
+
+
+# ---------------------------------------------------------------------
+# Install / uninstall
+# ---------------------------------------------------------------------
+
+
+def install() -> bool:
+    """Idempotent lazy install: metrics, compile listener, and the
+    ArrayImpl/device_get transfer funnel. Returns False (and patches
+    nothing) when RAY_TPU_JAX_SENTINEL=0 or jax is unavailable."""
+    global _installed, _listener_registered
+    global _compile_counter, _xfer_counter
+    if _installed:
+        return True
+    if not enabled():
+        return False
+    with _lock:
+        if _installed:
+            return True
+        try:
+            import jax
+            import jax.monitoring
+            from jaxlib.xla_extension import ArrayImpl
+        except Exception:  # noqa: BLE001 - no jax in this process
+            return False
+        from ray_tpu._private import metrics_plane
+        from ray_tpu.util.metrics import Counter, get_or_create
+        _compile_counter = get_or_create(
+            Counter, "ray_tpu_jit_compiles_total",
+            description="XLA backend compiles by step-region label; "
+                        "kind=first is warmup, kind=recompile means a "
+                        "recompile hazard (see graftlint RT020)",
+            tag_keys=("fn", "kind"))
+        _xfer_counter = get_or_create(
+            Counter, "ray_tpu_host_transfer_bytes_total",
+            description="device->host bytes forced through jax array "
+                        "coercions and jax.device_get, by step region "
+                        "(region=untracked outside step_region scopes; "
+                        "see graftlint RT021)",
+            tag_keys=("region",))
+        if not _listener_registered:
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+            _listener_registered = True
+        metrics_plane.register_snapshot_extra(
+            SNAPSHOT_KEY, _snapshot_extra)
+
+        # -- transfer funnel: ArrayImpl coercions + jax.device_get.
+        # block_until_ready and the buffer protocol live in C++ and
+        # can't be wrapped from Python; every *coercing* forcing point
+        # goes through one of these.
+        _orig["item"] = ArrayImpl.item
+        _orig["__array__"] = ArrayImpl.__array__
+        _orig["__float__"] = ArrayImpl.__float__
+        _orig["__int__"] = ArrayImpl.__int__
+        _orig["__bool__"] = ArrayImpl.__bool__
+        _orig["device_get"] = jax.device_get
+
+        def item(self, *a):
+            t0 = perf_counter()
+            out = _orig["item"](self, *a)
+            if not _in_xfer():
+                _account(getattr(self, "nbytes", 0), "item", t0)
+            return out
+
+        def __array__(self, *a, **kw):
+            t0 = perf_counter()
+            out = _orig["__array__"](self, *a, **kw)
+            if not _in_xfer():
+                _account(getattr(self, "nbytes", 0), "asarray", t0)
+            return out
+
+        def _scalar(name: str):
+            orig = _orig[name]
+
+            def coerce(self):
+                t0 = perf_counter()
+                out = orig(self)
+                if not _in_xfer():
+                    _account(getattr(self, "nbytes", 0),
+                             name.strip("_"), t0)
+                return out
+            coerce.__name__ = name
+            return coerce
+
+        def device_get(x):
+            # reentrancy guard: device_get coerces each leaf through
+            # __array__ — one accounted transfer, not two
+            if _in_xfer():
+                return _orig["device_get"](x)
+            _tls.in_xfer = True
+            t0 = perf_counter()
+            try:
+                out = _orig["device_get"](x)
+            finally:
+                _tls.in_xfer = False
+            try:
+                total = sum(getattr(leaf, "nbytes", 0)
+                            for leaf in jax.tree_util.tree_leaves(x))
+            except Exception:  # noqa: BLE001 - odd pytree
+                total = 0
+            _account(total, "device_get", t0)
+            return out
+
+        ArrayImpl.item = item
+        ArrayImpl.__array__ = __array__
+        ArrayImpl.__float__ = _scalar("__float__")
+        ArrayImpl.__int__ = _scalar("__int__")
+        ArrayImpl.__bool__ = _scalar("__bool__")
+        jax.device_get = device_get
+        _installed = True
+        return True
+
+
+def uninstall() -> None:
+    """Restore the patched forcing points (tests). The monitoring
+    listener stays registered — _installed gates its body — so a later
+    install() never double-registers."""
+    global _installed
+    with _lock:
+        if not _installed:
+            return
+        import jax
+        from jaxlib.xla_extension import ArrayImpl
+        from ray_tpu._private import metrics_plane
+        ArrayImpl.item = _orig["item"]
+        ArrayImpl.__array__ = _orig["__array__"]
+        ArrayImpl.__float__ = _orig["__float__"]
+        ArrayImpl.__int__ = _orig["__int__"]
+        ArrayImpl.__bool__ = _orig["__bool__"]
+        jax.device_get = _orig["device_get"]
+        metrics_plane.unregister_snapshot_extra(SNAPSHOT_KEY)
+        _installed = False
+
+
+# ---------------------------------------------------------------------
+# Step regions
+# ---------------------------------------------------------------------
+
+
+class _NoopRegion:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+NOOP = _NoopRegion()
+
+
+def _guard_mode() -> Optional[str]:
+    mode = os.environ.get("RAY_TPU_JAX_SENTINEL_GUARD", "").lower()
+    return mode if mode in ("log", "disallow") else None
+
+
+class _StepRegion:
+    """Labels compiles/transfers on this thread with `name`; with
+    RAY_TPU_JAX_SENTINEL_GUARD set, also applies jax's device→host
+    transfer guard for the scope. Regions nest; the innermost label
+    wins (a learner.update inside an IMPALA learner.step attributes
+    to learner.update)."""
+
+    __slots__ = ("name", "_tg")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._tg = None
+
+    def __enter__(self):
+        stack = getattr(_tls, "regions", None)
+        if stack is None:
+            stack = _tls.regions = []
+        stack.append(self.name)
+        mode = _guard_mode()
+        if mode is not None:
+            try:
+                import jax
+                self._tg = jax.transfer_guard_device_to_host(mode)
+                self._tg.__enter__()
+            except Exception:  # noqa: BLE001 - the guard is advisory:
+                # a jax too old for per-direction guards still gets
+                # the Python-side accounting, just not the XLA log
+                self._tg = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._tg is not None:
+            try:
+                self._tg.__exit__(*exc if exc else (None, None, None))
+            except Exception:  # noqa: BLE001 - a failed guard restore
+                pass           # must not mask the region body's result
+        stack = getattr(_tls, "regions", None)
+        if stack:
+            stack.pop()
+        return None
+
+
+def step_region(name: str):
+    """Context manager marking a hot training-step scope. First use
+    installs the sentinel (lazy); with RAY_TPU_JAX_SENTINEL=0 this is
+    a shared no-op and nothing is ever patched."""
+    if not install():
+        return NOOP
+    return _StepRegion(name)

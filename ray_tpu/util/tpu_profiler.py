@@ -75,7 +75,8 @@ def profile_step(fn, *args, log_dir: Optional[str] = None, **kwargs):
         "/tmp", f"ray_tpu_prof_{int(time.time())}")
     with trace(log_dir):
         out = fn(*args, **kwargs)
-        # block so device work lands inside the trace window
+        # intentional barrier: the trace window must include device
+        # completion, or the profile under-reports the step
         import jax
-        jax.block_until_ready(out)
+        jax.block_until_ready(out)  # graftlint: disable=RT021
     return out, log_dir

@@ -1,0 +1,304 @@
+"""jax sentinel (util/jax_sentinel.py): compile counters, transfer
+accounting, the watchdog's storm/transfer probes, and the off switch.
+
+The sentinel is the runtime half of the graftlint RT020/RT021 pairing:
+what the lint rules can't prove statically (a recompile per step, bytes
+forced device→host inside a step region) shows up here as metric deltas
+the watchdog judges within two harvest intervals.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private import metrics_plane as mp
+from ray_tpu._private import spans
+from ray_tpu.util import jax_sentinel
+from ray_tpu.util import metrics as um
+
+
+def _series(name):
+    """{sorted-tag-tuple: value} for one metric from the process
+    registry (counters accumulate across tests — assert deltas)."""
+    out = {}
+    for m in um.collect_wire():
+        if m["name"] != name:
+            continue
+        for s in m["series"]:
+            out[tuple(sorted(s["tags"].items()))] = s["value"]
+    return out
+
+
+def _flat_series():
+    """collect_wire() flattened to the harvest's `name{k=v,...}` keys
+    (same shape Watchdog.evaluate receives from the cluster merge)."""
+    out = {}
+    for m in um.collect_wire():
+        for s in m["series"]:
+            if "value" not in s:
+                continue  # histogram bucket rows
+            tags = ",".join(f"{k}={v}"
+                            for k, v in sorted(s["tags"].items()))
+            out[f"{m['name']}{{{tags}}}" if tags else m["name"]] = \
+                s["value"]
+    return out
+
+
+# ---- watchdog probes (no jax needed) ---------------------------------------
+
+
+def _make_watchdog(events, **kw):
+    kw.setdefault("jit_recompiles", 3)
+    kw.setdefault("jit_recompile_warmup_s", 0.0)
+    kw.setdefault("host_transfer_bytes", 100.0)
+    return mp.Watchdog(
+        emit=lambda et, msg, severity="INFO", **f:
+            events.append((et, msg, severity, f)),
+        cooldown_s=0.0, wait_edge_age_s=600.0,
+        store_occupancy_frac=0.95, queue_depth=1000, **kw)
+
+
+def _alerts(events, probe):
+    return [(m, s, f) for _t, m, s, f in events
+            if f.get("probe") == probe]
+
+
+def test_watchdog_recompile_storm_within_two_harvests():
+    events = []
+    wd = _make_watchdog(events)
+    key = "ray_tpu_jit_compiles_total{fn=learner.update,kind=recompile}"
+    wd.evaluate([], {key: 5.0}, [], interval_s=0.01)  # baseline round
+    assert not _alerts(events, "jit_recompile_storm")
+    wd.evaluate([], {key: 9.0}, [], interval_s=0.01)  # delta 4 >= 3
+    alerts = _alerts(events, "jit_recompile_storm")
+    assert len(alerts) == 1
+    msg, severity, fields = alerts[0]
+    assert severity == "ERROR"
+    assert fields["fn"] == "learner.update"
+    assert fields["value"] == 4.0
+    assert "RT020" in msg
+
+
+def test_watchdog_recompile_probe_skips_untracked_first_and_small():
+    events = []
+    wd = _make_watchdog(events)
+    series = {
+        # outside any step region: by definition not a hot path
+        "ray_tpu_jit_compiles_total{fn=untracked,kind=recompile}": 0.0,
+        # warmup compiles are the expected cost of a cold start
+        "ray_tpu_jit_compiles_total{fn=learner.update,kind=first}": 0.0,
+        # below the per-window threshold
+        "ray_tpu_jit_compiles_total{fn=train.step,kind=recompile}": 0.0,
+    }
+    wd.evaluate([], series, [], interval_s=0.01)
+    bumped = {k: v + (10.0 if "untracked" in k or "first" in k else 2.0)
+              for k, v in series.items()}
+    wd.evaluate([], bumped, [], interval_s=0.01)
+    assert not _alerts(events, "jit_recompile_storm")
+
+
+def test_watchdog_recompile_probe_warmup_grace():
+    """A label inside its warmup window never storms: cold starts
+    legitimately compile several modules under one region label."""
+    events = []
+    wd = _make_watchdog(events, jit_recompile_warmup_s=600.0)
+    key = "ray_tpu_jit_compiles_total{fn=learner.update,kind=recompile}"
+    wd.evaluate([], {key: 0.0}, [], interval_s=0.01)
+    wd.evaluate([], {key: 50.0}, [], interval_s=0.01)
+    assert not _alerts(events, "jit_recompile_storm")
+
+
+def test_watchdog_host_transfer_within_two_harvests():
+    events = []
+    wd = _make_watchdog(events)
+    key = "ray_tpu_host_transfer_bytes_total{region=learner.update}"
+    unk = "ray_tpu_host_transfer_bytes_total{region=untracked}"
+    wd.evaluate([], {key: 0.0, unk: 0.0}, [], interval_s=0.01)
+    assert not _alerts(events, "unexpected_host_transfer")
+    # untracked bytes never alert however large; in-region bytes alert
+    # on the first judged round once the delta crosses the floor
+    wd.evaluate([], {key: 500.0, unk: 1e9}, [], interval_s=0.01)
+    alerts = _alerts(events, "unexpected_host_transfer")
+    assert len(alerts) == 1
+    msg, severity, fields = alerts[0]
+    assert severity == "ERROR"
+    assert fields["region"] == "learner.update"
+    assert fields["value"] == 500.0
+    assert "RT021" in msg
+
+
+def test_watchdog_host_transfer_below_floor_quiet():
+    events = []
+    wd = _make_watchdog(events)
+    key = "ray_tpu_host_transfer_bytes_total{region=learner.update}"
+    wd.evaluate([], {key: 0.0}, [], interval_s=0.01)
+    wd.evaluate([], {key: 99.0}, [], interval_s=0.01)
+    assert not _alerts(events, "unexpected_host_transfer")
+
+
+# ---- live sentinel (jax, CPU) ----------------------------------------------
+
+
+@pytest.fixture
+def sentinel():
+    pytest.importorskip("jax")
+    assert jax_sentinel.install()
+    try:
+        yield jax_sentinel
+    finally:
+        jax_sentinel.uninstall()
+
+
+def test_compile_counter_first_warm_recompile(sentinel):
+    import jax
+    import jax.numpy as jnp
+
+    # pre-warm the inputs OUTSIDE any region so their builder compiles
+    # don't attribute to the label under test
+    x = jnp.ones((4,), dtype=jnp.float32)
+    y = jnp.ones((8,), dtype=jnp.float32)
+    f = jax.jit(lambda v: v * 2.0)
+    name = "ray_tpu_jit_compiles_total"
+    first_key = (("fn", "sentinel.t1"), ("kind", "first"))
+    rec_key = (("fn", "sentinel.t1"), ("kind", "recompile"))
+
+    before = _series(name)
+    with jax_sentinel.step_region("sentinel.t1"):
+        f(x).block_until_ready()
+    cold = _series(name)
+    assert cold.get(first_key, 0.0) - before.get(first_key, 0.0) == 1.0
+
+    with jax_sentinel.step_region("sentinel.t1"):
+        f(x).block_until_ready()  # cache-warm: silent
+    warm = _series(name)
+    assert warm.get(first_key, 0.0) == cold.get(first_key, 0.0)
+    assert warm.get(rec_key, 0.0) == cold.get(rec_key, 0.0)
+
+    with jax_sentinel.step_region("sentinel.t1"):
+        f(y).block_until_ready()  # new shape: real XLA recompile
+    hot = _series(name)
+    assert hot.get(rec_key, 0.0) - warm.get(rec_key, 0.0) >= 1.0
+
+
+def test_transfer_accounting_bytes_and_spans(sentinel):
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(16, dtype=jnp.float32)  # 64 bytes
+    s = jnp.float32(1.0)                   # 4 bytes
+    name = "ray_tpu_host_transfer_bytes_total"
+    key = (("region", "sentinel.t2"),)
+    unk = (("region", "untracked"),)
+
+    before = _series(name)
+    with jax_sentinel.step_region("sentinel.t2"):
+        assert s.item() == 1.0
+        host = jax.device_get(x)
+    assert host.shape == (16,)
+    after = _series(name)
+    # .item() pulls the 4-byte scalar; device_get pulls the 64-byte
+    # tree exactly once (the per-leaf __array__ is reentrancy-guarded)
+    assert after.get(key, 0.0) - before.get(key, 0.0) == 68.0
+
+    # the same forcing points OUTSIDE a region account as untracked
+    assert s.item() == 1.0
+    outside = _series(name)
+    assert outside.get(key, 0.0) == after.get(key, 0.0)
+    assert outside.get(unk, 0.0) - after.get(unk, 0.0) == 4.0
+
+    # in-region syncs also land on the flight recorder as host_sync.*
+    # spans carrying bytes + region (perf_report's host_sync bucket)
+    recs = [r for r in spans.ring().snapshot_records()
+            if r[1].startswith("host_sync.")
+            and (r[6] or {}).get("region") == "sentinel.t2"]
+    assert {r[1] for r in recs} == {"host_sync.item",
+                                    "host_sync.device_get"}
+
+
+def test_snapshot_extra_rides_process_snapshot(sentinel):
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v + 1.0)
+    with jax_sentinel.step_region("sentinel.t3"):
+        f(jnp.ones((2,))).block_until_ready()
+    snap = mp.snapshot_process()
+    extra = snap[jax_sentinel.SNAPSHOT_KEY]
+    assert extra["installed"] is True
+    assert extra["compiles"].get("sentinel.t3", 0) >= 1
+
+
+def test_live_breach_alerts_within_two_harvests(sentinel):
+    """End-to-end: real in-region transfers crossing the configured
+    floor raise unexpected_host_transfer on the second harvest."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(64, dtype=jnp.float32)  # 256 bytes per device_get
+    events = []
+    wd = _make_watchdog(events, host_transfer_bytes=200.0)
+    with jax_sentinel.step_region("sentinel.live"):
+        jax.device_get(x)  # breach begins
+    wd.evaluate([], _flat_series(), [], interval_s=0.01)  # baselined
+    assert not _alerts(events, "unexpected_host_transfer")
+    with jax_sentinel.step_region("sentinel.live"):
+        jax.device_get(x)  # breach continues into the next window
+    wd.evaluate([], _flat_series(), [], interval_s=0.01)  # judged
+    alerts = _alerts(events, "unexpected_host_transfer")
+    assert [f["region"] for _m, _s, f in alerts] == ["sentinel.live"]
+
+
+def test_live_recompile_storm_alerts_within_two_harvests(sentinel):
+    """End-to-end: real steady-state recompiles (shape-varying calls
+    under one region label) raise jit_recompile_storm on the second
+    harvest after the storm starts."""
+    import jax
+    import jax.numpy as jnp
+
+    xs = [jnp.ones((n,), dtype=jnp.float32) for n in range(2, 7)]
+    f = jax.jit(lambda v: v * 3.0)
+    events = []
+    wd = _make_watchdog(events, jit_recompiles=3)
+    with jax_sentinel.step_region("sentinel.storm"):
+        f(xs[0]).block_until_ready()  # first compile
+        f(xs[1]).block_until_ready()  # storm begins
+    wd.evaluate([], _flat_series(), [], interval_s=0.01)  # baselined
+    assert not _alerts(events, "jit_recompile_storm")
+    with jax_sentinel.step_region("sentinel.storm"):
+        for x in xs[2:]:
+            f(x).block_until_ready()  # 3 recompiles in one window
+    wd.evaluate([], _flat_series(), [], interval_s=0.01)  # judged
+    alerts = _alerts(events, "jit_recompile_storm")
+    assert [f2["fn"] for _m, _s, f2 in alerts] == ["sentinel.storm"]
+
+
+def test_off_switch_disables_everything():
+    """RAY_TPU_JAX_SENTINEL=0: install() refuses, step_region is a
+    shared no-op, nothing is patched — checked in a subprocess so the
+    env var is read fresh (and jax is never even imported)."""
+    code = (
+        "from ray_tpu.util import jax_sentinel\n"
+        "import sys\n"
+        "assert not jax_sentinel.enabled()\n"
+        "assert not jax_sentinel.install()\n"
+        "assert not jax_sentinel.installed()\n"
+        "assert jax_sentinel.step_region('x') is jax_sentinel.NOOP\n"
+        "assert 'jax' not in sys.modules\n"
+        "print('SENTINEL-OFF-OK')\n")
+    env = dict(os.environ, RAY_TPU_JAX_SENTINEL="0")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "SENTINEL-OFF-OK" in out.stdout
+
+
+def test_metrics_plane_configure_exposes_sentinel_knobs():
+    events = []
+    wd = _make_watchdog(events, jit_recompiles=7,
+                        jit_recompile_warmup_s=5.0,
+                        host_transfer_bytes=42.0)
+    assert wd.jit_recompiles == 7
+    assert wd.jit_recompile_warmup_s == 5.0
+    assert wd.host_transfer_bytes == 42.0

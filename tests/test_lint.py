@@ -946,7 +946,7 @@ def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == [f"RT00{i}" for i in range(1, 10)] + \
         ["RT010", "RT011", "RT012", "RT013", "RT014", "RT015", "RT016",
-         "RT017", "RT018", "RT019"]
+         "RT017", "RT018", "RT019", "RT020", "RT021", "RT022", "RT023"]
     assert all(r.rationale for r in ALL_RULES)
 
 
@@ -1466,6 +1466,502 @@ def test_rt016_cross_file_cycle():
     assert all(f.rule_id == "RT016" for f in fs)
 
 
+# ---- RT020 recompile hazards -----------------------------------------------
+
+RT020_POS_WRAP_IN_LOOP = """
+    import jax
+
+    def train(fns, xs):
+        out = []
+        for fn, x in zip(fns, xs):
+            out.append(jax.jit(fn)(x))
+        return out
+"""
+
+RT020_SUPPRESSED = """
+    import jax
+
+    def train(fns, xs):
+        out = []
+        for fn, x in zip(fns, xs):
+            # graftlint: disable=RT020
+            out.append(jax.jit(fn)(x))
+        return out
+"""
+
+
+def test_rt020_jit_wrap_in_loop():
+    fs = [f for f in findings(RT020_POS_WRAP_IN_LOOP)
+          if f.rule_id == "RT020"]
+    assert len(fs) == 1
+    assert "inside a loop" in fs[0].message
+
+
+def test_rt020_suppressed():
+    assert "RT020" not in rules_hit(RT020_SUPPRESSED)
+
+
+def test_rt020_keyed_compile_cache_fine():
+    """`self._cache[key] = jax.jit(...)` in a loop builds a keyed
+    compile cache on purpose — each iteration wraps ONCE per key."""
+    src = """
+        import jax
+
+        class Pool:
+            def build(self, fns):
+                for name, fn in fns.items():
+                    self._cache[name] = jax.jit(fn)
+    """
+    assert "RT020" not in rules_hit(src)
+
+
+def test_rt020_shape_branch_in_traced_body():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x.shape[0] > 1:
+                return x * 2
+            return x
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT020"]
+    assert len(fs) == 1
+    assert ".shape" in fs[0].message
+
+
+def test_rt020_shape_guard_clause_fine():
+    """`if x.ndim != 2: raise` validates at trace time — no per-shape
+    specialization beyond what jit already does."""
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x.ndim != 2:
+                raise ValueError("rank")
+            return x * 2
+    """
+    assert "RT020" not in rules_hit(src)
+
+
+def test_rt020_fstring_in_traced_body():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x, tag):
+            label = f"step-{tag}"
+            return x * 2
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT020"]
+    assert len(fs) == 1
+    assert "f-string" in fs[0].message
+
+
+def test_rt020_scalar_loop_counter_and_int_coercion():
+    src = """
+        import jax
+
+        def _mul(x, n):
+            return x * n
+
+        step = jax.jit(_mul)
+
+        def train(x, steps):
+            ys = []
+            for i in range(steps):
+                ys.append(step(x, i))
+            return ys
+
+        def train2(x, t):
+            return step(x, int(t))
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT020"]
+    assert len(fs) == 2
+    assert "loop counter 'i'" in fs[0].message
+    assert "int()" in fs[1].message
+
+
+def test_rt020_static_and_unknown_static_fine():
+    """A loop counter at a declared static position is the sanctioned
+    pattern; a NON-literal static_argnums means the static set is
+    unknown, so the rule stays silent rather than guess."""
+    src = """
+        import jax
+
+        def _mul(x, n):
+            return x * n
+
+        step = jax.jit(_mul, static_argnums=(1,))
+        step2 = jax.jit(_mul, static_argnums=POSITIONS)
+
+        def train(x, steps):
+            ys = []
+            for i in range(steps):
+                ys.append(step(x, i))
+                ys.append(step2(x, i))
+            return ys
+    """
+    assert "RT020" not in rules_hit(src)
+
+
+# ---- RT021 hidden host syncs -----------------------------------------------
+
+RT021_POS = """
+    import jax
+
+    def _fwd(x):
+        return x
+
+    step = jax.jit(_fwd)
+
+    def train(x):
+        y = step(x)
+        return y.item()
+"""
+
+RT021_SUPPRESSED = """
+    import jax
+
+    def _fwd(x):
+        return x
+
+    step = jax.jit(_fwd)
+
+    def train(x):
+        y = step(x)
+        return y.item()  # graftlint: disable=RT021
+"""
+
+
+def test_rt021_item_on_device_value():
+    fs = [f for f in findings(RT021_POS) if f.rule_id == "RT021"]
+    assert len(fs) == 1
+    assert ".item()" in fs[0].message
+
+
+def test_rt021_suppressed():
+    assert "RT021" not in rules_hit(RT021_SUPPRESSED)
+
+
+def test_rt021_coercions_print_and_barrier():
+    src = """
+        import jax
+        import numpy as np
+
+        def _fwd(x):
+            return x
+
+        step = jax.jit(_fwd)
+
+        def train(x):
+            y = step(x)
+            a = float(y)
+            b = np.asarray(y)
+            print(y)
+            y.block_until_ready()
+            return a, b
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT021"]
+    assert len(fs) == 4  # float(), np.asarray(), print(), barrier
+
+
+def test_rt021_device_get_and_meta_fine():
+    """jax.device_get is THE sanctioned forcing point: its result is
+    host data, and shape/dtype reads are metadata, not transfers."""
+    src = """
+        import jax
+
+        def _fwd(x):
+            return x
+
+        step = jax.jit(_fwd)
+
+        def train(x):
+            y = step(x)
+            host = jax.device_get(y)
+            n = y.shape[0]
+            return host.item(), n
+    """
+    assert "RT021" not in rules_hit(src)
+
+
+def test_rt021_exempt_paths():
+    """Syncs only cost a step on the hot path: tests/tools/scripts
+    trees are exempt wholesale."""
+    src = textwrap.dedent(RT021_POS)
+    assert any(f.rule_id == "RT021" for f in lint_source(src, "fix.py"))
+    for path in ("tests/fix.py", "tools/dump.py", "scripts/run.py"):
+        assert not any(f.rule_id == "RT021"
+                       for f in lint_source(src, path))
+
+
+# ---- RT022 donation misuse -------------------------------------------------
+
+RT022_POS = """
+    import jax
+
+    def _step(state, batch):
+        return state
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def train(state, batch):
+        out = step(state, batch)
+        loss = state.mean()
+        return out, loss
+"""
+
+RT022_SUPPRESSED = """
+    import jax
+
+    def _step(state, batch):
+        return state
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def train(state, batch):
+        out = step(state, batch)
+        loss = state.mean()  # graftlint: disable=RT022
+        return out, loss
+"""
+
+
+def test_rt022_read_after_donation():
+    fs = [f for f in findings(RT022_POS) if f.rule_id == "RT022"]
+    assert len(fs) == 1
+    assert "donated position 0" in fs[0].message
+    # the finding lands on the stale READ, not on the donating call
+    assert fs[0].line == 11
+
+
+def test_rt022_suppressed():
+    assert "RT022" not in rules_hit(RT022_SUPPRESSED)
+
+
+def test_rt022_rebind_through_self_fine():
+    """`state = step(state, ...)` replaces the donated buffer with the
+    result — the sanctioned update-in-place shape."""
+    src = """
+        import jax
+
+        def _step(state, batch):
+            return state
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def train(state, batch):
+            state = step(state, batch)
+            return state
+    """
+    assert "RT022" not in rules_hit(src)
+
+
+def test_rt022_undonated_update_in_place_hint():
+    src = """
+        import jax
+
+        def _step(state, batch):
+            return state
+
+        step = jax.jit(_step)
+
+        def train(state, batch):
+            state = step(state, batch)
+            return state
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT022"]
+    assert len(fs) == 1
+    assert fs[0].message.startswith("hint:")
+    assert "donate_argnums" in fs[0].message
+
+
+def test_rt022_cross_file_donation():
+    """The donate_argnums declaration and the stale read live in
+    different files, joined by the callee name through project facts."""
+    donor = textwrap.dedent("""
+        import jax
+
+        def _step(state, batch):
+            return state
+
+        train_step = jax.jit(_step, donate_argnums=(0,))
+    """)
+    caller = textwrap.dedent("""
+        def train(state, batch):
+            out = train_step(state, batch)
+            norm = state.sum()
+            return out, norm
+    """)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "donor.py"), "w") as f:
+            f.write(donor)
+        with open(os.path.join(d, "caller.py"), "w") as f:
+            f.write(caller)
+        fs = [f for f in lint_paths([d]) if f.rule_id == "RT022"]
+    assert len(fs) == 1
+    assert fs[0].path.endswith("caller.py")
+    assert "donated position 0" in fs[0].message
+
+
+# ---- RT023 leak on raise ---------------------------------------------------
+
+RT023_POS = """
+    class Runner:
+        def run(self, store, ref, batch):
+            store.pin(ref)
+            out = self.compute(batch)
+            store.unpin(ref)
+            return out
+"""
+
+RT023_SUPPRESSED = """
+    class Runner:
+        def run(self, store, ref, batch):
+            store.pin(ref)  # graftlint: disable=RT023
+            out = self.compute(batch)
+            store.unpin(ref)
+            return out
+"""
+
+
+def test_rt023_unprotected_release():
+    fs = [f for f in findings(RT023_POS) if f.rule_id == "RT023"]
+    assert len(fs) == 1
+    assert "'pin' resource acquired in 'run'" in fs[0].message
+
+
+def test_rt023_suppressed():
+    assert "RT023" not in rules_hit(RT023_SUPPRESSED)
+
+
+def test_rt023_try_finally_fine():
+    src = """
+        class Runner:
+            def run(self, store, ref, batch):
+                store.pin(ref)
+                try:
+                    out = self.compute(batch)
+                finally:
+                    store.unpin(ref)
+                return out
+    """
+    assert "RT023" not in rules_hit(src)
+
+
+def test_rt023_context_manager_fine():
+    src = """
+        class Runner:
+            def run(self, store, ref, batch):
+                with store.lease(ref):
+                    return self.compute(batch)
+    """
+    assert "RT023" not in rules_hit(src)
+
+
+def test_rt023_ownership_handoff_fine():
+    """No matching release in reach means the resource's lifecycle
+    moved elsewhere (queue handoff, callback transfer) — not a leak
+    this function can cause."""
+    src = """
+        class Runner:
+            def stage(self, store, ref):
+                store.pin(ref)
+                self.queue.put(ref)
+                return ref
+
+            def stage_cb(self, store, ref):
+                store.pin(ref)
+                cb = store.unpin
+                self.queue.put(ref, cb)
+    """
+    assert "RT023" not in rules_hit(src)
+
+
+def test_rt023_release_via_helper_same_file():
+    """The release is reached through `self.finish(...)`, so the
+    compute() call between acquire and that helper is still a leak
+    window (interprocedural cutoff via the releases fact map)."""
+    src = """
+        class Runner:
+            def run(self, store, ref, batch):
+                store.pin(ref)
+                out = self.compute(batch)
+                self.finish(store, ref)
+                return out
+
+            def finish(self, store, ref):
+                store.unpin(ref)
+    """
+    fs = [f for f in findings(src) if f.rule_id == "RT023"]
+    assert len(fs) == 1
+    assert "'pin' resource acquired in 'run'" in fs[0].message
+
+
+def test_rt023_cross_file_helper_release():
+    """The releasing helper lives in another file: the bare call path
+    still leaks, the try/finally path is recognized as protected BY
+    that helper — both judgments need the cross-file releases map."""
+    runner = textwrap.dedent("""
+        class Runner:
+            def run(self, store, ref, batch):
+                store.pin(ref)
+                out = self.compute(batch)
+                self.finish(store, ref)
+                return out
+
+            def run_safe(self, store, ref, batch):
+                store.pin(ref)
+                try:
+                    return self.compute(batch)
+                finally:
+                    self.finish(store, ref)
+    """)
+    helper = textwrap.dedent("""
+        class Mixin:
+            def finish(self, store, ref):
+                store.unpin(ref)
+    """)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "runner.py"), "w") as f:
+            f.write(runner)
+        with open(os.path.join(d, "helper.py"), "w") as f:
+            f.write(helper)
+        fs = [f for f in lint_paths([d]) if f.rule_id == "RT023"]
+    assert len(fs) == 1
+    assert fs[0].path.endswith("runner.py")
+    assert "'pin' resource acquired in 'run'" in fs[0].message
+
+
+def test_rt023_actor_acquire_in_setup_only():
+    """`.remote()` counts as an actor acquire only in setup paths
+    where a matching kill/shutdown is plausibly owed; a steady-state
+    task submission is not an acquire."""
+    setup = """
+        class Driver:
+            def setup(self, cls, cfg):
+                self.worker = cls.remote(cfg)
+                self.validate(cfg)
+                self.worker.kill()
+    """
+    steady = """
+        class Driver:
+            def step(self, fn, batch):
+                ref = fn.remote(batch)
+                self.validate(batch)
+                self.pool.kill()
+    """
+    fs = [f for f in findings(setup) if f.rule_id == "RT023"]
+    assert len(fs) == 1
+    assert "'actor' resource acquired in 'setup'" in fs[0].message
+    assert "RT023" not in rules_hit(steady)
+
+
 # ---- incremental lint cache ------------------------------------------------
 
 def test_lint_cache_hit_and_invalidation(tmp_path):
@@ -1541,9 +2037,14 @@ def test_cli_exit_codes_and_json(tmp_path):
     with redirect_stdout(buf):
         assert main([bad, "--format=json"]) == 1
     payload = json.loads(buf.getvalue())
-    assert payload and payload[0]["rule"] == "RT006"
+    # header makes a green run auditable: which filter, which rules
+    assert payload["graftlint"]["select"] is None
+    assert payload["graftlint"]["ignore"] is None
+    assert "RT006" in payload["graftlint"]["rules"]
+    found = payload["findings"]
+    assert found and found[0]["rule"] == "RT006"
     # line 3: the fixture string starts with a blank line
-    assert payload[0]["path"] == bad and payload[0]["line"] == 3
+    assert found[0]["path"] == bad and found[0]["line"] == 3
 
     buf = io.StringIO()
     with redirect_stdout(buf):
@@ -1566,7 +2067,11 @@ def test_cli_select_and_ignore(tmp_path):
     buf = io.StringIO()
     with redirect_stdout(buf):
         assert main([bad, "--select=RT006", "--format=json"]) == 1
-    assert {f["rule"] for f in json.loads(buf.getvalue())} == {"RT006"}
+    payload = json.loads(buf.getvalue())
+    assert {f["rule"] for f in payload["findings"]} == {"RT006"}
+    # the header records the filter the findings were produced under
+    assert payload["graftlint"]["select"] == ["RT006"]
+    assert payload["graftlint"]["rules"] == ["RT006"]
 
     buf = io.StringIO()
     with redirect_stdout(buf):

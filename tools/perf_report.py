@@ -39,7 +39,12 @@ BUCKETS: Dict[str, Tuple[int, Tuple[str, ...]]] = {
     # checkpoint/reform/reshard/resume) outrank everything: wall time
     # inside a re-form is recovery cost, not compute/transport, even
     # when store/rpc spans nest inside it
-    "elastic_reconfig": (4, ("elastic.",)),
+    "elastic_reconfig": (5, ("elastic.",)),
+    # device→host syncs recorded by the jax sentinel inside step
+    # regions (util/jax_sentinel.py): wall time blocked on a forced
+    # transfer is stall, not compute, even though the spans nest
+    # inside learner.* — so host_sync outranks every work bucket
+    "host_sync": (4, ("host_sync.",)),
     "store_rpc": (3, ("rpc.", "store.", "cw.", "envelope.")),
     "device_feed": (2, ("feed.stage", "feed.ship", "feed.xfer",
                         "feed.unfuse")),
