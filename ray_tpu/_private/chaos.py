@@ -10,7 +10,7 @@ and distributed to every process over the existing pubsub ("chaos"
 channel). Each rule is fault x selector x trigger:
 
     fault     delay | drop_connection | partition | kill_worker |
-              error | evict_object
+              error | evict_object | stall_worker
     selector  RPC-method glob, node id (hex prefix), node pair
               (partition), actor class glob, object id glob
     trigger   seeded probability, after-N-matching-calls counter,
@@ -19,8 +19,18 @@ channel). Each rule is fault x selector x trigger:
 Every process consults its local copy at cheap hook points:
 
     rpc client call      drop_connection, partition
-    rpc server dispatch  delay, kill_worker
+    rpc server dispatch  delay, kill_worker, stall_worker
     store create/get/pull  error, evict_object
+
+`stall_worker` is the hung-collective fault (ISSUE 17): SIGSTOP a
+matching worker for delay_ms, then SIGCONT it — every thread freezes
+(heartbeat sidecars included), which is exactly what a wedged XLA
+collective looks like from the outside. It is NODE-MANAGER-ACTUATED
+ONLY: a stopped process cannot resume itself, so the worker self-fault
+path that kill_worker has does not exist here; rules fire on NM
+dispatch (method="nm_*" — harvest RPCs arrive every couple of
+seconds) via the stall actuator, with the same record-after-confirm +
+refund-on-miss accounting as daemon kills.
 
 Counters and seeded RNG streams are PER PROCESS (each process draws the
 same seeded stream, like the reference asio randomization), so a
@@ -49,7 +59,7 @@ from ray_tpu.util.locks import TracedLock
 logger = logging.getLogger(__name__)
 
 FAULT_TYPES = ("delay", "drop_connection", "partition", "kill_worker",
-               "error", "evict_object")
+               "error", "evict_object", "stall_worker")
 
 # Chaos control-plane traffic is never itself a chaos target (a drop rule
 # matching "*" must not sever the channel that could clear it).
@@ -161,6 +171,9 @@ class ChaosClient:
         self.gcs_address: Optional[Tuple[str, int]] = None
         # NM-registered actuator: fn(actor_class_glob) -> None
         self._kill_actuator: Optional[Callable[[str], None]] = None
+        # NM-registered actuator: fn(actor_class_glob, duration_ms) ->
+        # bool (SIGSTOP a matching local worker, SIGCONT after duration)
+        self._stall_actuator: Optional[Callable[[str, float], bool]] = None
         # worker-registered black-box hook: fn(reason) runs just before
         # a chaos self-kill so the dying process can persist its flight
         # dump (log_plane.write_flight_dump)
@@ -202,6 +215,7 @@ class ChaosClient:
             self.is_worker = False
             self.gcs_address = None
             self._kill_actuator = None
+            self._stall_actuator = None
             self._predeath_hook = None
             self._version = -1
             self._rules = [st for st in self._rules
@@ -219,6 +233,13 @@ class ChaosClient:
         node take effect (kill a matching local worker process)."""
         with self._lock:
             self._kill_actuator = fn
+
+    def set_stall_actuator(self, fn: Callable[[str, float], bool]) -> None:
+        """Node manager registers how stall_worker rules take effect
+        (SIGSTOP a matching local worker, SIGCONT after the duration).
+        Daemon-side only: a stopped process cannot resume itself."""
+        with self._lock:
+            self._stall_actuator = fn
 
     def set_predeath_hook(self, fn: Callable[[str], Any]) -> None:
         """Worker registers its black-box flight-dump writer, run just
@@ -394,16 +415,33 @@ class ChaosClient:
             f"dropped {method} to {address}")
 
     def on_server_dispatch(self, method: str) -> None:
-        """RPC server hook: delay + kill_worker faults."""
+        """RPC server hook: delay + kill_worker + stall_worker faults."""
         if not self.active or self._entered() or \
                 method.startswith(_EXEMPT_PREFIXES):
             return
         sleep_s = 0.0
         kill: Optional[_RuleState] = None
+        stall: Optional[_RuleState] = None
         fired: List[Tuple[_RuleState, str]] = []
         with self._lock:
             for st in self._rules:
                 rule = st.rule
+                if rule.fault == "stall_worker" and stall is None:
+                    # NM-actuated only (a SIGSTOP'd process cannot
+                    # SIGCONT itself): workers never self-stall, and a
+                    # daemon without the actuator skips the rule. Like
+                    # daemon kills, the fire is recorded only after the
+                    # actuator confirms a victim (refunded on a miss).
+                    if self.is_worker or self._stall_actuator is None:
+                        continue
+                    if not fnmatch.fnmatchcase(method, rule.method):
+                        continue
+                    if rule.node_id and not \
+                            self.node_id.startswith(rule.node_id):
+                        continue
+                    if self._should_fire(st):
+                        stall = st
+                    continue
                 if rule.fault == "delay":
                     if not fnmatch.fnmatchcase(method, rule.method):
                         continue
@@ -448,6 +486,26 @@ class ChaosClient:
             self._tls.in_hook = False
         if sleep_s > 0:
             time.sleep(sleep_s)
+        if stall is not None:
+            self._tls.in_hook = True
+            try:
+                stalled = bool(self._stall_actuator(
+                    stall.rule.actor_class, stall.rule.delay_ms))
+            except Exception:  # noqa: BLE001 - actuator crashed
+                stalled = False
+            finally:
+                self._tls.in_hook = False
+            if stalled:
+                self._tls.in_hook = True
+                try:
+                    self._record_fire(stall, f"server:{method}")
+                finally:
+                    self._tls.in_hook = False
+            else:
+                # refund: no worker matched the selector right now
+                with self._lock:
+                    if stall.fires > 0:
+                        stall.fires -= 1
         if kill is None:
             return
         if self.is_worker:

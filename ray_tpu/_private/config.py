@@ -126,6 +126,16 @@ class Config:
     # rather than treating a slow-but-progressing recovery as stuck.
     watchdog_elastic_reconfig_s = _define(
         "watchdog_elastic_reconfig_s", 120.0, float)
+    # Gang heartbeat plane (train/heartbeat.py): a rank whose
+    # ray_tpu_gang_heartbeat_age_seconds exceeds this raises
+    # `gang_rank_wedged` — the sidecar beats every ~0.5s even while the
+    # main thread sits inside a collective, so ~20 missed beats means
+    # the PROCESS is stopped (SIGSTOP, hard GIL stall), not merely a
+    # slow step. The gang supervisor uses the same threshold as the
+    # second factor of its wedge trip (step deadline expired AND a
+    # heartbeat this stale). metrics_configure-tunable at runtime.
+    watchdog_gang_heartbeat_s = _define(
+        "watchdog_gang_heartbeat_s", 10.0, float)
     # JAX sentinel probes (util/jax_sentinel.py; static twins are
     # graftlint RT020/RT021): a step-region label whose kind=recompile
     # counter grows by >= watchdog_jit_recompiles within one harvest

@@ -202,6 +202,13 @@ class GcsServer:
         self.chaos_fired: Dict[str, int] = {}
         self.chaos_version = 0
         self._chaos_rule_counter = 0
+        # Gang heartbeat table (train/heartbeat.py): gang -> rank ->
+        # last beat, stamped with GCS-side monotonic receipt time so
+        # age needs no cross-host clock agreement. FIFO-capped on gangs;
+        # supervisors clear their gang on teardown.
+        self.gang_heartbeats_tbl: "OrderedDict[str, Dict[int, Dict[str, Any]]]" = \
+            OrderedDict()
+        self.GANG_HEARTBEAT_GANGS_MAX = 64
         self._dead = False
 
         # Reload the persisted actor directory (reference GcsInitData:
@@ -297,6 +304,12 @@ class GcsServer:
             "wait_graph_add": self.wait_graph_add,
             "wait_graph_remove": self.wait_graph_remove,
             "wait_graph_snapshot": self.wait_graph_snapshot,
+            # gang heartbeat plane (train/heartbeat.py): rank sidecars
+            # beat in (oneway), gang supervisors poll ages + the
+            # runtime step-deadline override, and clear on teardown
+            "gang_heartbeat": self.gang_heartbeat,
+            "gang_heartbeats": self.gang_heartbeats,
+            "gang_heartbeat_clear": self.gang_heartbeat_clear,
             # chaos plane (_private/chaos.py)
             "chaos_inject": self.chaos_inject,
             "chaos_clear": self.chaos_clear,
@@ -362,6 +375,7 @@ class GcsServer:
             Gauge, "ray_tpu_alive_nodes",
             description="nodes the GCS currently considers alive"
         ).set(float(alive))
+        self._sample_gang_heartbeat_gauge()
 
     # ---- KV --------------------------------------------------------------
 
@@ -1070,6 +1084,117 @@ class GcsServer:
 
     def wait_graph_snapshot(self) -> Dict[str, Any]:
         return self.wait_graph.snapshot()
+
+    # ---- gang heartbeat plane (train/heartbeat.py) ----------------------
+
+    def gang_heartbeat(self, gang: str, rank: int, step: int = 0,
+                       phase: str = "", node_id: str = "",
+                       pid: int = 0) -> None:
+        """One rank beat (oneway from the worker sidecar). Stamped with
+        THIS process's monotonic clock: age is computed at query time
+        against the same clock, so no cross-host time agreement is
+        needed and a paused sender reads exactly as a growing age."""
+        with self._lock:
+            gang_tbl = self.gang_heartbeats_tbl.get(gang)
+            if gang_tbl is None:
+                while len(self.gang_heartbeats_tbl) >= \
+                        self.GANG_HEARTBEAT_GANGS_MAX:
+                    self.gang_heartbeats_tbl.popitem(last=False)
+                gang_tbl = self.gang_heartbeats_tbl[gang] = {}
+            gang_tbl[int(rank)] = {
+                "step": int(step), "phase": phase, "node_id": node_id,
+                "pid": int(pid), "recv_mono": time.monotonic()}
+
+    def gang_heartbeats(self, gang: str) -> Dict[str, Any]:
+        """Per-rank heartbeat ages for one gang, enriched with each
+        rank's NM RPC address (NodeInfo.address) so the supervisor can
+        hard-kill a wedged pid without an extra lookup, plus the
+        runtime step-deadline override (metrics_configure) so the
+        deadline stays tunable without touching the trainer."""
+        now = time.monotonic()
+        with self._lock:
+            ranks: Dict[int, Dict[str, Any]] = {}
+            for rank, rec in (self.gang_heartbeats_tbl.get(gang)
+                              or {}).items():
+                node = self.nodes.get(rec["node_id"])
+                ranks[rank] = {
+                    "step": rec["step"], "phase": rec["phase"],
+                    "node_id": rec["node_id"], "pid": rec["pid"],
+                    "nm_address": list(node.address)
+                    if node is not None and node.alive else None,
+                    "age_s": max(0.0, now - rec["recv_mono"]),
+                }
+        plane = getattr(self, "metrics_plane", None)
+        override = getattr(plane, "step_deadline_override_s", None)
+        return {"gang": gang, "ranks": ranks,
+                "step_deadline_override_s": override}
+
+    def gang_heartbeat_clear(self, gang: str) -> bool:
+        with self._lock:
+            return self.gang_heartbeats_tbl.pop(gang, None) is not None
+
+    # A row this stale is an ABANDONED formation, not a wedge: any real
+    # wedge is detected and torn down by its gang supervisor within the
+    # step deadline (seconds), and a clean teardown clears the rows. A
+    # supervisor that died without cleanup (crashed driver, failed test
+    # run) leaves rows that would otherwise read as wedged-forever to
+    # the watchdog. GC'd here rather than on a timer of their own so
+    # the table stays bounded on the always-on GCS.
+    GANG_HEARTBEAT_ABANDON_S = 120.0
+
+    def _gang_heartbeat_rows(self) -> List[Tuple[str, int, float]]:
+        """Live (gang, rank, age_s) rows from the heartbeat table —
+        shared by the harvest gauge export and the metrics plane's
+        liveness tick (which must NOT wait for a harvest: a wedged
+        worker stalls the fan-out by design). Rows past the abandon
+        horizon are dropped, not reported."""
+        now = time.monotonic()
+        dropped: List[Tuple[str, int]] = []
+        with self._lock:
+            out = []
+            for gang, tbl in list(self.gang_heartbeats_tbl.items()):
+                for rank, rec in list(tbl.items()):
+                    age = max(0.0, now - rec["recv_mono"])
+                    if age > self.GANG_HEARTBEAT_ABANDON_S:
+                        del tbl[rank]
+                        dropped.append((gang, rank))
+                        continue
+                    out.append((gang, rank, age))
+                if not tbl:
+                    self.gang_heartbeats_tbl.pop(gang, None)
+        for gang, rank in dropped:
+            logger.info(
+                "dropping abandoned gang heartbeat row %s rank %d "
+                "(stale > %.0fs; its formation was torn down without "
+                "a clear, or its supervisor died)", gang, rank,
+                self.GANG_HEARTBEAT_ABANDON_S)
+        return out
+
+    def gang_heartbeat_age_series(self) -> Dict[str, float]:
+        """The heartbeat ages as flat watchdog series keys (same
+        `name{gang=...,rank=...}` shape the aggregator produces), so
+        the liveness tick feeds _probe_gang_wedge the exact input the
+        harvested gauge would — one probe, two cadences."""
+        return {f"ray_tpu_gang_heartbeat_age_seconds"
+                f"{{gang={gang},rank={rank}}}": age
+                for gang, rank, age in self._gang_heartbeat_rows()}
+
+    def _sample_gang_heartbeat_gauge(self) -> None:
+        """Export ray_tpu_gang_heartbeat_age_seconds{gang,rank} on each
+        harvest. Rebuild-per-sample (reset then set the live rows): the
+        tag population is dynamic, and a lingering series for a cleared
+        gang would read as wedged-forever to the watchdog probe."""
+        from ray_tpu.util.metrics import Gauge, get_or_create
+        rows = self._gang_heartbeat_rows()
+        g = get_or_create(
+            Gauge, "ray_tpu_gang_heartbeat_age_seconds",
+            description="seconds since each gang rank's last heartbeat "
+                        "(sidecar beats every ~0.5s; a growing age is a "
+                        "wedged/stopped rank)",
+            tag_keys=("gang", "rank"))
+        g.reset()
+        for gang, rank, age in rows:
+            g.set(age, tags={"gang": gang, "rank": str(rank)})
 
     # ---- chaos plane (_private/chaos.py) --------------------------------
 

@@ -358,6 +358,7 @@ class Watchdog:
                  serve_error_rate: float = 0.1,
                  serve_shed_rate: float = 0.5,
                  elastic_reconfig_s: float = 120.0,
+                 gang_heartbeat_stale_s: float = 10.0,
                  jit_recompiles: int = 3,
                  jit_recompile_warmup_s: float = 60.0,
                  host_transfer_bytes: float = float(1 << 20)) -> None:
@@ -372,6 +373,7 @@ class Watchdog:
         self.serve_error_rate = serve_error_rate
         self.serve_shed_rate = serve_shed_rate
         self.elastic_reconfig_s = elastic_reconfig_s
+        self.gang_heartbeat_stale_s = gang_heartbeat_stale_s
         self.jit_recompiles = jit_recompiles
         self.jit_recompile_warmup_s = jit_recompile_warmup_s
         self.host_transfer_bytes = host_transfer_bytes
@@ -959,6 +961,42 @@ class Watchdog:
                 out[k] = v
         return out
 
+    def _probe_gang_wedge(self, series: Dict[str, float]) -> None:
+        """`gang_rank_wedged`: a rank's heartbeat age
+        (`ray_tpu_gang_heartbeat_age_seconds{gang,rank}`, exported by
+        the GCS from its gang heartbeat table each harvest) exceeds
+        gang_heartbeat_stale_s. The sidecar beats every ~0.5s even
+        while the rank's main thread sits inside a collective, so an
+        age this large means the PROCESS is stopped — SIGSTOP'd, hard
+        GIL stall, frozen host — not a slow step. The age is an
+        absolute value from the GCS monotonic clock (no cross-interval
+        delta needed), so the alert lands within the harvest interval
+        that first observes the breach; the cooldown dedupes repeats
+        while the gang supervisor's step-deadline trip tears the rank
+        down. Fed from TWO cadences with identical series keys: the
+        harvested gauge here in evaluate(), and the plane's liveness
+        tick reading the GCS table directly — the latter because a
+        wedged worker stalls the harvest fan-out for the full worker
+        snapshot timeout, exactly the window this probe must fire in."""
+        for key, v in series.items():
+            if not key.startswith(
+                    "ray_tpu_gang_heartbeat_age_seconds{"):
+                continue
+            if v <= self.gang_heartbeat_stale_s:
+                continue
+            tags = self._series_tags(key)
+            gang = tags.get("gang", "?")
+            rank = tags.get("rank", "?")
+            self._alert(
+                "gang_rank_wedged", key,
+                f"gang {gang!r} rank {rank}: no heartbeat for "
+                f"{v:.1f}s (> {self.gang_heartbeat_stale_s:.0f}s) — "
+                f"the rank process is wedged (SIGSTOP, hard stall, or "
+                f"frozen host), not merely slow; the gang supervisor's "
+                f"step deadline will hard-kill it and re-form the gang "
+                f"(reason=wedge)", severity="ERROR",
+                gang=gang, rank=rank, value=v)
+
     def _probe_jax_sentinel(self, series: Dict[str, float]) -> None:
         """`jit_recompile_storm` / `unexpected_host_transfer`: per-
         harvest deltas of the jax sentinel's counters
@@ -1059,6 +1097,7 @@ class Watchdog:
                       lambda: self._probe_serve_slo(snaps),
                       lambda: self._probe_serve_shed(snaps),
                       lambda: self._probe_elastic(snaps),
+                      lambda: self._probe_gang_wedge(series),
                       lambda: self._probe_jax_sentinel(series),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
@@ -1102,6 +1141,7 @@ class MetricsPlane:
             serve_error_rate=Config.watchdog_serve_error_rate,
             serve_shed_rate=Config.watchdog_serve_shed_rate,
             elastic_reconfig_s=Config.watchdog_elastic_reconfig_s,
+            gang_heartbeat_stale_s=Config.watchdog_gang_heartbeat_s,
             jit_recompiles=Config.watchdog_jit_recompiles,
             jit_recompile_warmup_s=(
                 Config.watchdog_jit_recompile_warmup_s),
@@ -1114,6 +1154,12 @@ class MetricsPlane:
         self._procs_gauge = get_or_create(
             Gauge, "ray_tpu_metrics_harvest_procs",
             description="processes covered by the last metrics harvest")
+        # Runtime step-deadline override for gang supervisors
+        # (metrics_configure(step_deadline_s=...)): the GCS hands it
+        # back on every gang_heartbeats query, so the wedge deadline is
+        # tunable live without touching the trainer. None = defer to
+        # ScalingConfig.step_deadline_s / auto-calibration.
+        self.step_deadline_override_s: Optional[float] = None
         self._lock = TracedLock("metrics_plane")
         # serializes full rounds: the sampler loop and on-demand callers
         # (scrapes, dumps) never harvest concurrently
@@ -1127,6 +1173,41 @@ class MetricsPlane:
         self._thread = threading.Thread(target=self._sample_loop,
                                         daemon=True, name="gcs-metrics")
         self._thread.start()
+        # Liveness tick: the gang-wedge probe on its own short cadence,
+        # fed straight from the GCS heartbeat table. It must not ride
+        # the harvest — a wedged (SIGSTOP'd) worker stalls the fan-out
+        # for the full worker-pull timeout, which is exactly when the
+        # probe needs to fire (and the gang supervisor's trip clears
+        # the table moments later).
+        self._liveness_wake = threading.Event()
+        self._liveness_thread = threading.Thread(
+            target=self._liveness_loop, daemon=True,
+            name="gcs-metrics-liveness")
+        self._liveness_thread.start()
+
+    # -- liveness tick ------------------------------------------------
+
+    def _liveness_loop(self) -> None:
+        """Evaluate the gang-wedge probe against LIVE heartbeat ages on
+        a cadence independent of harvest latency. The harvested-gauge
+        path in Watchdog.evaluate still runs (the alert cooldown keys
+        are identical, so the two cadences dedupe); this loop exists so
+        the alert SLO (<= 2 harvest intervals after staleness) holds
+        even while the harvest itself is stalled behind the wedged
+        rank's snapshot pull."""
+        while not self._stopped:
+            period = self.interval_s if self.interval_s > 0 else 1.0
+            self._liveness_wake.wait(
+                timeout=min(1.0, max(0.25, period)))
+            self._liveness_wake.clear()
+            if self._stopped:
+                return
+            try:
+                ages = self._gcs.gang_heartbeat_age_series()
+                if ages:
+                    self.watchdog._probe_gang_wedge(ages)
+            except Exception:  # noqa: BLE001 - probe tick must not die
+                logger.exception("gang liveness probe tick failed")
 
     # -- harvest fan-out ----------------------------------------------
 
@@ -1296,12 +1377,16 @@ class MetricsPlane:
                   serve_error_rate: Optional[float] = None,
                   serve_shed_rate: Optional[float] = None,
                   elastic_reconfig_s: Optional[float] = None,
+                  gang_heartbeat_stale_s: Optional[float] = None,
+                  step_deadline_s: Optional[float] = None,
                   jit_recompiles: Optional[int] = None,
                   jit_recompile_warmup_s: Optional[float] = None,
                   host_transfer_bytes: Optional[float] = None
                   ) -> Dict[str, Any]:
         """Runtime tuning (ops + tests): adjust the sample interval and
-        watchdog thresholds without restarting the GCS."""
+        watchdog thresholds without restarting the GCS.
+        `step_deadline_s` plants the gang supervisors' runtime per-step
+        deadline override (<= 0 clears it back to config/auto)."""
         if interval_s is not None:
             self.interval_s = float(interval_s)
             self._wake.set()
@@ -1326,6 +1411,12 @@ class MetricsPlane:
             self.watchdog.serve_shed_rate = float(serve_shed_rate)
         if elastic_reconfig_s is not None:
             self.watchdog.elastic_reconfig_s = float(elastic_reconfig_s)
+        if gang_heartbeat_stale_s is not None:
+            self.watchdog.gang_heartbeat_stale_s = \
+                float(gang_heartbeat_stale_s)
+        if step_deadline_s is not None:
+            self.step_deadline_override_s = \
+                float(step_deadline_s) if step_deadline_s > 0 else None
         if jit_recompiles is not None:
             self.watchdog.jit_recompiles = int(jit_recompiles)
         if jit_recompile_warmup_s is not None:
@@ -1347,6 +1438,9 @@ class MetricsPlane:
                 "serve_shed_rate": self.watchdog.serve_shed_rate,
                 "elastic_reconfig_s":
                     self.watchdog.elastic_reconfig_s,
+                "gang_heartbeat_stale_s":
+                    self.watchdog.gang_heartbeat_stale_s,
+                "step_deadline_s": self.step_deadline_override_s,
                 "jit_recompiles": self.watchdog.jit_recompiles,
                 "jit_recompile_warmup_s":
                     self.watchdog.jit_recompile_warmup_s,
@@ -1356,3 +1450,4 @@ class MetricsPlane:
     def stop(self) -> None:
         self._stopped = True
         self._wake.set()
+        self._liveness_wake.set()

@@ -153,6 +153,9 @@ class NodeManager:
         # worker id hex -> pre-kill flight data (span tail, rss) captured
         # by daemon-initiated kill paths while the victim still answers
         self._prekill_dumps: Dict[str, Dict[str, Any]] = {}
+        # pids currently SIGSTOPped by chaos_stall_worker: keeps a rule
+        # that keeps firing from stacking stalls on the same victim
+        self._stalled: set = set()
         self.idle: Dict[str, List[str]] = {}            # runtime env key -> ids
         self.pending: List[_PendingLease] = []
         # lease id -> worker id hex; grant/release funnel through the
@@ -171,6 +174,8 @@ class NodeManager:
             # subscriber addresses via this one method name)
             "cw_pubsub_push": self._on_pubsub_push,
             "nm_chaos_kill_worker": self.chaos_kill_worker,
+            "nm_chaos_stall_worker": self.chaos_stall_worker,
+            "nm_kill_worker_pid": self.kill_worker_pid,
             "nm_register_worker": self.register_worker,
             "nm_request_lease": self.request_lease,
             "nm_cancel_lease": self.cancel_lease,
@@ -242,6 +247,7 @@ class NodeManager:
         chaos_lib.client().set_context(node_id=self.node_id.hex(),
                                        gcs_address=self.gcs_address)
         chaos_lib.client().set_kill_actuator(self.chaos_kill_worker)
+        chaos_lib.client().set_stall_actuator(self.chaos_stall_worker)
         chaos_lib.fetch_policy(self._gcs.call)
         self._chaos_token = uuid.uuid4().hex
         try:
@@ -1053,6 +1059,87 @@ class NodeManager:
             victim.proc.kill()
         except OSError:
             return False
+        return True
+
+    def chaos_stall_worker(self, actor_class: str = "",
+                           duration_ms: float = 0.0) -> bool:
+        """stall_worker actuator: SIGSTOP one live local worker whose
+        hosted actor class matches the glob (empty glob prefers busy
+        task workers). Freezes EVERY thread — the exact signature of a
+        hung XLA collective: the main thread stops making progress AND
+        the heartbeat sidecar stops beating, so the supervisor's
+        staleness check (train/heartbeat.py) is the only signal left.
+        After duration_ms a daemon timer SIGCONTs the victim (stray
+        resume: by then the supervisor has usually SIGKILLed it —
+        tolerated via the OSError guard); duration_ms=0 stalls until
+        something kills the process. Returns True if a worker was
+        stalled."""
+        import fnmatch as _fnmatch
+        import signal as _signal
+        with self._lock:
+            live = [h for h in self.workers.values()
+                    if h.proc is not None and h.registered
+                    and h.proc.pid not in self._stalled]
+            if actor_class:
+                pool = [h for h in live if h.is_actor
+                        and h.current_task is not None
+                        and _fnmatch.fnmatchcase(
+                            h.current_task.function_name, actor_class)]
+            else:
+                pool = sorted(live, key=lambda h: not bool(h.current_task))
+            victim = pool[0] if pool else None
+            if victim is not None:
+                self._stalled.add(victim.proc.pid)
+        if victim is None:
+            return False
+        pid = victim.proc.pid
+        logger.warning("chaos: stalling worker %s pid=%d for %s",
+                       victim.worker_id.hex()[:12], pid,
+                       f"{duration_ms:.0f}ms" if duration_ms > 0
+                       else "ever (until killed)")
+        try:
+            os.kill(pid, _signal.SIGSTOP)
+        except OSError:
+            with self._lock:
+                self._stalled.discard(pid)
+            return False
+        if duration_ms > 0:
+            def _resume() -> None:
+                time.sleep(duration_ms / 1000.0)
+                with self._lock:
+                    self._stalled.discard(pid)
+                try:
+                    os.kill(pid, _signal.SIGCONT)
+                except OSError:
+                    pass  # victim was killed while stopped
+            threading.Thread(target=_resume, daemon=True,
+                             name=f"chaos-stall-resume-{pid}").start()
+        return True
+
+    def kill_worker_pid(self, pid: int, reason: str = "") -> bool:
+        """SIGKILL one local worker by OS pid. The wedge-recovery
+        actuator (train/heartbeat.py hard_kill_ranks): a SIGSTOPped
+        worker cannot run `cw_kill_self` — only an outside SIGKILL,
+        which works on stopped processes, removes it. Returns True when
+        the pid named a live registered worker and the kill landed."""
+        with self._lock:
+            victim = next((h for h in self.workers.values()
+                           if h.proc is not None and h.proc.pid == pid),
+                          None)
+        if victim is None:
+            return False
+        logger.warning("killing worker %s pid=%d (%s)",
+                       victim.worker_id.hex()[:12], pid,
+                       reason or "requested by pid")
+        # 1s pull timeout inside tolerates a stopped victim: the span
+        # pull just times out and the postmortem ships without it
+        self._capture_prekill(victim)
+        try:
+            victim.proc.kill()
+        except OSError:
+            return False
+        with self._lock:
+            self._stalled.discard(pid)
         return True
 
     # ---- misc ------------------------------------------------------------

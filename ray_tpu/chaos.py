@@ -62,13 +62,18 @@ def inject(fault: str, *,
 
     fault: one of `delay` (RPC server dispatch), `drop_connection` /
     `partition` (RPC client call), `kill_worker` (worker process
-    suicide / node-manager kill), `error` / `evict_object` (store
-    create/get/pull).
+    suicide / node-manager kill), `stall_worker` (node manager SIGSTOPs
+    a matching worker for `delay_ms` milliseconds, then SIGCONTs it —
+    the hung-collective fault: every thread freezes, heartbeat sidecars
+    included; delay_ms=0 stalls until something kills the process),
+    `error` / `evict_object` (store create/get/pull).
 
     Selectors: `method` (glob over RPC method or store op name; for
     kill_worker it defaults to "w_push_task" so counters track task
-    pushes), `node_id` (hex prefix), `nodes` (partition pair of hex
-    prefixes), `actor_class` (glob), `object_glob` (object id glob).
+    pushes, for stall_worker to "nm_*" so rules fire on node-manager
+    dispatch — the NM serves harvest RPCs every couple of seconds),
+    `node_id` (hex prefix), `nodes` (partition pair of hex prefixes),
+    `actor_class` (glob), `object_glob` (object id glob).
 
     Trigger: the first `after_n` matching calls pass through; then each
     match fires with `probability` drawn from a seeded per-process RNG,
@@ -78,7 +83,8 @@ def inject(fault: str, *,
     if fault not in FAULT_TYPES:
         raise ValueError(f"unknown fault {fault!r} (one of {FAULT_TYPES})")
     if method is None:
-        method = "w_push_task" if fault == "kill_worker" else "*"
+        method = {"kill_worker": "w_push_task",
+                  "stall_worker": "nm_*"}.get(fault, "*")
     rule = {
         "fault": fault, "rule_id": rule_id, "method": method,
         "node_id": node_id, "nodes": tuple(nodes),

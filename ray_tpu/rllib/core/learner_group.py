@@ -42,10 +42,21 @@ class _MeshLearnerActor:
     the gang's unique runtime-env pool key guarantees)."""
 
     def __init__(self, factory: Callable[[], Any], coordinator: str,
-                 world: int, rank: int, seed: int):
+                 world: int, rank: int, seed: int, gang_id: str = ""):
         import os
 
         import jax
+        # Heartbeat sidecar BEFORE jax.distributed.initialize: the
+        # rendezvous itself is a collective that can wedge (a peer
+        # SIGSTOPped mid-join), and the supervisor can only see that
+        # through beats that started first.
+        self._heartbeat = None
+        if gang_id:
+            from ray_tpu.train.heartbeat import HeartbeatSender
+            hb = HeartbeatSender(gang_id, rank)
+            if hb.start():
+                self._heartbeat = hb
+                hb.set_phase("rendezvous")
         # Honor an explicit platform pin (the chip-free test ladder sets
         # JAX_PLATFORMS=cpu): device plugins can re-assert themselves over
         # the env var, so pin through jax.config like tests/conftest.py.
@@ -58,6 +69,8 @@ class _MeshLearnerActor:
         self.world = world
         self.learner = factory()
         self.learner.build_distributed(seed=seed)
+        if self._heartbeat is not None:
+            self._heartbeat.set_phase("ready")
 
     def ping(self) -> str:
         return "pong"
@@ -79,6 +92,10 @@ class _MeshLearnerActor:
         return out
 
     def update(self, batch, minibatch_size, num_iters, seed):
+        if self._heartbeat is not None:
+            # the update round is the supervisor's step unit
+            self._heartbeat.note_step()
+            self._heartbeat.set_phase("update")
         return self.learner.update_distributed(
             self._local_shard(batch), minibatch_size, num_iters, seed)
 
@@ -102,17 +119,34 @@ from ray_tpu.train.elastic import free_port as _free_port
 
 
 class LearnerGroup:
+    # wedge supervisor cadence (mirrors train/backend_executor.py)
+    WEDGE_POLL_S = 1.0
+    WEDGE_HB_REFRESH_S = 2.0
+
     def __init__(self, learner_factory: Callable[[], Any],
                  num_learners: int = 0, seed: int = 0, *,
                  elastic_min_learners: Optional[int] = None,
                  elastic_reform_timeout_s: float = 60.0,
-                 state_refresh_every: int = 1):
+                 state_refresh_every: int = 1,
+                 step_deadline_s: Optional[float] = None):
+        if step_deadline_s is not None and step_deadline_s <= 0:
+            raise ValueError(
+                f"step_deadline_s must be > 0, got {step_deadline_s}")
         self._num_learners = num_learners   # achieved world size
         self._target_learners = num_learners  # what re-forms aim for
         self._factory = learner_factory
         self._seed = seed
         self._elastic_min = elastic_min_learners
         self._reform_timeout_s = elastic_reform_timeout_s
+        # gang heartbeat channel id; fresh per formation (_spawn_gang)
+        self._gang_uid: Optional[str] = None
+        # per-step wedge deadline — enforced only for elastic gangs
+        # (explicit step_deadline_s, else auto-calibrated from trailing
+        # update times; runtime-tunable via metrics_configure)
+        self._step_deadline = None
+        if elastic_min_learners is not None:
+            from ray_tpu.train.heartbeat import StepDeadline
+            self._step_deadline = StepDeadline(step_deadline_s)
         # How many updates between durable-cache refreshes. The cache
         # fetch pulls the FULL params+opt state from rank 0 to the
         # driver, so for large models every-update (the default, exact
@@ -157,14 +191,20 @@ class LearnerGroup:
         (train.elastic.gang_runtime_env): jax.distributed must
         initialize before any other jax use, so a re-form can never
         reuse a previous generation's processes."""
+        import uuid
+
         import ray_tpu
         from ray_tpu.train.elastic import gang_runtime_env
         gang_env = gang_runtime_env("RAY_TPU_LEARNER_GANG")
         coordinator = f"127.0.0.1:{_free_port()}"
+        # fresh heartbeat channel per generation: stale rows from a
+        # torn-down gang never shadow the new one
+        self._gang_uid = f"learner:{uuid.uuid4().hex[:8]}"
         actor_cls = ray_tpu.remote(_MeshLearnerActor)
         actors = [
             actor_cls.options(num_cpus=1, runtime_env=gang_env).remote(
-                self._factory, coordinator, world, rank, self._seed)
+                self._factory, coordinator, world, rank, self._seed,
+                self._gang_uid)
             for rank in range(world)
         ]
         # Barrier on gang readiness (rank 0 hosts the coordinator; all
@@ -266,6 +306,13 @@ class LearnerGroup:
             except Exception:  # noqa: BLE001 - actor already dead
                 pass
         self._actors = []
+        if self._gang_uid is not None:
+            from ray_tpu.train import heartbeat as hb
+            from ray_tpu.train.elastic import _core_worker_or_none
+            cw = _core_worker_or_none()
+            if cw is not None:
+                hb.clear_gang(cw._gcs.call, self._gang_uid)
+            self._gang_uid = None
 
     # ---- updates ----------------------------------------------------
     def update(self, batch: Dict[str, np.ndarray],
@@ -279,12 +326,13 @@ class LearnerGroup:
                                        seed)
         except Exception as e:  # noqa: BLE001 - actor death mid-update
             from ray_tpu.exceptions import RayTaskError
+            from ray_tpu.train.backend_executor import GangWedgedError
             if not self.elastic or isinstance(e, RayTaskError):
                 # a RayTaskError means the update RAN and raised — a
                 # deterministic application error that a gang re-form
                 # would only replay (and miscount as a worker_death
                 # reconfiguration); only infrastructure failures
-                # (actor death, lost worker, timeout) reconfigure
+                # (actor death, lost worker, timeout, wedge) reconfigure
                 raise
             logger.warning(
                 "elastic learner gang update failed (%r); "
@@ -292,8 +340,10 @@ class LearnerGroup:
             # aim back at the TARGET, not the achieved size: a gang
             # that degraded to 3/4 must try for 4 again when capacity
             # returns, not ratchet down toward the minimum
-            self._elastic_reconfigure("worker_death",
-                                      target=self._target_learners)
+            self._elastic_reconfigure(
+                "wedge" if isinstance(e, GangWedgedError)
+                else "worker_death",
+                target=self._target_learners)
             return self._update_remote(batch, minibatch_size, num_iters,
                                        seed)
 
@@ -302,10 +352,14 @@ class LearnerGroup:
         # Same full batch + same seed to every rank: each slices its own
         # equal shard and all ranks enter the jitted collective step the
         # same number of times.
-        stats = ray_tpu.get([
-            a.update.remote(batch, minibatch_size, num_iters, seed)
-            for a in self._actors
-        ], timeout=600)
+        refs = [a.update.remote(batch, minibatch_size, num_iters, seed)
+                for a in self._actors]
+        if self.elastic:
+            # wedge-aware wait: a rank SIGSTOPped inside the psum
+            # otherwise blocks every peer for the full 600s get
+            stats = self._await_update(refs, timeout=600)
+        else:
+            stats = ray_tpu.get(refs, timeout=600)
         # Scalars mean-reduce across ranks; array stats (per-sample TD
         # errors + their batch indexes) concatenate in rank order — each
         # rank reported its own shard of the global batch.
@@ -329,6 +383,90 @@ class LearnerGroup:
                 except Exception:  # noqa: BLE001 - the NEXT update's
                     pass           # failure path uses the older cache
         return out
+
+    # ---- collective-wedge supervisor (train/heartbeat.py) -----------
+    def _await_update(self, refs: List[Any], timeout: float
+                      ) -> List[Any]:
+        """Await one update round with the wedge trip armed — the
+        learner-plane mirror of BackendExecutor._await_round. Short
+        wait slices; between slices the supervisor refreshes the gang
+        heartbeat table (which also carries the runtime step-deadline
+        override) and, once the deadline expires, checks staleness.
+        Two-factor trip: deadline expired AND >= 1 stale heartbeat —
+        every-rank-fresh-but-slow keeps waiting. On a trip the wedged
+        pids are hard-killed via their node managers and
+        GangWedgedError routes into _elastic_reconfigure with
+        reason="wedge". Round times feed the deadline calibrator."""
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu.train import heartbeat as hb
+        from ray_tpu.train.backend_executor import GangWedgedError
+        t0 = _time.monotonic()
+        hb_next = 0.0
+        override: Optional[float] = None
+        while True:
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=self.WEDGE_POLL_S)
+            if not pending:
+                stats = ray_tpu.get(  # graftlint: disable=RT002
+                    refs, timeout=60)
+                self._step_deadline.observe(_time.monotonic() - t0)
+                return stats
+            now = _time.monotonic()
+            if now - t0 > timeout:
+                raise TimeoutError(
+                    f"no learner update round within {timeout:.0f}s")
+            if now < hb_next:
+                continue
+            hb_next = now + self.WEDGE_HB_REFRESH_S
+            reply = self._query_heartbeats()
+            if reply is None:
+                continue
+            if reply.get("step_deadline_override_s") is not None:
+                override = reply["step_deadline_override_s"]
+            deadline = self._step_deadline.current(override)
+            if deadline is None or now - t0 < deadline:
+                continue
+            from ray_tpu._private.config import Config
+            stale = hb.stale_ranks(reply,
+                                   Config.watchdog_gang_heartbeat_s)
+            if not stale:
+                continue  # slow but every rank alive: keep waiting
+            from ray_tpu._private import spans
+            cls = hb.classify_wedge(reply, stale)
+            spans.instant(
+                "elastic.wedge_detect", gang=self._gang_uid,
+                classification=cls["kind"],
+                ranks=",".join(str(r) for r in cls["ranks"]),
+                nodes=",".join(n[:12] for n in cls["nodes"]),
+                deadline_s=round(deadline, 3),
+                waited_s=round(now - t0, 3))
+            logger.error(
+                "elastic learner: step deadline %.1fs expired after "
+                "%.1fs with stale heartbeat(s) from rank(s) %s (%s); "
+                "hard-killing wedged processes and re-forming",
+                deadline, now - t0, cls["ranks"], cls["kind"])
+            killed = hb.hard_kill_ranks(stale)
+            raise GangWedgedError(
+                f"learner rank(s) {cls['ranks']} wedged mid-update "
+                f"({cls['kind']}): step deadline {deadline:.1f}s "
+                f"expired with heartbeats "
+                f"{[round(r['age_s'], 1) for r in stale]}s stale; "
+                f"hard-killed ranks {killed} via their node managers")
+
+    def _query_heartbeats(self) -> Optional[Dict[str, Any]]:
+        if self._gang_uid is None:
+            return None
+        from ray_tpu.train import heartbeat as hb
+        from ray_tpu.train.elastic import _core_worker_or_none
+        cw = _core_worker_or_none()
+        if cw is None:
+            return None
+        try:
+            return hb.query_gang(cw._gcs.call, self._gang_uid)
+        except Exception:  # noqa: BLE001 - GCS hiccup: retry next slice
+            return None
 
     def additional_update(self, **kwargs) -> Dict[str, Any]:
         if self._local is not None:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+import uuid
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional
 
@@ -41,6 +42,14 @@ logger = logging.getLogger(__name__)
 
 class TrainingWorkerError(RuntimeError):
     """A worker's train loop raised; wraps the original error."""
+
+
+class GangWedgedError(RuntimeError):
+    """Rank(s) wedged mid-step: the step deadline expired with stale
+    heartbeats (train/heartbeat.py). The wedged processes have already
+    been hard-killed via their node managers by the time this raises —
+    the caller routes it into the elastic re-form path with
+    reason="wedge"."""
 
 
 class BackendExecutor:
@@ -61,12 +70,22 @@ class BackendExecutor:
         self._tracker = None
         self._watch = None
         self._next_grow_poll = 0.0
+        # collective-wedge watchdog (train/heartbeat.py): per-formation
+        # heartbeat channel id + the per-step deadline calibrator.
+        # Enforced only for elastic gangs — the recovery IS the elastic
+        # re-form path — but heartbeats flow (and the gang_rank_wedged
+        # probe watches them) for fixed gangs too.
+        self._gang_uid: Optional[str] = None
+        self._step_deadline = None
         if self._elastic:
             from ray_tpu.train.elastic import (MembershipWatch,
                                                ReconfigTracker)
+            from ray_tpu.train.heartbeat import StepDeadline
             self._tracker = ReconfigTracker("train")
             self._watch = MembershipWatch()
             self._watch.subscribe()
+            self._step_deadline = StepDeadline(
+                scaling_config.step_deadline_s)
 
     # how long a RECONFIGURING gang waits for straggler bundles once
     # the minimum is met (TorchElastic proceed-with-survivors: recover
@@ -78,6 +97,13 @@ class BackendExecutor:
     # when no pubsub capacity event arrived (pubsub can be unavailable
     # — MembershipWatch.subscribe is best-effort)
     GROW_POLL_PERIOD_S = 5.0
+
+    # wedge supervisor: how often the elastic result wait wakes to
+    # check membership/deadline state, and how often it refreshes the
+    # gang heartbeat table from the GCS while a round is in flight
+    # (also picks up the metrics_configure step-deadline override)
+    WEDGE_POLL_S = 1.0
+    WEDGE_HB_REFRESH_S = 2.0
 
     # ---- lifecycle --------------------------------------------------
     def start(self) -> None:
@@ -116,6 +142,11 @@ class BackendExecutor:
                 "probes", len(self.worker_group), target,
                 self._scaling.elastic_min_workers)
         self._contexts = self._build_contexts(self.worker_group)
+        # fresh heartbeat channel per FORMATION: rows from a torn-down
+        # generation must never read as this gang's liveness
+        self._gang_uid = f"train:{uuid.uuid4().hex[:8]}"
+        for ctx in self._contexts:
+            ctx.gang_id = self._gang_uid
         if self._scaling.num_tpus_per_worker:
             self._share_tpu_visibility(self.worker_group)
         if self._watch is not None:
@@ -251,11 +282,18 @@ class BackendExecutor:
                     continue
                 self._maybe_grow()
             try:
-                # the get IS batched; the loop is the restart-retry path
-                results = ray_tpu.get(  # graftlint: disable=RT002
-                    [w.next_result.remote(timeout=timeout)
-                     for w in self.worker_group.workers],
-                    timeout=timeout + 60)
+                refs = [w.next_result.remote(timeout=timeout)
+                        for w in self.worker_group.workers]
+                if self._elastic:
+                    # wedge-aware wait: poll so a rank hung INSIDE a
+                    # collective (stale heartbeat + expired step
+                    # deadline) is detected and hard-killed instead of
+                    # blocking the whole gang for the full timeout
+                    results = self._await_round(refs, timeout)
+                else:
+                    # the get IS batched; the loop is the restart path
+                    results = ray_tpu.get(  # graftlint: disable=RT002
+                        refs, timeout=timeout + 60)
             except Exception as e:  # noqa: BLE001 - actor death etc.
                 self._handle_failure(e)
                 continue
@@ -278,6 +316,101 @@ class BackendExecutor:
                     "reporting — all ranks must call report() the same "
                     "number of times")
             return [r for r in results if r is not None]
+
+    # ---- collective-wedge supervisor (train/heartbeat.py) -----------
+    def _await_round(self, refs: List[Any], timeout: float
+                     ) -> List[Optional[TrainingResult]]:
+        """Await one result round with the wedge trip armed.
+
+        Short wait slices instead of one blocking get; between slices
+        the supervisor refreshes the gang heartbeat table (which also
+        carries the runtime step-deadline override) and, once the step
+        deadline has expired, checks for stale ranks. The trip is
+        two-factor by design: deadline expired AND >= 1 stale heartbeat.
+        Every-rank-fresh-but-slow keeps waiting — auto-calibration plus
+        the liveness factor is what keeps slow steps from false-
+        tripping. On a trip the wedged pids are hard-killed via their
+        node managers (a SIGSTOP'd rank answers no RPC) and
+        GangWedgedError routes into the elastic re-form with
+        reason="wedge". Round times feed the deadline calibrator."""
+        import ray_tpu
+        from ray_tpu.train import heartbeat as hb
+        t0 = time.monotonic()
+        hb_next = 0.0
+        override: Optional[float] = None
+        while True:
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=self.WEDGE_POLL_S)
+            if not pending:
+                results = ray_tpu.get(  # graftlint: disable=RT002
+                    refs, timeout=60)
+                self._step_deadline.observe(time.monotonic() - t0)
+                return results
+            now = time.monotonic()
+            if now - t0 > timeout + 60:
+                # mirror the blocking get's outer bound: workers are
+                # paced by next_result(timeout) so a round this old is
+                # a stuck gang even with fresh heartbeats
+                raise TimeoutError(
+                    f"no result round within {timeout + 60:.0f}s")
+            if now < hb_next:
+                continue
+            hb_next = now + self.WEDGE_HB_REFRESH_S
+            reply = self._query_heartbeats()
+            if reply is None:
+                continue
+            if reply.get("step_deadline_override_s") is not None:
+                override = reply["step_deadline_override_s"]
+            deadline = self._step_deadline.current(override)
+            if deadline is None or now - t0 < deadline:
+                continue
+            from ray_tpu._private.config import Config
+            stale = hb.stale_ranks(reply,
+                                   Config.watchdog_gang_heartbeat_s)
+            if not stale:
+                continue  # slow but every rank alive: keep waiting
+            self._trip_wedge(reply, stale, deadline, now - t0)
+
+    def _query_heartbeats(self) -> Optional[Dict[str, Any]]:
+        if self._gang_uid is None:
+            return None
+        from ray_tpu.train import heartbeat as hb
+        from ray_tpu.train.elastic import _core_worker_or_none
+        cw = _core_worker_or_none()
+        if cw is None:
+            return None
+        try:
+            return hb.query_gang(cw._gcs.call, self._gang_uid)
+        except Exception:  # noqa: BLE001 - GCS hiccup: retry next slice
+            return None
+
+    def _trip_wedge(self, reply: Dict[str, Any],
+                    stale: List[Dict[str, Any]], deadline: float,
+                    waited: float) -> None:
+        from ray_tpu._private import spans
+        from ray_tpu.train import heartbeat as hb
+        cls = hb.classify_wedge(reply, stale)
+        spans.instant(
+            "elastic.wedge_detect", gang=self._gang_uid,
+            classification=cls["kind"],
+            ranks=",".join(str(r) for r in cls["ranks"]),
+            nodes=",".join(n[:12] for n in cls["nodes"]),
+            deadline_s=round(deadline, 3), waited_s=round(waited, 3))
+        logger.error(
+            "elastic: step deadline %.1fs expired after %.1fs with "
+            "stale heartbeat(s) from rank(s) %s — %s; hard-killing "
+            "wedged processes and re-forming",
+            deadline, waited, cls["ranks"],
+            "whole-node wedge, classifying as slice leave of %s"
+            % [n[:12] for n in cls["nodes"]]
+            if cls["kind"] == "slice_leave" else "isolated rank wedge")
+        killed = hb.hard_kill_ranks(stale)
+        raise GangWedgedError(
+            f"rank(s) {cls['ranks']} wedged mid-step "
+            f"({cls['kind']}): step deadline {deadline:.1f}s expired "
+            f"after {waited:.1f}s with heartbeats "
+            f"{[round(r['age_s'], 1) for r in stale]}s stale; "
+            f"hard-killed ranks {killed} via their node managers")
 
     # ---- elastic reconfiguration ------------------------------------
     def _lost_gang_nodes(self) -> List[str]:
@@ -345,7 +478,9 @@ class BackendExecutor:
                 else "restarting group")
             try:
                 if self._elastic:
-                    self._reconfigure("worker_death")
+                    self._reconfigure(
+                        "wedge" if isinstance(error, GangWedgedError)
+                        else "worker_death")
                 else:
                     self._restart()
                 return
@@ -412,6 +547,15 @@ class BackendExecutor:
                 pass
             self.worker_group.shutdown()
             self.worker_group = None
+        if self._gang_uid is not None:
+            # drop the formation's heartbeat rows: a dead generation's
+            # rows would export as wedged-forever gauge series
+            from ray_tpu.train.elastic import _core_worker_or_none
+            from ray_tpu.train.heartbeat import clear_gang
+            cw = _core_worker_or_none()
+            if cw is not None:
+                clear_gang(cw._gcs.call, self._gang_uid)
+            self._gang_uid = None
 
     def shutdown(self) -> None:
         self._teardown_group()

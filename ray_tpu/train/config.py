@@ -37,8 +37,22 @@ class ScalingConfig:
     # proceeding at a smaller feasible world size (>= min) or raising
     # TrainingWorkerError naming the infeasible demand.
     elastic_reform_timeout_s: float = 60.0
+    # Collective-wedge watchdog (train/heartbeat.py): max seconds one
+    # training round (report->report) may take before the supervisor
+    # checks rank heartbeats and, if any are stale, hard-kills the
+    # wedged ranks and re-forms the gang (reason="wedge"). None (the
+    # default) auto-calibrates as k x the trailing p99 of observed
+    # round times — slow-but-alive steps never false-trip, and a cold
+    # gang with no timing history has no deadline at all. Runtime-
+    # tunable via the GCS metrics_configure(step_deadline_s=...) RPC.
+    # Enforced only for elastic gangs (the recovery IS the elastic
+    # re-form path).
+    step_deadline_s: Optional[float] = None
 
     def __post_init__(self):
+        if self.step_deadline_s is not None and self.step_deadline_s <= 0:
+            raise ValueError(
+                f"step_deadline_s must be > 0, got {self.step_deadline_s}")
         if self.elastic_max_workers is not None and \
                 self.elastic_min_workers is None:
             raise ValueError(

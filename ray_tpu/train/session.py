@@ -31,6 +31,11 @@ class TrainContext:
     node_rank: int = 0
     experiment_name: str = ""
     trial_dir: str = ""
+    # Gang heartbeat channel id (train/heartbeat.py): set per gang
+    # FORMATION by the backend executor — each elastic re-form gets a
+    # fresh id so stale rows from a torn-down generation never shadow
+    # the new gang. Empty = no heartbeat sidecar.
+    gang_id: str = ""
 
     def get_world_rank(self) -> int:
         return self.world_rank
@@ -82,10 +87,16 @@ class _TrainSession:
         self._config = config
         self._thread: Optional[threading.Thread] = None
         self._finished = False
+        self._heartbeat = None
 
     # -- worker-loop side --------------------------------------------
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
+        if self._heartbeat is not None:
+            # the report round IS the supervisor's step unit: its
+            # deadline is calibrated on report->report time
+            self._heartbeat.note_step()
+            self._heartbeat.set_phase("train")
         self._results.put(TrainingResult(
             metrics=dict(metrics),
             checkpoint_dir=checkpoint.path if checkpoint else None,
@@ -96,12 +107,24 @@ class _TrainSession:
 
     # -- actor side ---------------------------------------------------
     def start(self) -> None:
+        if self.context.gang_id:
+            # heartbeat sidecar: beats from its own thread + RpcClient
+            # even while the loop thread sits inside a collective. A
+            # SIGSTOP freezes it too — a STALE beat is the wedge signal.
+            from ray_tpu.train.heartbeat import HeartbeatSender
+            hb = HeartbeatSender(self.context.gang_id,
+                                 self.context.world_rank)
+            if hb.start():
+                self._heartbeat = hb
+
         def runner():
             try:
                 if self._config is not None:
                     self._loop(self._config)
                 else:
                     self._loop()
+                if self._heartbeat is not None:
+                    self._heartbeat.set_phase("done")
                 self._results.put(TrainingResult(
                     metrics={}, rank=self.context.world_rank, final=True))
             except BaseException as e:  # noqa: BLE001
@@ -113,6 +136,12 @@ class _TrainSession:
             target=runner, daemon=True,
             name=f"train-loop-rank{self.context.world_rank}")
         self._thread.start()
+
+    def close(self) -> None:
+        """Stop the heartbeat sidecar (gang teardown)."""
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
 
     def next_result(self, timeout: Optional[float] = None
                     ) -> Optional[TrainingResult]:

@@ -72,6 +72,8 @@ class RayTrainWorker:
         return self._session.next_result(timeout=timeout)
 
     def shutdown_session(self) -> None:
+        if self._session is not None:
+            self._session.close()  # stop the heartbeat sidecar
         self._session = None
         _set_session(None)
 

@@ -80,6 +80,14 @@ class Gauge(Metric):
         with self._lock:
             self._values[self._key(tags)] = float(value)
 
+    def reset(self) -> None:
+        """Drop every tagged series. For gauges whose tag population is
+        dynamic (e.g. per-gang heartbeat ages): a rebuild-per-sample
+        exporter resets then re-sets the live series so series for
+        departed members stop exporting stale values forever."""
+        with self._lock:
+            self._values.clear()
+
 
 class Histogram(Metric):
     kind = "histogram"

@@ -430,6 +430,17 @@ def metrics_history(names: Optional[List[str]] = None,
     return _gcs().call("metrics_history", names=names, limit=limit)
 
 
+def metrics_configure(**knobs: Any) -> Dict[str, Any]:
+    """Tune the GCS metrics plane + watchdog live, no restart
+    (_private/metrics_plane.py configure): `interval_s`, `cooldown_s`,
+    probe thresholds (`gang_heartbeat_stale_s`, `wait_edge_age_s`,
+    ...), and the runtime `step_deadline_s` override every gang
+    supervisor picks up on its next heartbeat query (<= 0 clears it,
+    back to ScalingConfig / auto-calibration). Returns the effective
+    settings."""
+    return _gcs().call("metrics_configure", **knobs)
+
+
 def health_alerts(limit: int = 100) -> List[Dict[str, Any]]:
     """HEALTH_ALERT events the metrics watchdog emitted (invariant
     probes over the harvested series; see _private/metrics_plane.py)."""

@@ -1,0 +1,126 @@
+"""GKE TPU node-pool provider contract tests (ISSUE 17 satellite).
+
+These pin the EXACT gcloud invocations GKESliceBackend emits — arg
+order, flag spelling, derived values — because the strings ARE the
+public contract with GKE: any drift (a renamed flag, a re-derived
+topology, a dropped --quiet) ships straight to production clusters
+with no compiler between us and the API. A mutation to any of the
+emitted strings must fail here.
+"""
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import (GKESliceBackend,
+                                           GKETPUNodeProvider)
+
+
+class _Recorder:
+    """Stands in for GKESliceBackend._run: records each gcloud arg
+    list verbatim and returns empty stdout (success)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, args):
+        self.calls.append(list(args))
+        return ""
+
+
+def _provider(accelerator_type: str = "v5p-8") -> GKETPUNodeProvider:
+    p = GKETPUNodeProvider(cluster="c1", zone="us-east5-a",
+                           accelerator_type=accelerator_type)
+    assert isinstance(p.backend, GKESliceBackend)
+    p.backend._run = _Recorder()
+    return p
+
+
+def test_create_node_emits_exact_gcloud_create_args():
+    p = _provider("v5p-8")  # 8 cores / 2 per chip = 4 chips = 1 host
+    node = p.create_node({"TPU": 4.0})
+    pool = node.provider_id
+    assert pool.startswith("ray-tpu-") and len(pool) == len("ray-tpu-") + 6
+    assert p.backend._run.calls == [[
+        "container", "node-pools", "create", pool,
+        "--cluster=c1", "--zone=us-east5-a",
+        "--num-nodes=1",
+        "--machine-type=ct5p-hightpu-4t",
+        "--tpu-topology=2x2x1",
+    ]]
+
+
+def test_terminate_node_emits_exact_gcloud_delete_args():
+    p = _provider("v5p-8")
+    node = p.create_node({"TPU": 4.0})
+    pool = node.provider_id
+    p.backend._run.calls.clear()
+    p.terminate_node(node)
+    assert p.backend._run.calls == [[
+        "container", "node-pools", "delete", pool,
+        "--cluster=c1", "--zone=us-east5-a", "--quiet",
+    ]]
+    assert p.non_terminated_nodes() == []
+
+
+@pytest.mark.parametrize("acc,num_nodes,topology", [
+    ("v5p-8", 1, "2x2x1"),
+    ("v5p-16", 2, "2x2x2"),
+    ("v5p-32", 4, "2x2x4"),
+    ("v5p-64", 8, "2x4x4"),
+    ("v5p-128", 16, "4x4x4"),
+])
+def test_topology_and_num_nodes_derive_from_one_chip_count(
+        acc, num_nodes, topology):
+    """--num-nodes and --tpu-topology must agree — both derive from
+    the slice's chip count (v5p suffix counts CORES, 2 per chip)."""
+    p = _provider(acc)
+    p.create_node({})
+    (call,) = p.backend._run.calls
+    assert f"--num-nodes={num_nodes}" in call
+    assert f"--tpu-topology={topology}" in call
+
+
+def test_unsupported_slice_size_rejected_before_gcloud():
+    """A slice we can't spell a topology for must raise, not emit an
+    inconsistent pool spec."""
+    p = _provider("v5p-384")  # 192 chips = 48 hosts: no v5p topology
+    with pytest.raises(ValueError, match="unsupported v5p slice size"):
+        p.create_node({})
+    assert p.backend._run.calls == []
+
+
+def test_topology_map_is_exact():
+    f = GKETPUNodeProvider._topology_for
+    assert [f(c) for c in (4, 8, 16, 32, 64)] == \
+        ["2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4"]
+    with pytest.raises(ValueError):
+        f(12)  # 3 hosts: not a v5p topology
+
+
+def test_slice_chips_honors_cores_per_chip():
+    """v5p suffix counts cores (2/chip); v5e counts chips (1/chip);
+    an unparseable type falls back to one host's worth."""
+    assert _provider("v5p-16").slice_chips == 8
+    assert _provider("v5e-16").slice_chips == 16
+    assert _provider("bogus").slice_chips == 4
+
+
+def test_head_resource_lands_exactly_once_per_slice():
+    """Host 0 (and only host 0) carries the TPU-<type>-head marker the
+    gang head actor schedules against; every host carries the pool
+    label and its chip share."""
+    p = _provider("v5p-32")  # 16 chips = 4 hosts
+    hosts = p._host_resources("pool-x")
+    assert len(hosts) == 4
+    assert all(h["TPU"] == 4.0 and h["pool-x"] == 1.0 for h in hosts)
+    heads = [h for h in hosts if "TPU-v5p-32-head" in h]
+    assert heads == [hosts[0]]
+
+
+def test_create_node_registers_hosts_with_pool_resources():
+    p = _provider("v5p-16")
+    node = p.create_node({})
+    hosts = node.handle["hosts"]
+    assert [h["host_id"] for h in hosts] == \
+        [f"{node.provider_id}-host0", f"{node.provider_id}-host1"]
+    assert all(h["resources"][node.provider_id] == 1.0 for h in hosts)
+    assert p.non_terminated_nodes() == [node]

@@ -340,7 +340,10 @@ def test_chaos_rolling_update_and_proxy_roll_zero_failures(
         time.sleep(0.5)
         # 2) proxy rolling update: config change → drain-replace
         serve.start_fleet(http_port=0, request_timeout_s=90.0)
-        deadline = time.monotonic() + 45
+        # 90s: the drain-replace must first bleed the old proxy's
+        # in-flight requests dry under 4 live load threads — on a
+        # loaded box that alone can eat most of a 45s window
+        deadline = time.monotonic() + 90
         while time.monotonic() < deadline:
             st = serve.fleet_status()
             ps = st.get("proxies", [])

@@ -1,0 +1,649 @@
+"""Collective-wedge watchdog (ISSUE 17): step deadlines + rank
+heartbeats that turn a hung XLA collective into an elastic re-form.
+
+The flagship acceptance test SIGSTOPs one rank of a live elastic
+DataParallelTrainer mid-step via the new `stall_worker` chaos fault
+(which freezes the heartbeat sidecar too — the realistic wedge
+signature), and requires: a `gang_rank_wedged` HEALTH_ALERT within two
+harvest intervals, an `elastic.wedge_detect` span on the merged
+timeline, the wedged pid hard-killed through its node manager (a
+stopped process answers no RPC, so `ray_tpu.kill` can't do it), a
+reason="wedge" reconfiguration resuming from the latest durable
+checkpoint, and step/loss continuity across the re-form.
+
+Units cover the deadline calibrator, staleness/classification helpers,
+the GCS heartbeat table round trip, the watchdog probe, and the
+learner-plane supervisor. The heavyweight learner-gang integration and
+the multi-seed sweep drill ride behind `-m slow` with tier-1 siblings
+(test_learner_await_update_trips_unit, test_chaos_sweep_wedge_smoke).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, DataParallelTrainer, FailureConfig,
+                           RunConfig, ScalingConfig)
+from ray_tpu.train.heartbeat import (HeartbeatSender, StepDeadline,
+                                     classify_wedge, stale_ranks)
+
+from tests.conftest import assert_ownership_drains
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gcs():
+    from ray_tpu._private import worker as worker_mod
+    return worker_mod.global_worker().core_worker._gcs
+
+
+# ---------------------------------------------------------------------------
+# StepDeadline calibration
+# ---------------------------------------------------------------------------
+
+
+def test_step_deadline_explicit_and_override():
+    d = StepDeadline(3.0)
+    assert d.current() == 3.0
+    # runtime override (metrics_configure) beats the explicit value
+    assert d.current(override_s=7.5) == 7.5
+    # a cleared override (None) falls back to explicit
+    assert d.current(override_s=None) == 3.0
+    with pytest.raises(ValueError):
+        StepDeadline(0.0)
+    with pytest.raises(ValueError):
+        StepDeadline(-1.0)
+
+
+def test_step_deadline_auto_calibration():
+    d = StepDeadline(None, k=4.0, floor_s=5.0, window=8, min_samples=3)
+    # no distribution yet: no deadline, no trip
+    assert d.current() is None
+    d.observe(0.1)
+    d.observe(0.1)
+    assert d.current() is None  # still below min_samples
+    d.observe(0.1)
+    # armed: k * p99 = 0.4 but floored at 5.0 so microbenchmark-fast
+    # steps never produce a hair-trigger deadline
+    assert d.current() == 5.0
+    # slow-but-steady steps calibrate the deadline UP: 4x the trailing
+    # p99, so a legitimately slow workload is never deadline-tripped
+    for _ in range(8):
+        d.observe(10.0)
+    assert d.current() == pytest.approx(40.0)
+    # the window is bounded: old samples age out
+    assert len(d._samples) == 8
+    # an override still wins over auto-calibration
+    assert d.current(override_s=2.0) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Staleness + slice-aware classification
+# ---------------------------------------------------------------------------
+
+
+def _reply(rows):
+    return {"gang": "g", "ranks": rows, "step_deadline_override_s": None}
+
+
+def test_stale_ranks_threshold():
+    reply = _reply({0: {"age_s": 0.4, "node_id": "a", "pid": 1},
+                    1: {"age_s": 12.0, "node_id": "a", "pid": 2},
+                    2: {"age_s": 9.9, "node_id": "b", "pid": 3}})
+    stale = stale_ranks(reply, 10.0)
+    assert [r["rank"] for r in stale] == [1]
+    assert stale[0]["pid"] == 2
+    # all fresh -> nothing to trip on, whatever the deadline says
+    assert stale_ranks(reply, 15.0) == []
+
+
+def test_classify_wedge_rank_vs_slice():
+    # one stale rank on a node with a fresh sibling: isolated rank wedge
+    reply = _reply({0: {"age_s": 12.0, "node_id": "a"},
+                    1: {"age_s": 0.1, "node_id": "a"},
+                    2: {"age_s": 0.1, "node_id": "b"}})
+    cls = classify_wedge(reply, stale_ranks(reply, 10.0))
+    assert cls == {"kind": "rank_wedge", "ranks": [0], "nodes": []}
+    # EVERY rank of one node stale: one membership event (slice leave),
+    # not N independent rank failures
+    reply = _reply({0: {"age_s": 12.0, "node_id": "a"},
+                    1: {"age_s": 13.0, "node_id": "a"},
+                    2: {"age_s": 0.1, "node_id": "b"}})
+    cls = classify_wedge(reply, stale_ranks(reply, 10.0))
+    assert cls == {"kind": "slice_leave", "ranks": [0, 1],
+                   "nodes": ["a"]}
+
+
+# ---------------------------------------------------------------------------
+# Watchdog probe (unit: synthetic series, no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_gang_rank_wedged_probe_unit():
+    from ray_tpu._private.metrics_plane import Watchdog
+
+    alerts = []
+
+    def emit(event_type, message, severity="INFO", **fields):
+        alerts.append((event_type, severity, fields))
+
+    wd = Watchdog(emit=emit, cooldown_s=0.0, wait_edge_age_s=120.0,
+                  store_occupancy_frac=0.95, queue_depth=256,
+                  gang_heartbeat_stale_s=10.0)
+    # flat aggregator keys: name{k=v,...} (metrics_plane._series_key)
+    fresh = {'ray_tpu_gang_heartbeat_age_seconds{gang=t:1,rank=0}': 0.6,
+             'ray_tpu_gang_heartbeat_age_seconds{gang=t:1,rank=1}': 9.9}
+    wd._probe_gang_wedge(fresh)
+    assert alerts == []  # under threshold: a slow beat is not a wedge
+    stale = dict(fresh)
+    stale['ray_tpu_gang_heartbeat_age_seconds{gang=t:1,rank=1}'] = 14.2
+    wd._probe_gang_wedge(stale)
+    assert len(alerts) == 1
+    event_type, severity, fields = alerts[0]
+    assert event_type == "HEALTH_ALERT" and severity == "ERROR"
+    assert fields["probe"] == "gang_rank_wedged"
+    assert fields["gang"] == "t:1" and fields["rank"] == "1"
+    assert fields["value"] == 14.2
+
+
+def test_abandoned_heartbeat_rows_are_gcd():
+    """A formation torn down WITHOUT a clear (crashed driver, failed
+    test run) must not read as wedged-forever: rows past the abandon
+    horizon are dropped by the liveness/gauge sampler, and the table
+    stays bounded. Standalone GcsServer — no cluster."""
+    from ray_tpu._private.gcs import GcsServer
+
+    gcs = GcsServer()
+    try:
+        gcs.gang_heartbeat(gang="dead:1", rank=0, step=3,
+                           phase="update", node_id="", pid=1)
+        gcs.gang_heartbeat(gang="live:1", rank=0, step=1,
+                           phase="update", node_id="", pid=2)
+        # rewind the dead gang's receipt stamp past the horizon
+        with gcs._lock:
+            gcs.gang_heartbeats_tbl["dead:1"][0]["recv_mono"] -= \
+                gcs.GANG_HEARTBEAT_ABANDON_S + 1.0
+        rows = gcs._gang_heartbeat_rows()
+        assert [(g, r) for g, r, _a in rows] == [("live:1", 0)]
+        with gcs._lock:
+            assert "dead:1" not in gcs.gang_heartbeats_tbl
+        # a live row well under the horizon survives the sweep
+        assert gcs._gang_heartbeat_rows()[0][0] == "live:1"
+        assert "live:1" in gcs.gang_heartbeat_age_series().__str__()
+    finally:
+        gcs.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# GCS heartbeat table round trip (live cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_gang_heartbeat_gcs_roundtrip(ray_start):
+    g = _gcs()
+    gang = "unit:roundtrip"
+    try:
+        g.call("gang_heartbeat", gang=gang, rank=0, step=7,
+               phase="train", node_id="nodeA", pid=1234)
+        reply = g.call("gang_heartbeats", gang=gang)
+        rec = reply["ranks"][0]
+        assert rec["step"] == 7 and rec["phase"] == "train"
+        assert rec["pid"] == 1234
+        # age stamped on the GCS's OWN monotonic clock at receipt — no
+        # cross-host clock agreement involved
+        assert 0.0 <= rec["age_s"] < 5.0
+        # unknown node id -> no NM kill route on the record
+        assert rec["nm_address"] is None
+        # re-beat advances the row in place
+        g.call("gang_heartbeat", gang=gang, rank=0, step=8,
+               phase="train", node_id="nodeA", pid=1234)
+        assert g.call("gang_heartbeats", gang=gang)["ranks"][0][
+            "step"] == 8
+        # the runtime deadline override rides every heartbeat reply
+        # (tuned through the public state API wrapper)
+        from ray_tpu.util import state as state_api
+        assert reply["step_deadline_override_s"] is None
+        assert state_api.metrics_configure(
+            step_deadline_s=7.25)["step_deadline_s"] == 7.25
+        assert g.call("gang_heartbeats", gang=gang)[
+            "step_deadline_override_s"] == 7.25
+        state_api.metrics_configure(step_deadline_s=0)  # <= 0 clears
+        assert g.call("gang_heartbeats", gang=gang)[
+            "step_deadline_override_s"] is None
+        # teardown clears the rows (a dead formation's rows would
+        # otherwise export as wedged-forever gauge series)
+        assert g.call("gang_heartbeat_clear", gang=gang) is True
+        assert g.call("gang_heartbeats", gang=gang)["ranks"] == {}
+    finally:
+        g.call("gang_heartbeat_clear", gang=gang)
+        g.call("metrics_configure", step_deadline_s=0)
+
+
+def test_heartbeat_sender_beats_from_sidecar_thread(ray_start):
+    """The sender stamps beats from its own thread + connection even
+    while the 'main thread' (this test) does nothing — the property
+    that keeps beats flowing while a rank sits inside a collective."""
+    gang = "unit:sender"
+    hb = HeartbeatSender(gang, rank=3, period_s=0.1)
+    try:
+        assert hb.start()  # driver process has a core worker
+        hb.note_step(41)
+        hb.note_step()
+        hb.set_phase("train")
+        deadline = time.monotonic() + 10
+        rec = None
+        while time.monotonic() < deadline:
+            ranks = _gcs().call("gang_heartbeats", gang=gang)["ranks"]
+            if 3 in ranks and ranks[3]["step"] == 42:
+                rec = ranks[3]
+                break
+            time.sleep(0.05)
+        assert rec is not None, "sidecar never beat"
+        assert rec["phase"] == "train" and rec["pid"] == os.getpid()
+        assert rec["age_s"] < 5.0
+    finally:
+        hb.stop()
+        _gcs().call("gang_heartbeat_clear", gang=gang)
+
+
+# ---------------------------------------------------------------------------
+# Learner-plane supervisor (tier-1 sibling of the slow integration)
+# ---------------------------------------------------------------------------
+
+
+def test_learner_await_update_trips_unit(ray_start):
+    """LearnerGroup._await_update trips GangWedgedError on (deadline
+    expired AND stale heartbeat) without waiting out the full update
+    timeout — against a synthetic heartbeat reply, so no real gang or
+    SIGSTOP is needed in tier-1."""
+    from ray_tpu.rllib.core.learner_group import LearnerGroup
+    from ray_tpu.train.backend_executor import GangWedgedError
+
+    @ray_tpu.remote
+    def hang(s):
+        time.sleep(s)
+        return "done"
+
+    gang = object.__new__(LearnerGroup)
+    gang._gang_uid = "learner:unittrip"
+    gang._step_deadline = StepDeadline(0.5)
+    # stale rank with no NM route: hard_kill_ranks logs + skips, the
+    # raise still happens (gang teardown owns the sweep)
+    gang._query_heartbeats = lambda: {
+        "gang": gang._gang_uid,
+        "ranks": {0: {"age_s": 99.0, "node_id": "gone", "pid": 0,
+                      "nm_address": None, "step": 1, "phase": "update"}},
+        "step_deadline_override_s": None,
+    }
+    ref = hang.remote(6.0)
+    t0 = time.monotonic()
+    with pytest.raises(GangWedgedError) as ei:
+        gang._await_update([ref], timeout=60.0)
+    assert time.monotonic() - t0 < 10.0  # tripped, not waited out
+    assert "wedged mid-update" in str(ei.value)
+    assert ray_tpu.get(ref, timeout=30) == "done"  # drain the task
+
+
+def test_learner_await_update_slow_but_alive(ray_start):
+    """Fresh heartbeats on every rank keep the supervisor waiting past
+    the deadline — the two-factor trip never fires on slow-but-alive."""
+    from ray_tpu.rllib.core.learner_group import LearnerGroup
+
+    @ray_tpu.remote
+    def slowstep():
+        time.sleep(3.0)
+        return "stepped"
+
+    gang = object.__new__(LearnerGroup)
+    gang._gang_uid = "learner:unitslow"
+    gang._step_deadline = StepDeadline(0.5)  # expires long before done
+    gang._query_heartbeats = lambda: {
+        "gang": gang._gang_uid,
+        "ranks": {0: {"age_s": 0.2, "node_id": "n", "pid": 1,
+                      "nm_address": None, "step": 1, "phase": "update"}},
+        "step_deadline_override_s": None,
+    }
+    out = gang._await_update([slowstep.remote()], timeout=60.0)
+    assert out == ["stepped"]
+    # the round time fed the calibrator
+    assert len(gang._step_deadline._samples) == 1
+
+
+@pytest.mark.slow  # real learner gang + jax.distributed + SIGSTOP (~1min)
+def test_learner_group_wedge_reconfigure(ray_start, monkeypatch):
+    """A SIGSTOPped learner rank wedges the replicated update; the
+    supervisor hard-kills it and the gang re-forms with
+    reason="wedge", resuming from the cached state (step counter
+    continuity)."""
+    import numpy as np
+
+    from ray_tpu._private.config import Config
+    from ray_tpu.rllib.core.learner_group import LearnerGroup
+    from tests.test_elastic import _make_stub_factory, _step_count
+
+    monkeypatch.setattr(Config, "watchdog_gang_heartbeat_s", 3.0)
+    batch = {"x": np.arange(128, dtype=np.float32)}
+    chaos.clear()
+    gang = None
+    try:
+        gang = LearnerGroup(
+            _make_stub_factory(), num_learners=2, seed=11,
+            elastic_min_learners=1, elastic_reform_timeout_s=120.0,
+            step_deadline_s=2.0)
+        s1 = gang.update(dict(batch), minibatch_size=None,
+                         num_iters=1, seed=0)
+        assert s1["world"] == 2.0
+        assert _step_count(gang.get_state()) == 1
+        # wedge one learner: 60s stall means it stays stopped until the
+        # supervisor's SIGKILL — the SIGCONT at 60s is a stray to a
+        # dead pid
+        chaos.inject("stall_worker", actor_class="*MeshLearnerActor*",
+                     probability=1.0, max_fires=1, delay_ms=60000.0)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sum(r["fired"] for r in chaos.list_rules()) >= 1:
+                break
+            time.sleep(0.2)
+        assert sum(r["fired"] for r in chaos.list_rules()) >= 1, \
+            "stall never fired"
+        s2 = gang.update(dict(batch), minibatch_size=None,
+                         num_iters=1, seed=1)
+        assert s2["world"] == 2.0
+        assert gang._tracker.history[-1]["reason"] == "wedge"
+        # resumed from the cached post-update-1 state, not restarted
+        assert _step_count(gang.get_state()) == 2
+    finally:
+        chaos.clear()
+        if gang is not None:
+            gang.shutdown()
+    assert_ownership_drains()
+
+
+# ---------------------------------------------------------------------------
+# Flagship acceptance: SIGSTOP a rank mid-step, live (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _wait_progress(path, pred, deadline_s, what):
+    deadline = time.monotonic() + deadline_s
+    rows = []
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            rows = [ln.split(",") for ln in
+                    open(path).read().splitlines() if ln]
+            if pred(rows):
+                return rows
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}; rows={rows}")
+
+
+def _make_wedge_loop():
+    """Deterministic per-step 'training' (nested scope: cloudpickle
+    ships it by value into gang workers). Loss is a pure function of
+    the step, so a step re-run after a re-form must reproduce the SAME
+    loss — the continuity assert — and params restored from the
+    checkpoint (not re-initialized) are what make that hold."""
+
+    def loop(config):
+        import os as _os
+        import time as _time
+
+        from ray_tpu import train as _train
+        from ray_tpu.train import Checkpoint as _Checkpoint
+
+        ctx = _train.get_context()
+        params = 100.0
+        start = 0
+        ckpt = _train.get_checkpoint()
+        if ckpt:
+            meta = ckpt.get_metadata()
+            start = meta.get("step", -1) + 1
+            params = meta.get("params", params)
+        for step in range(start, config["steps"]):
+            _time.sleep(0.3)  # the per-step compute window
+            params = params * 0.9  # deterministic: params == 100*0.9^(s+1)
+            loss = params * params
+            with open(config["progress"] + f".r{ctx.get_world_rank()}",
+                      "a") as f:
+                f.write(f"{step},{ctx.get_world_size()},{loss:.6f}\n")
+            if ctx.get_world_rank() == 0:
+                cdir = _os.path.join(config["base"], f"wip_{step}")
+                _os.makedirs(cdir, exist_ok=True)
+                c = _Checkpoint(cdir)
+                c.update_metadata({"step": step, "params": params})
+                _train.report({"step": step, "loss": loss},
+                              checkpoint=c)
+            else:
+                _train.report({"step": step, "loss": loss})
+
+    return loop
+
+
+def test_wedge_flagship_sigstop_detect_kill_reform(ray_start,
+                                                   monkeypatch,
+                                                   tmp_path):
+    """THE acceptance check: SIGSTOP one rank of a 2-worker elastic
+    gang mid-distributed-step (stall_worker chaos fault; the heartbeat
+    sidecar freezes with it). Requires: gang_rank_wedged HEALTH_ALERT
+    within 2 harvest intervals; step-deadline trip -> NM hard-kill ->
+    reason="wedge" reconfiguration; resume from the latest durable
+    checkpoint with step AND loss continuity; elastic.wedge_detect on
+    the merged span timeline. A slow-but-alive gang must never trip:
+    every pre-wedge step already overruns the 1.5s deadline check
+    window's heartbeat refresh without tripping (fresh beats)."""
+    from ray_tpu._private.config import Config
+    from ray_tpu.util import metrics as metrics_mod
+    from ray_tpu.util import state as state_api
+
+    steps_total = 12
+    progress = str(tmp_path / "progress")
+    # Staggered thresholds: the watchdog (gang_heartbeat_stale_s=1.0)
+    # must alert BEFORE the driver supervisor (3.0s) trips — the trip's
+    # teardown clears the gang's heartbeat rows, and with equal
+    # thresholds the gauge series can vanish between the staleness
+    # crossing and the next harvest, racing the alert away.
+    monkeypatch.setattr(Config, "watchdog_gang_heartbeat_s", 3.0)
+    chaos.clear()
+    harvest_s = 0.5
+    _gcs().call("metrics_configure", interval_s=harvest_s,
+                cooldown_s=0.1, gang_heartbeat_stale_s=1.0)
+    fit_result = []
+    try:
+        trainer = DataParallelTrainer(
+            _make_wedge_loop(),
+            train_loop_config={"steps": steps_total,
+                               "base": str(tmp_path),
+                               "progress": progress},
+            scaling_config=ScalingConfig(
+                num_workers=2, resources_per_worker={"CPU": 1},
+                elastic_min_workers=1, elastic_reform_timeout_s=15.0,
+                step_deadline_s=1.5),
+            run_config=RunConfig(
+                storage_path=str(tmp_path), name="wedge_flagship",
+                failure_config=FailureConfig(max_failures=4)))
+        t = threading.Thread(
+            target=lambda: fit_result.append(trainer.fit()),
+            daemon=True)
+        t.start()
+
+        # phase 1: both ranks training (>= 2 steps logged by rank 0)
+        _wait_progress(progress + ".r0",
+                       lambda rows: len(rows) >= 2 and
+                       rows[-1][1] == "2",
+                       60, "world-2 training")
+
+        # phase 2: SIGSTOP one gang rank. 60s stall >> detection time:
+        # the rank stays frozen until the supervisor SIGKILLs it via
+        # its node manager; the actuator's SIGCONT at 60s lands on a
+        # dead pid (the tolerated stray).
+        t_stall = time.time()
+        chaos.inject("stall_worker", actor_class="RayTrainWorker*",
+                     probability=1.0, max_fires=1, delay_ms=60000.0)
+
+        # phase 3: the watchdog alert lands within 2 harvest intervals
+        # of the staleness threshold being crossed
+        # filter to THIS trainer's gang plane: an abandoned formation
+        # from an earlier test in the shared session can legitimately
+        # carry gang_rank_wedged alerts of its own
+        alert = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and alert is None:
+            for al in state_api.health_alerts():
+                if al.get("probe") == "gang_rank_wedged" and \
+                        al.get("ts", 0) >= t_stall and \
+                        str(al.get("gang", "")).startswith("train:"):
+                    alert = al
+            time.sleep(0.1)
+        assert alert is not None, \
+            "watchdog never alerted on the wedged rank"
+        assert alert["severity"] == "ERROR"
+        # fired <= stall + staleness(1.0s) + 2 harvests (+ firing lag
+        # of the stall rule itself, bounded by one NM dispatch ~ one
+        # harvest, + slack for a loaded box)
+        assert alert["ts"] - t_stall < 1.0 + 3 * harvest_s + 6.0
+
+        # phase 4: the run completes — wedge detected, rank hard-killed,
+        # gang re-formed from the latest durable checkpoint, resumed
+        t.join(timeout=120)
+        assert not t.is_alive(), "fit() never finished after the wedge"
+        result = fit_result[0]
+        assert result.error is None, f"run failed: {result.error!r}"
+        assert result.metrics["step"] == steps_total - 1
+
+        # step continuity: rank 0's log covers every step with no
+        # restart-from-0 after the wedge; re-run steps (the tail beyond
+        # the last durable checkpoint) reproduce the SAME loss — params
+        # came from the checkpoint, not re-initialization
+        rows = [ln.split(",") for ln in
+                open(progress + ".r0").read().splitlines() if ln]
+        seen = {}
+        steps_seq = [int(r[0]) for r in rows]
+        assert sorted(set(steps_seq)) == list(range(steps_total))
+        for r in rows:
+            seen.setdefault(int(r[0]), set()).add(r[2])
+        for step, losses in seen.items():
+            assert len(losses) == 1, \
+                (step, losses, "re-run step diverged from checkpoint")
+        # resumed from the LATEST durable checkpoint, not from scratch:
+        # the wedge landed after >= 2 steps, so a restart-from-0 would
+        # re-run 3+ steps — resume re-runs at most the round in flight
+        assert len(rows) <= steps_total + 2, \
+            (len(rows), "resumed too far back — not the latest checkpoint")
+
+        # reason="wedge" on the reconfiguration counter
+        counter = metrics_mod.get_or_create(
+            metrics_mod.Counter,
+            "ray_tpu_elastic_reconfigurations_total",
+            tag_keys=("reason",))
+        reasons = {dict(k).get("reason"): v
+                   for k, v in counter.snapshot()["values"].items()}
+        assert reasons.get("wedge", 0) >= 1, reasons
+
+        # elastic.wedge_detect rides the merged span timeline
+        from ray_tpu._private import spans as spans_mod
+        events = spans_mod.merge_snapshots(_gcs().call("spans_collect"))
+        wedges = [e for e in events
+                  if str(e.get("name", "")) == "elastic.wedge_detect"]
+        assert wedges, sorted({str(e.get("name", "")) for e in events
+                               if "elastic" in str(e.get("name", ""))})
+        args = wedges[-1].get("args") or {}
+        assert args.get("classification") in ("rank_wedge",
+                                              "slice_leave"), args
+
+        # the stall fired exactly once and was accounted
+        assert sum(r["fired"] for r in chaos.list_rules()) == 1
+    finally:
+        chaos.clear()
+        # restore the config DEFAULT (monkeypatch teardown runs after
+        # this finally, so Config still reads the test's 2.5 here)
+        _gcs().call("metrics_configure", interval_s=2.0, cooldown_s=30.0,
+                    gang_heartbeat_stale_s=10.0, step_deadline_s=0)
+    assert_ownership_drains()
+
+
+def test_slow_but_alive_gang_never_trips(ray_start):
+    """Negative acceptance: every step overruns the 1s explicit
+    deadline but all heartbeats stay fresh — the two-factor trip keeps
+    waiting and the run finishes with ZERO reconfigurations."""
+    import tempfile
+
+    from ray_tpu.util import metrics as metrics_mod
+
+    base = tempfile.mkdtemp(prefix="slow_alive_")
+
+    def make_loop():
+        def loop(config):
+            import time as _time
+
+            from ray_tpu import train as _train
+            for step in range(config["steps"]):
+                _time.sleep(1.6)  # > deadline, every step
+                _train.report({"step": step})
+        return loop
+
+    def wedge_count():
+        counter = metrics_mod.get_or_create(
+            metrics_mod.Counter,
+            "ray_tpu_elastic_reconfigurations_total",
+            tag_keys=("reason",))
+        return sum(v for k, v in counter.snapshot()["values"].items()
+                   if dict(k).get("reason") == "wedge")
+
+    # judge ONLY reason="wedge": on a busy shared cluster the gang may
+    # legitimately form degraded and scale up (reason="scale_up") —
+    # the property under test is that slow steps never read as a wedge
+    before = wedge_count()
+    chaos.clear()
+    result = DataParallelTrainer(
+        make_loop(), train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 1},
+            elastic_min_workers=1, step_deadline_s=1.0),
+        run_config=RunConfig(
+            storage_path=base, name="slow_alive",
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert result.error is None, f"slow-but-alive run failed: " \
+                                 f"{result.error!r}"
+    assert result.metrics["step"] == 2
+    assert wedge_count() == before, \
+        "slow-but-alive steps tripped the wedge"
+    assert_ownership_drains()
+
+
+# ---------------------------------------------------------------------------
+# Sweep drill (tools/chaos_sweep.py --schedule wedge)
+# ---------------------------------------------------------------------------
+
+
+def _run_sweep(extra_args, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_sweep.py"),
+         "--schedule", "wedge", "--format", "json", *extra_args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON from sweep: {proc.stdout[-2000:]}" \
+                  f"{proc.stderr[-2000:]}"
+    return json.loads(lines[-1])
+
+
+def test_chaos_sweep_wedge_smoke():
+    out = _run_sweep(["--seeds", "1", "--timeout", "240"], timeout=300)
+    assert out["schedule"] == "wedge"
+    assert out["failed_seeds"] == [], out
+
+
+@pytest.mark.slow  # multi-seed, multi-cycle SIGSTOP drill (~minutes)
+def test_chaos_sweep_wedge_multi_seed():
+    out = _run_sweep(["--seeds", "1,2,3", "--cycles", "2",
+                      "--timeout", "420"], timeout=1500)
+    assert out["failed_seeds"] == [], out
+    # across the seed sweep the stall rules actually fired somewhere
+    assert sum(r["fired"] for r in out["results"]) >= 1

@@ -67,6 +67,22 @@ SCHEDULES = {
         {"fault": "kill_worker", "actor_class": "RayTrainWorker",
          "method": "w_*", "probability": 0.02, "max_fires": 2},
     ],
+    # collective-wedge drill: seeded SIGSTOPs of train-gang members
+    # (fires on node-manager dispatch — the NM is the actuator) while
+    # the wedge workload below runs with a tight step deadline. The 8s
+    # stall outlives detection (~5s with the workload's tightened
+    # knobs) by design: the supervisor must hard-kill the stopped rank
+    # (SIGKILL works on stopped processes) and re-form, and the
+    # actuator's eventual SIGCONT usually lands on a dead pid — the
+    # tolerated "stray resume". A stall landing outside a result round
+    # (e.g. during formation, whose waits are not wedge-aware) resolves
+    # itself at SIGCONT, bounding the hang. Fires during rounds must
+    # show up as reason="wedge" reconfigurations; ownership must drain.
+    "wedge": [
+        {"fault": "stall_worker", "actor_class": "RayTrainWorker",
+         "method": "nm_*", "probability": 0.1, "max_fires": 2,
+         "delay_ms": 8000.0},
+    ],
 }
 
 _SMOKE_WORKLOAD = """
@@ -173,6 +189,103 @@ assert not leaks, "ownership leak after elastic cycles: " + "; ".join(leaks)
 print("ELASTIC_WORKLOAD_OK")
 """
 
+# Wedge drill workload (schedule "wedge"): the elastic drill with the
+# collective-wedge supervisor armed tight — explicit 2s step deadline,
+# 3s heartbeat staleness — so a SIGSTOPped rank (which freezes the
+# heartbeat sidecar too) trips detect -> hard-kill -> re-form within a
+# few seconds instead of the defaults' ~12s. Exit 0 requires the run to
+# finish at the full step count, every stall fire to be accounted as a
+# reason="wedge" reconfiguration, and the ownership plane to drain.
+_WEDGE_WORKLOAD = """
+import os
+import tempfile
+import time
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu._private.config import Config
+from ray_tpu.train import (Checkpoint, DataParallelTrainer, FailureConfig,
+                           RunConfig, ScalingConfig)
+
+# tighten detection: trip needs BOTH the step deadline expired AND a
+# heartbeat stale past this threshold (two-factor; driver-side check)
+Config.watchdog_gang_heartbeat_s = 3.0
+
+cycles = int(os.environ.get("RAY_TPU_SWEEP_ELASTIC_CYCLES", "1"))
+steps_total = 6 * cycles
+base = tempfile.mkdtemp(prefix="wedge_sweep_")
+
+
+def loop(config):
+    ctx = train.get_context()
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt:
+        start = ckpt.get_metadata().get("step", -1) + 1
+    for step in range(start, config["steps"]):
+        # a real per-step compute window so a stall can land mid-step
+        time.sleep(0.2)
+        if ctx.get_world_rank() == 0:
+            cdir = os.path.join(config["base"], f"wip_{step}")
+            os.makedirs(cdir, exist_ok=True)
+            c = Checkpoint(cdir)
+            c.update_metadata({"step": step})
+            train.report({"step": step,
+                          "world": ctx.get_world_size()}, checkpoint=c)
+        else:
+            train.report({"step": step, "world": ctx.get_world_size()})
+
+
+result = DataParallelTrainer(
+    loop, train_loop_config={"steps": steps_total, "base": base},
+    scaling_config=ScalingConfig(
+        num_workers=2, resources_per_worker={"CPU": 1},
+        elastic_min_workers=1, elastic_reform_timeout_s=10.0,
+        step_deadline_s=2.0),
+    run_config=RunConfig(
+        storage_path=base, name="wedge_sweep",
+        failure_config=FailureConfig(max_failures=10))).fit()
+assert result.error is None, f"wedge run failed: {result.error!r}"
+assert result.metrics["step"] == steps_total - 1, result.metrics
+
+# Account the stalls: a fire landing inside a result round trips a
+# reason="wedge" re-form (the deterministic flagship test in
+# tests/test_wedge.py asserts that one-for-one); a fire landing
+# OUTSIDE a round (formation, teardown) self-resolves at SIGCONT
+# without a trip. The sweep's hard invariants are completion at the
+# full step count and a clean ownership drain under EVERY seed's
+# fault pattern; the wedge/fire accounting is printed for the record.
+from ray_tpu import chaos
+from ray_tpu.util import metrics as metrics_mod
+
+fired = sum(r["fired"] for r in chaos.list_rules())
+counter = metrics_mod.get_or_create(
+    metrics_mod.Counter, "ray_tpu_elastic_reconfigurations_total",
+    tag_keys=("reason",))
+reasons = {dict(k).get("reason"): v
+           for k, v in counter.snapshot()["values"].items()}
+
+# ownership drain canary: wedge teardown (hard-killed rank included)
+# must not leak lease slots or pins
+import gc
+
+from ray_tpu._private import ownership
+from ray_tpu._private import worker as worker_mod
+
+cw = worker_mod.global_worker().core_worker
+deadline = time.monotonic() + 15
+leaks = []
+while time.monotonic() < deadline:
+    gc.collect()
+    with cw._lock:
+        leaks = ownership.lease_drain_report(cw._ltab)
+    if not leaks:
+        break
+    time.sleep(0.25)
+assert not leaks, "ownership leak after wedge cycles: " + "; ".join(leaks)
+print(f"WEDGE_WORKLOAD_OK fired={fired} wedges={reasons.get('wedge', 0)}")
+"""
+
 _RUNNER = """
 import json
 import sys
@@ -254,7 +367,7 @@ def main() -> int:
 
     seeds = [int(s) for s in args.seeds.split(",")] if args.seeds \
         else list(range(1, args.num_seeds + 1))
-    if args.schedule == "elastic":
+    if args.schedule in ("elastic", "wedge"):
         os.environ["RAY_TPU_SWEEP_ELASTIC_CYCLES"] = str(args.cycles)
     script_path = args.script
     tmp = None
@@ -262,8 +375,9 @@ def main() -> int:
         import tempfile
         fd, tmp = tempfile.mkstemp(suffix="_chaos_smoke.py")
         with os.fdopen(fd, "w") as f:
-            f.write(_ELASTIC_WORKLOAD if args.schedule == "elastic"
-                    else _SMOKE_WORKLOAD)
+            f.write({"elastic": _ELASTIC_WORKLOAD,
+                     "wedge": _WEDGE_WORKLOAD}.get(args.schedule,
+                                                   _SMOKE_WORKLOAD))
         script_path = tmp
 
     results = []
