@@ -997,6 +997,36 @@ class Watchdog:
                 f"(reason=wedge)", severity="ERROR",
                 gang=gang, rank=rank, value=v)
 
+    def _probe_replay_stall(self, series: Dict[str, float]) -> None:
+        """`replay_shard_stall`: a replay shard with un-acked pushes
+        outstanding (`ray_tpu_replay_push_inflight{shard}` > 0) whose
+        add counter (`ray_tpu_replay_added_total{shard}`) did not move
+        since the previous harvest is absorbing pushes without applying
+        them — a wedged or overloaded shard actor. Writers keep shedding
+        against its full inflight window, so the symptom the trainer
+        sees is silent sample loss, not an error. First-appearance
+        series baseline (prev None), so a stalled shard alerts within
+        two harvest intervals."""
+        for key, inflight in series.items():
+            if not key.startswith("ray_tpu_replay_push_inflight{"):
+                continue
+            if inflight <= 0:
+                continue
+            shard = self._series_tags(key).get("shard", "?")
+            added_key = f"ray_tpu_replay_added_total{{shard={shard}}}"
+            cur = series.get(added_key)
+            prev = self._prev_series.get(added_key)
+            if cur is None or prev is None:
+                continue  # baseline round for this shard
+            if cur <= prev:
+                self._alert(
+                    "replay_shard_stall", key,
+                    f"replay shard {shard}: {inflight:g} pushes in "
+                    f"flight but added_total did not move this harvest "
+                    f"(stuck at {cur:g}) — the shard actor is wedged "
+                    f"or overloaded and writers are shedding against "
+                    f"its full window", shard=shard, value=inflight)
+
     def _probe_jax_sentinel(self, series: Dict[str, float]) -> None:
         """`jit_recompile_storm` / `unexpected_host_transfer`: per-
         harvest deltas of the jax sentinel's counters
@@ -1099,6 +1129,7 @@ class Watchdog:
                       lambda: self._probe_elastic(snaps),
                       lambda: self._probe_gang_wedge(series),
                       lambda: self._probe_jax_sentinel(series),
+                      lambda: self._probe_replay_stall(series),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:

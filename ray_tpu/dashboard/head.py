@@ -375,6 +375,11 @@ class DashboardHead:
             # installed chaos rules + cluster-wide fired counts
             # (_private/chaos.py; `ray_tpu chaos` CLI equivalent)
             return s.chaos_rules()
+        if route == "/api/replay":
+            # distributed replay plane: per-shard occupancy, adds,
+            # priority updates, stale-ticket drops (rllib/utils/replay/;
+            # CLI: `ray_tpu replay`)
+            return s.replay_shards()
         if route == "/api/events":
             return s.list_cluster_events(
                 event_type=params.get("type"),

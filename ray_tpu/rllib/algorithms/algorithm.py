@@ -144,11 +144,6 @@ class Algorithm:
             if self.ma_learner_cls is None:
                 raise ValueError(
                     f"{type(self).__name__} has no multi-agent learner")
-            if config.num_learners > 0:
-                raise ValueError(
-                    "multi_agent(policies=...) currently supports the "
-                    "local learner only (num_learners=0); the mesh-gang "
-                    "learner path shards single-module batches")
             agents = getattr(probe, "agents", None)
             if agents:
                 mapped = {config.policy_mapping_fn(a) for a in agents}

@@ -14,7 +14,9 @@ threaded as a scalar array (no retrace per anneal step).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence
+import queue
+import threading
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
@@ -54,6 +56,17 @@ class DQNConfig(AlgorithmConfig):
         self.initial_epsilon = 1.0
         self.final_epsilon = 0.02
         self.epsilon_timesteps = 10_000
+        # distributed replay plane (APEX pattern, reference
+        # apex_dqn.py): >0 moves replay out of the driver into
+        # ReplayShardActors and decouples sample→store from
+        # replay→train into async loops. Needs num_env_runners > 0;
+        # with 0 runner actors the sync in-driver path runs regardless.
+        self.num_replay_shards = 0
+        self.replay_shard_capacity = None   # None -> buffer_size/shards
+        self.replay_max_inflight_pushes = 4  # per shard, then shed
+        self.replay_sample_inflight = 2      # pipelined pulls per shard
+        self.replay_queue_depth = 4          # staged-batch queue bound
+        self.max_requests_in_flight_per_env_runner = 2
 
 
 class DuelingQMLPModule(RLModule):
@@ -245,6 +258,10 @@ class DQNLearner(Learner):
                  "td_error": jnp.abs(td)}
         if "batch_indexes" in batch:
             stats["td_indexes"] = batch["batch_indexes"]
+        if "item_epochs" in batch:
+            # staleness tickets ride to the priority update so a shard
+            # can drop updates for slots recycled since the sample
+            stats["td_epochs"] = batch["item_epochs"]
         return loss, stats
 
     def additional_update(self, *, update_target: bool = False,
@@ -302,6 +319,22 @@ class DQN(Algorithm):
             config.epsilon_timesteps, config.final_epsilon,
             config.initial_epsilon)
         self._last_target_update = 0
+        # distributed replay plane (built lazily on first step)
+        self._replay_group = None
+        self._runner_mgr = None
+        self._writer_spec_version = -1
+        self._replay_thread: Optional[threading.Thread] = None
+        self._replay_stop = threading.Event()
+        self._replay_stats_lock = threading.Lock()
+        self._replay_learner_stats: Dict[str, float] = {}
+        self._replay_learner_error: Optional[BaseException] = None
+        self._replay_steps_trained = 0
+        self._replay_updates = 0
+        self._replay_weights_version = 0
+        self._replay_synced_version = 0
+        self._replay_touched: set = set()
+        self._replay_feed = None
+        self._last_reported_trained = 0
 
     def _extra_state(self) -> Dict[str, Any]:
         return {"last_target_update": self._last_target_update}
@@ -333,9 +366,199 @@ class DQN(Algorithm):
             self.learner_group.additional_update(update_target=True)
             self._last_target_update = self._timesteps_total
 
+    # ---- distributed replay plane (APEX pattern) --------------------
+
+    def _ensure_replay_plane(self) -> None:
+        if self._replay_group is not None:
+            return
+        cfg = self.config
+        from ray_tpu.rllib.utils.replay import ReplayGroup
+        from ray_tpu.util.actor_manager import FaultTolerantActorManager
+        n = cfg.num_replay_shards
+        capacity = cfg.replay_shard_capacity or \
+            max(1, cfg.buffer_size // n)
+        self._replay_group = ReplayGroup(
+            n, capacity,
+            prioritized=cfg.prioritized_replay,
+            alpha=cfg.prioritized_replay_alpha,
+            beta=cfg.prioritized_replay_beta,
+            batch_size=cfg.train_batch_size,
+            min_size_to_sample=max(
+                cfg.train_batch_size,
+                cfg.num_steps_sampled_before_learning_starts // n),
+            seed=cfg.seed,
+            queue_depth=cfg.replay_queue_depth,
+            sample_inflight_per_shard=cfg.replay_sample_inflight)
+        self._replay_group.start()
+        self._runner_mgr = FaultTolerantActorManager(
+            self.env_runners.actors,
+            max_remote_requests_in_flight_per_actor=(
+                cfg.max_requests_in_flight_per_env_runner),
+            health_probe_method="ping")
+        self._install_writer_spec()
+        if self._replay_thread is None:
+            self._replay_thread = threading.Thread(
+                target=self._replay_learner_loop, daemon=True,
+                name="dqn-replay-learner")
+            self._replay_thread.start()
+
+    def _install_writer_spec(self) -> None:
+        """Ship the current shard handle set to every runner — called at
+        startup and again whenever the group resharded (a replaced shard
+        means the old handles route pushes into a dead actor)."""
+        cfg = self.config
+        spec = {"shards": self._replay_group.shard_handles(),
+                "max_inflight_per_shard": cfg.replay_max_inflight_pushes,
+                "gamma": cfg.gamma, "n_step": cfg.n_step}
+        self._runner_mgr.foreach_actor(
+            ("set_replay_writer", (spec,), None), timeout_seconds=60.0)
+        self._writer_spec_version = self._replay_group.reshard_version
+
+    def _replay_learner_loop(self) -> None:
+        """replay→train loop: drain staged batches the ReplayGroup
+        puller pipelined off the shards, update, and route TD-error
+        priorities back to the issuing shard (one-way)."""
+        import time as _time
+
+        from ray_tpu._private import spans as _spans
+        from ray_tpu.util import jax_sentinel
+
+        cfg = self.config
+        group = self._replay_group
+        if self.learner_group._local is not None:
+            from ray_tpu.rllib.utils.device_feed import DeviceFeed
+            self._replay_feed = DeviceFeed(group.queue,
+                                           stop_event=self._replay_stop)
+        while not self._replay_stop.is_set():
+            staged = None
+            try:
+                if self._replay_feed is not None:
+                    batch, meta = self._replay_feed.get(timeout=0.2)
+                else:
+                    staged, meta = group.queue.get(timeout=0.2)
+                    batch = staged.as_dict()
+            except queue.Empty:
+                continue
+            try:
+                t0 = _time.perf_counter()
+                with _spans.span("learner.step",
+                                 steps=cfg.train_batch_size), \
+                        jax_sentinel.step_region("learner.step"):
+                    st = self.learner_group.update(
+                        batch, minibatch_size=None, num_iters=1,
+                        seed=(cfg.seed or 0) + self._replay_updates)
+                if self._replay_feed is not None:
+                    self._replay_feed.add_busy(
+                        _time.perf_counter() - t0)
+            except BaseException as e:  # noqa: BLE001
+                self._replay_learner_error = e
+                return
+            finally:
+                if staged is not None:
+                    staged.release()
+            if group.prioritized and "td_error" in st:
+                group.update_priorities(
+                    meta.get("shard_id"),
+                    np.asarray(st["td_indexes"], np.int64),
+                    np.asarray(st["td_error"], np.float64),
+                    np.asarray(st["td_epochs"], np.int64)
+                    if "td_epochs" in st else None)
+            self._after_each_update()
+            with self._replay_stats_lock:
+                self._replay_learner_stats = {
+                    k: float(v) for k, v in st.items()
+                    if not getattr(v, "ndim", 0)}
+                self._replay_steps_trained += cfg.train_batch_size
+                self._replay_updates += 1
+                self._replay_weights_version += 1
+
+    def _training_step_replay_plane(self) -> Dict[str, Any]:
+        """sample→store and replay→train as decoupled async loops: env
+        runners push transitions straight to the replay shards (only
+        metadata returns here), the group's puller keeps sample RPCs
+        pipelined, and the learner thread trains off the staged queue."""
+        import ray_tpu
+
+        cfg = self.config
+        if self._replay_learner_error is not None:
+            raise self._replay_learner_error
+        self._ensure_replay_plane()
+        stats: Dict[str, Any] = {}
+        self._before_sample(stats)
+        per_request = cfg.rollout_fragment_length \
+            * cfg.num_envs_per_env_runner
+        self._runner_mgr.foreach_actor_async(
+            ("sample_to_replay", (per_request,), None))
+        results = self._runner_mgr.fetch_ready_async_reqs(
+            timeout_seconds=2.0)
+        sampled = 0
+        writer_stats: Dict[str, int] = {}
+        for r in results:
+            if not r.ok:
+                continue
+            meta = r.value
+            sampled += meta["steps"]
+            self._record_episode_metrics([meta])
+            self._replay_touched.add(r.actor_id)
+            writer_stats = meta.get("writer", writer_stats)
+        self._timesteps_total += sampled
+        # a reshard invalidates the shard handles baked into runner
+        # writers — re-ship the spec before more pushes go astray
+        if self._replay_group.reshard_version != \
+                self._writer_spec_version:
+            self._install_writer_spec()
+        with self._replay_stats_lock:
+            version = self._replay_weights_version
+            lstats = dict(self._replay_learner_stats)
+            trained_total = self._replay_steps_trained
+            updates_total = self._replay_updates
+        trained_delta = trained_total - self._last_reported_trained
+        self._last_reported_trained = trained_total
+        if version > self._replay_synced_version and \
+                self._replay_touched:
+            weights = self.learner_group.get_weights()
+            actors = self._runner_mgr.actors()
+            targets = [actors[i] for i in self._replay_touched
+                       if i in actors]
+            ray_tpu.get(
+                [a.set_weights.remote(weights) for a in targets],
+                timeout=300)
+            self._replay_synced_version = version
+            self._replay_touched.clear()
+        self._maybe_update_target()
+        if self._iteration % 10 == 9:
+            self._runner_mgr.probe_unhealthy_actors(timeout_seconds=2.0)
+            self._replay_group.probe_unhealthy()
+        stats.update(lstats)
+        return {
+            "learner": stats,
+            "num_env_steps_sampled": sampled,
+            "num_env_steps_trained": trained_delta,
+            "num_env_steps_trained_total": trained_total,
+            "num_updates_total": updates_total,
+            "replay": self._replay_group.stats(),
+            "replay_writer": writer_stats,
+            "num_healthy_env_runners":
+                self._runner_mgr.num_healthy_actors(),
+            "device_feed": (self._replay_feed.stats()
+                            if self._replay_feed is not None else {}),
+        }
+
+    def stop(self) -> None:
+        self._replay_stop.set()
+        if self._replay_thread is not None:
+            self._replay_thread.join(timeout=10)
+            self._replay_thread = None
+        if self._replay_group is not None:
+            self._replay_group.stop()
+            self._replay_group = None
+        super().stop()
+
     # ---- the shared replay loop -------------------------------------
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
+        if cfg.num_replay_shards > 0 and self.env_runners.actors:
+            return self._training_step_replay_plane()
         # --- explore + sample (reference dqn.py training_step) -------
         stats: Dict[str, Any] = {}
         self._before_sample(stats)

@@ -412,6 +412,56 @@ class MultiAgentLearnerMixin:
     independent per-module gradients, and one optimizer updates the
     union params pytree."""
 
+    def update_distributed(self, local_batch, minibatch_size=None,
+                           num_iters=1, seed=0):
+        """DDP-style minibatch SGD over a nested {module_id: columns}
+        batch. Each rank holds its own per-module shard (equal sizes
+        across ranks — _MeshLearnerActor._local_shard truncates), the
+        shared seed makes every rank pick identical per-module index
+        sets and step counts (collectives wedge otherwise), and each
+        step's global minibatch is the per-module union of the local
+        samples — per-agent modules shard across learner ranks with
+        static per-rank shapes."""
+        import jax
+
+        n_m = {mid: len(next(iter(b.values())))
+               for mid, b in local_batch.items()}
+        empty = [mid for mid, n in n_m.items() if n == 0]
+        if empty:
+            raise ValueError(
+                f"modules {empty} have no rows on this learner rank: "
+                f"every rank needs >=1 row per module (grow "
+                f"train_batch_size / rollout length or reduce "
+                f"num_learners)")
+        nprocs = max(1, jax.process_count())
+        total = sum(n_m.values())
+        local_target = max(1, (minibatch_size or total * nprocs)
+                           // nprocs)
+        mb_m = {mid: max(1, min(n, round(local_target * n / total)))
+                for mid, n in n_m.items()}
+        steps_per_epoch = max(1, min(n // mb_m[mid]
+                                     for mid, n in n_m.items()))
+        rng = np.random.default_rng(seed)
+        stats: Dict[str, Any] = {}
+        count = 0
+        for _ in range(num_iters):
+            perms = {mid: rng.permutation(n) for mid, n in n_m.items()}
+            for s in range(steps_per_epoch):
+                gb = {}
+                for mid, b in local_batch.items():
+                    idx = perms[mid][s * mb_m[mid]:(s + 1) * mb_m[mid]]
+                    gb[mid] = self._make_global_batch(
+                        {k: np.take(v, idx,
+                                    axis=self.data_axis_for(k))
+                         for k, v in b.items()})
+                with self._state_lock:
+                    self._params, self._opt_state, st = \
+                        self._update_fn(self._params, self._opt_state,
+                                        gb, self.extra_inputs())
+                count += 1
+                self._accumulate(stats, st)
+        return self._finalize(stats, count)
+
     def update(self, batch, minibatch_size=None, num_iters=1, seed=0):
         import jax
 

@@ -63,6 +63,16 @@ class _MeshLearnerActor:
         plat = os.environ.get("JAX_PLATFORMS")
         if plat:
             jax.config.update("jax_platforms", plat)
+        if plat == "cpu":
+            # XLA's CPU backend refuses cross-process computations
+            # ("Multiprocess computations aren't implemented on the CPU
+            # backend") unless collectives go through gloo — required
+            # for the chip-free ladder to exercise real gang updates.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # noqa: BLE001 - older jax: no such knob
+                pass
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=world, process_id=rank)
         self.rank = rank
@@ -78,7 +88,14 @@ class _MeshLearnerActor:
     def _local_shard(self, batch: Dict[str, np.ndarray]
                      ) -> Dict[str, np.ndarray]:
         """Equal per-rank slices along each column's data axis (truncating
-        the remainder so every rank runs identical jit step counts)."""
+        the remainder so every rank runs identical jit step counts).
+        Multi-agent batches are nested {module_id: {col: array}}; each
+        module's rows shard independently so every rank holds a static
+        per-module shape (the lane→module split is deterministic, so all
+        ranks agree on each module's row count)."""
+        if batch and all(isinstance(v, dict) for v in batch.values()):
+            return {mid: self._local_shard(sub)
+                    for mid, sub in batch.items()}
         first = next(iter(batch))
         axis = self.learner.data_axis_for(first)
         n = batch[first].shape[axis]

@@ -324,5 +324,46 @@ class SingleAgentEnvRunner:
         batch["worker_index"] = self.worker_index
         return batch
 
+    # ---- replay-plane push path (APEX pattern) ----------------------
+    def set_replay_writer(self, spec: Optional[Dict[str, Any]]) -> None:
+        """Install (or clear, with None) the replay push client. The
+        driver ships `spec` after spawning shards and again after every
+        reshard: {"shards": [(shard_id, handle)], "max_inflight_per_shard",
+        "gamma", "n_step"} — shard ActorHandles are picklable, so the
+        spec travels as a plain actor-call argument."""
+        if spec is None:
+            self._replay_writer = None
+            return
+        from ray_tpu.rllib.utils.replay import ReplayWriter
+        self._replay_writer = ReplayWriter(
+            spec["shards"],
+            max_inflight_per_shard=spec.get("max_inflight_per_shard", 4))
+        self._replay_gamma = spec.get("gamma", self.gamma)
+        self._replay_n_step = spec.get("n_step", 1)
+        self._replay_seq = getattr(self, "_replay_seq", 0)
+
+    def sample_to_replay(self, num_timesteps: int) -> Dict[str, Any]:
+        """Roll out and push the transitions straight to the replay
+        shards; only lightweight metadata returns to the driver (the
+        fragment itself rides the scatter-put envelope to its shard,
+        never back through the driver)."""
+        writer = getattr(self, "_replay_writer", None)
+        assert writer is not None, "set_replay_writer before sampling"
+        # late import: dqn imports algorithm imports this module
+        from ray_tpu.rllib.algorithms.dqn.dqn import fragment_to_transitions
+        fragment = self.sample(num_timesteps)
+        trans = fragment_to_transitions(
+            fragment, self._replay_gamma, n_step=self._replay_n_step)
+        self._replay_seq += 1
+        shard = writer.push(
+            trans, route_key=f"{self.worker_index}:{self._replay_seq}")
+        return {
+            "steps": int(len(trans["rewards"])),
+            "episode_metrics": fragment.get("episode_metrics", []),
+            "worker_index": self.worker_index,
+            "pushed_to_shard": shard,
+            "writer": writer.stats(),
+        }
+
     def stop(self) -> None:
         self.env.close()

@@ -27,7 +27,14 @@ class ReplayBuffer:
         self._next = 0          # next write slot
         self._size = 0          # filled slots
         self._added = 0         # lifetime timesteps added
+        self._evicted = 0       # lifetime slots overwritten
         self._rng = np.random.default_rng(seed)
+        # Per-slot write generation: bumped on every (over)write. Sampled
+        # batches carry it as `item_epochs` so a priority update that
+        # arrives after the slot was recycled can be detected and dropped
+        # instead of silently re-prioritizing an unrelated transition.
+        self._epoch = np.zeros(self.capacity, np.int64)
+        self.unmatched_priority_updates = 0
 
     def __len__(self) -> int:
         return self._size
@@ -55,6 +62,8 @@ class ReplayBuffer:
         idx = (self._next + np.arange(n)) % self.capacity
         for k, v in batch.items():
             self._cols[k][idx] = v
+        self._evicted += max(0, self._size + n - self.capacity)
+        self._epoch[idx] += 1
         self._next = int((self._next + n) % self.capacity)
         self._size = min(self._size + n, self.capacity)
         self._added += n
@@ -68,6 +77,7 @@ class ReplayBuffer:
         idx = self._rng.integers(self._size, size=num_items)
         out = {k: v[idx] for k, v in self._cols.items()}
         out["batch_indexes"] = idx
+        out["item_epochs"] = self._epoch[idx].copy()
         return out
 
     def get_state(self) -> Dict[str, np.ndarray]:
@@ -161,14 +171,32 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         weights = weights / weights.max()
         out = {k: v[idx] for k, v in self._cols.items()}
         out["batch_indexes"] = idx
+        out["item_epochs"] = self._epoch[idx].copy()
         out["weights"] = weights.astype(np.float32)
         return out
 
-    def update_priorities(self, idx: np.ndarray,
-                          priorities: np.ndarray) -> None:
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray,
+                          epochs: Optional[np.ndarray] = None) -> int:
+        """Re-prioritize sampled slots; returns the number applied.
+
+        `epochs` (the `item_epochs` ticket from sample()) guards against
+        the APEX staleness class: an update racing an overwrite of the
+        same slot would otherwise land on a different transition. Stale
+        tickets are dropped and counted, never applied.
+        """
+        idx = np.asarray(idx)
         p = np.abs(np.asarray(priorities, np.float64)) + self._eps
+        if epochs is not None:
+            live = self._epoch[idx] == np.asarray(epochs)
+            self.unmatched_priority_updates += int((~live).sum())
+            idx, p = idx[live], p[live]
+            if not len(idx):
+                return 0
         self._max_priority = max(self._max_priority, float(p.max()))
-        self._tree.set(np.asarray(idx), p ** self.alpha)
+        # duplicate slots in one update batch: last write wins in the
+        # tree either way, but dedupe keeps set() idempotent
+        self._tree.set(idx, p ** self.alpha)
+        return int(len(idx))
 
     def get_state(self):
         state = super().get_state()

@@ -649,6 +649,41 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    """Distributed replay plane: one row per ReplayShardActor with the
+    shard's live occupancy, lifetime adds/evictions, priority-update
+    counts, and stale-ticket drops (see README "Distributed replay")."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    out = s.replay_shards()
+    if args.format == "json":
+        print(json.dumps(out, default=str))
+        return 0
+    print(f"replay shards: {out['num_alive']}/{out['num_shards']} "
+          f"alive  size={out['total_size']} "
+          f"added={out['total_added']} "
+          f"unmatched_updates={out['total_unmatched_priority_updates']}")
+    rows = []
+    for sh in out["shards"]:
+        st = sh.get("stats") or {}
+        rows.append({
+            "shard": st.get("shard_id", "?"),
+            "name": sh.get("name", ""),
+            "state": sh.get("state", "?"),
+            "restarts": sh.get("num_restarts", 0),
+            "size": st.get("size", ""),
+            "capacity": st.get("capacity", ""),
+            "added": st.get("added", ""),
+            "evicted": st.get("evicted", ""),
+            "updates": st.get("update_rpcs", ""),
+            "unmatched": st.get("unmatched_priority_updates", ""),
+        })
+    _print_table(rows, ["shard", "name", "state", "restarts", "size",
+                        "capacity", "added", "evicted", "updates",
+                        "unmatched"])
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Serve request telemetry (see README "Serve request telemetry"):
     the slowest + all errored requests captured by every ingress proxy,
@@ -969,6 +1004,13 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=10.0,
                    help="overall proxy fan-out deadline (seconds)")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("replay", help="distributed replay plane: "
+                                      "per-shard occupancy, adds, "
+                                      "priority updates, stale tickets")
+    p.add_argument("--address", default=None)
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.set_defaults(fn=cmd_replay)
 
     p = sub.add_parser("metrics", help="cluster metrics plane: dump the "
                                        "merged registry / watchdog alerts")

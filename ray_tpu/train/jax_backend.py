@@ -65,6 +65,15 @@ def _init_jax_distributed(coordinator_address: str, num_processes: int,
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         jax.config.update("jax_platforms", plat)
+    if plat == "cpu":
+        # XLA's CPU backend refuses cross-process computations unless
+        # collectives go through gloo — needed for the chip-free ladder
+        # to run real multi-process gang collectives.
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - older jax: no such knob
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

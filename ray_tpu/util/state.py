@@ -394,6 +394,59 @@ def serve_fleet() -> Dict[str, Any]:
     return status
 
 
+def replay_shards() -> Dict[str, Any]:
+    """Distributed replay plane state (rllib/utils/replay/): every live
+    ReplayShardActor found in the actor registry, enriched with each
+    shard's own stats() snapshot (size, added, evicted, priority
+    updates, unmatched tickets). CLI: `ray_tpu replay`; dashboard:
+    /api/replay."""
+    import ray_tpu
+    from ray_tpu.rllib.utils.replay import REPLAY_NAMESPACE
+
+    records = list_actors(filters={"class_name": "ReplayShardActor"})
+    shards: List[Dict[str, Any]] = []
+    pending = []
+    for rec in records:
+        row: Dict[str, Any] = {
+            "actor_id": rec["actor_id"],
+            "name": rec["name"],
+            "state": rec["state"],
+            "node_id": rec["node_id"],
+            "num_restarts": rec["num_restarts"],
+            "stats": None,
+        }
+        shards.append(row)
+        if rec["state"] != "ALIVE" or not rec["name"]:
+            continue
+        try:
+            h = ray_tpu.get_actor(rec["name"],
+                                  namespace=REPLAY_NAMESPACE)
+            pending.append((row, h.stats.remote()))
+        except Exception:  # noqa: BLE001 - died mid-listing
+            pass
+    if pending:
+        ready, _ = ray_tpu.wait([r for _row, r in pending],
+                                num_returns=len(pending), timeout=10)
+        ready_set = {r.hex() for r in ready}
+        for row, ref in pending:
+            if ref.hex() in ready_set:
+                try:
+                    # ready refs: local materialize, zero extra RPCs
+                    row["stats"] = ray_tpu.get(ref, timeout=10)  # graftlint: disable=RT002
+                except Exception:  # noqa: BLE001 - died mid-query
+                    pass
+    live = [s["stats"] for s in shards if s["stats"]]
+    return {
+        "num_shards": len(shards),
+        "num_alive": sum(1 for s in shards if s["state"] == "ALIVE"),
+        "total_size": sum(s["size"] for s in live),
+        "total_added": sum(s["added"] for s in live),
+        "total_unmatched_priority_updates": sum(
+            s["unmatched_priority_updates"] for s in live),
+        "shards": shards,
+    }
+
+
 def chaos_rules() -> Dict[str, Any]:
     """Installed chaos rules + cluster-wide fired counts (the runtime
     view behind `ray_tpu chaos list` and the dashboard /api/chaos)."""

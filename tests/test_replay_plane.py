@@ -1,0 +1,428 @@
+"""Distributed replay plane (rllib/utils/replay/): shard routing,
+prioritized-sampling parity, epoch-ticket staleness, zero-copy pushes,
+pipelined pulls, shard-death elasticity, the lifted multi-agent
+num_learners>0 path, the replay_shard_stall watchdog probe, and the
+chaos replay drill.
+
+reference parity: APEX/R2D2 replay-actor pattern
+(algorithms/dqn/apex_dqn.py, utils/replay_buffers/) — shards own local
+priorities, workers push, the learner pulls and sends TD-error
+priority updates back one-way.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.utils.replay import (REPLAY_NAMESPACE, ReplayGroup,
+                                        ReplayShardActor, ReplayWriter,
+                                        route_shard, shard_actor_name)
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _batch(rng, n=8, obs_dim=4):
+    return {
+        "obs": rng.standard_normal((n, obs_dim)).astype(np.float32),
+        "actions": rng.integers(0, 2, n).astype(np.int64),
+        "rewards": rng.standard_normal(n).astype(np.float32),
+    }
+
+
+class TestRouting:
+    def test_route_shard_deterministic_and_in_range(self):
+        for key in ("0:17", "worker-3:42", "x"):
+            first = route_shard(key, 4)
+            assert 0 <= first < 4
+            assert all(route_shard(key, 4) == first for _ in range(5))
+
+    def test_route_shard_spreads(self):
+        hits = {route_shard(f"w{i}:{j}", 4)
+                for i in range(8) for j in range(8)}
+        assert hits == {0, 1, 2, 3}
+
+    def test_route_shard_single(self):
+        assert route_shard("anything", 1) == 0
+
+
+class TestEpochTickets:
+    """(shard_id, item_epoch) staleness contract on the local buffer —
+    a priority update for a slot that was overwritten after sampling
+    must be dropped and counted, never applied to the new occupant."""
+
+    def test_stale_update_dropped_and_counted(self):
+        rng = np.random.default_rng(0)
+        buf = PrioritizedReplayBuffer(capacity=8, seed=1)
+        buf.add(_batch(rng, 8))
+        out = buf.sample(4, beta=0.4)
+        idx, epochs = out["batch_indexes"], out["item_epochs"]
+        buf.add(_batch(rng, 8))  # ring overwrite bumps every epoch
+        applied = buf.update_priorities(
+            idx, np.full(len(idx), 99.0), epochs=epochs)
+        assert applied == 0
+        assert buf.unmatched_priority_updates == len(idx)
+
+    def test_fresh_update_applied(self):
+        rng = np.random.default_rng(0)
+        buf = PrioritizedReplayBuffer(capacity=16, seed=1)
+        buf.add(_batch(rng, 8))
+        out = buf.sample(4, beta=0.4)
+        applied = buf.update_priorities(
+            out["batch_indexes"], np.full(4, 2.5),
+            epochs=out["item_epochs"])
+        assert applied == 4
+        assert buf.unmatched_priority_updates == 0
+
+    def test_same_seed_same_sample(self):
+        def run():
+            rng = np.random.default_rng(3)
+            buf = PrioritizedReplayBuffer(capacity=32, seed=7)
+            buf.add(_batch(rng, 20))
+            buf.update_priorities(np.arange(5), np.linspace(1, 5, 5))
+            out = buf.sample(8, beta=0.4)
+            return out["batch_indexes"], out["weights"]
+
+        i1, w1 = run()
+        i2, w2 = run()
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_allclose(w1, w2)
+
+
+class TestShardActor:
+    def test_prioritized_sampling_parity_with_local(self, ray_start):
+        """Same seed + same push sequence => the shard actor samples
+        the same indices/weights as a driver-local buffer."""
+        ray_tpu = ray_start
+        seed, shard_id, cap = 11, 3, 64
+        cls = ray_tpu.remote(ReplayShardActor)
+        actor = cls.options(num_cpus=0.1).remote(
+            shard_id, cap, prioritized=True, alpha=0.6, seed=seed,
+            group="parity")
+        # the actor derives its stream as seed + shard_id * 7919
+        local = PrioritizedReplayBuffer(
+            cap, alpha=0.6, seed=seed + shard_id * 7919)
+        rng = np.random.default_rng(5)
+        refs = []
+        for i in range(4):
+            b = _batch(rng, 16)
+            prios = np.abs(b["rewards"]) + 0.1
+            # actor calls are ordered per-caller, so the shard applies
+            # these pushes in sequence
+            refs.append(actor.push.remote(b, prios))
+            m = min(16, local.capacity)
+            idx = (local._next + np.arange(m)) % local.capacity  # noqa: SLF001
+            local.add(b)
+            local.update_priorities(idx, prios[-m:])
+        ray_tpu.get(refs, timeout=60)
+        got = ray_tpu.get(actor.sample.remote(8, beta=0.4), timeout=60)
+        want = local.sample(8, beta=0.4)
+        np.testing.assert_array_equal(
+            got["batch_indexes"], want["batch_indexes"])
+        np.testing.assert_allclose(got["weights"], want["weights"])
+        np.testing.assert_array_equal(
+            got["item_epochs"], want["item_epochs"])
+        ray_tpu.kill(actor)
+
+    def test_zero_copy_push_rpc_and_bytes(self, ray_start):
+        """ReplayWriter pushes ride the scatter-put envelope: the
+        driver copies the payload once into the store (site=put) and
+        the actor arg is a ref — pushing K batches must not double the
+        driver's transport bytes, and must cost exactly K push RPCs."""
+        ray_tpu = ray_start
+        from ray_tpu._private import core_worker as cw_mod
+
+        cls = ray_tpu.remote(ReplayShardActor)
+        actor = cls.options(num_cpus=0.1).remote(
+            0, 1024, prioritized=False, group="zerocopy")
+        writer = ReplayWriter([(0, actor)], max_inflight_per_shard=32)
+
+        def put_bytes():
+            # read the put path's cached Counter instance, not the
+            # registry: metrics.clear() elsewhere in the suite orphans
+            # the registered entry while _transport_bytes keeps
+            # incrementing this cache
+            c = cw_mod._TRANSPORT_COUNTER
+            if c is None:
+                return 0
+            vals = c.snapshot()["values"]
+            return sum(v for k, v in vals.items()
+                       if dict(k).get("site") == "put")
+
+        rng = np.random.default_rng(0)
+        # each batch must beat Config.max_inline_object_size (100 KiB)
+        # or the envelope rides inline with ZERO store copies and the
+        # site=put counter has nothing to show
+        k, rows, obs_dim = 4, 128, 256
+        batches = [_batch(rng, rows, obs_dim) for _ in range(k)]
+        payload = sum(sum(a.nbytes for a in b.values())
+                      for b in batches)
+        before = put_bytes()
+        for i, b in enumerate(batches):
+            assert writer.push(b, route_key=str(i)) == 0
+        writer.flush(timeout=60)
+        delta = put_bytes() - before
+        # one store copy per push (plus envelope overhead), not two
+        assert payload * 0.9 <= delta <= payload * 1.6, (delta, payload)
+        st = ray_tpu.get(actor.stats.remote(), timeout=60)
+        assert st["push_rpcs"] == k
+        assert st["added"] == k * rows
+        assert writer.stats()["pushes"] == k
+        assert writer.stats()["shed"] == 0
+        ray_tpu.kill(actor)
+
+
+class TestReplayGroup:
+    def _fill(self, ray_tpu, group, rows=256):
+        writer = ReplayWriter(group.shard_handles(),
+                              max_inflight_per_shard=8)
+        rng = np.random.default_rng(2)
+        pushed = 0
+        while pushed < rows:
+            writer.push(_batch(rng, 32), route_key=str(pushed))
+            pushed += 32
+        writer.flush(timeout=60)
+        return writer
+
+    def test_concurrent_pull_pipelining(self, ray_start):
+        ray_tpu = ray_start
+        group = ReplayGroup(2, 512, prioritized=True, batch_size=16,
+                            min_size_to_sample=16, seed=0,
+                            name="pipe", queue_depth=4)
+        try:
+            self._fill(ray_tpu, group)
+            group.start()
+            seen, pulls = set(), 0
+            deadline = time.monotonic() + 30
+            while (len(seen) < 2 or pulls < 6) and \
+                    time.monotonic() < deadline:
+                item = group.get_batch(timeout=1.0)
+                if item is None:
+                    continue
+                staged, meta = item
+                d = staged.as_dict()
+                for key in ("obs", "batch_indexes", "item_epochs",
+                            "weights"):
+                    assert key in d, sorted(d)
+                assert group.update_priorities(
+                    meta["shard_id"], d["batch_indexes"],
+                    np.abs(d["rewards"]) + 0.1, d["item_epochs"])
+                staged.release()
+                seen.add(meta["shard_id"])
+                pulls += 1
+            assert seen == {0, 1}
+            assert pulls >= 6
+            stats = group.shard_stats()
+            # every shard served multiple overlapped sample RPCs and
+            # saw the one-way priority updates land
+            assert all(s["sample_rpcs"] >= 2 for s in stats), stats
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                stats = group.shard_stats()
+                if sum(s["update_rpcs"] for s in stats) >= 1:
+                    break
+                time.sleep(0.2)
+            assert sum(s["update_rpcs"] for s in stats) >= 1, stats
+            assert group.stats()["priority_updates_sent"] == pulls
+        finally:
+            group.stop()
+
+    def test_shard_death_elasticity(self, ray_start):
+        """Killing a shard mid-pull must not halt the group: the dead
+        shard comes back as a fresh (empty) generation, the reshard
+        version bumps, and pulls keep flowing from the survivors."""
+        ray_tpu = ray_start
+        group = ReplayGroup(2, 512, prioritized=True, batch_size=16,
+                            min_size_to_sample=16, seed=0,
+                            name="elastic", queue_depth=4)
+        try:
+            self._fill(ray_tpu, group)
+            group.start()
+            assert group.get_batch(timeout=15.0) is not None
+            victim = dict(group.shard_handles())[1]
+            ray_tpu.kill(victim)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st = group.stats()
+                if st["shard_replacements"] >= 1 and \
+                        st["healthy_shards"] == 2:
+                    break
+                item = group.get_batch(timeout=0.5)
+                if item is not None:
+                    item[0].release()
+            st = group.stats()
+            assert st["shard_replacements"] >= 1, st
+            assert st["healthy_shards"] == 2, st
+            assert st["reshard_version"] >= 1, st
+            # the replacement is a fresh generation, registered under
+            # its bumped name and starting empty
+            handle = ray_tpu.get_actor(
+                shard_actor_name("elastic", 1, 1),
+                namespace=REPLAY_NAMESPACE)
+            assert ray_tpu.get(handle.stats.remote(),
+                               timeout=30)["added"] == 0
+            # pulls still flow (survivor keeps serving)
+            got = None
+            deadline = time.monotonic() + 15
+            while got is None and time.monotonic() < deadline:
+                got = group.get_batch(timeout=1.0)
+            assert got is not None
+            got[0].release()
+        finally:
+            group.stop()
+
+
+class TestWatchdogReplayStall:
+    def test_stalled_shard_alerts_within_two_harvests(self):
+        from ray_tpu._private.metrics_plane import Watchdog
+
+        alerts = []
+
+        def emit(event, message, **fields):
+            alerts.append((event, message, fields))
+
+        wd = Watchdog(emit, cooldown_s=0.0, wait_edge_age_s=60.0,
+                      store_occupancy_frac=0.9, queue_depth=100)
+        series = {"ray_tpu_replay_push_inflight{shard=1}": 3.0,
+                  "ray_tpu_replay_added_total{shard=1}": 640.0}
+        wd.evaluate([], dict(series), [])       # baseline harvest
+        assert not alerts
+        wd.evaluate([], dict(series), [])       # added_total stuck
+        assert len(alerts) == 1
+        assert alerts[0][2]["probe"] == "replay_shard_stall"
+        assert alerts[0][2]["shard"] == "1"
+
+    def test_healthy_shard_stays_quiet(self):
+        from ray_tpu._private.metrics_plane import Watchdog
+
+        alerts = []
+        wd = Watchdog(lambda *a, **k: alerts.append(a),
+                      cooldown_s=0.0, wait_edge_age_s=60.0,
+                      store_occupancy_frac=0.9, queue_depth=100)
+        wd.evaluate([], {"ray_tpu_replay_push_inflight{shard=0}": 2.0,
+                         "ray_tpu_replay_added_total{shard=0}": 100.0},
+                    [])
+        wd.evaluate([], {"ray_tpu_replay_push_inflight{shard=0}": 2.0,
+                         "ray_tpu_replay_added_total{shard=0}": 164.0},
+                    [])
+        assert not alerts
+
+
+class TestDQNReplayPlane:
+    def test_dqn_trains_through_two_shards(self, ray_start):
+        """The tentpole e2e: a real env-runner DQN run where sample ->
+        store goes through sharded replay actors and replay -> train is
+        the decoupled learner loop, with TD-error priority updates
+        flowing back to the owning shards."""
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ray_tpu.rllib.algorithms.dqn import DQNConfig
+
+        algo = (DQNConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=1,
+                             rollout_fragment_length=32)
+                .training(buffer_size=2000, train_batch_size=16,
+                          num_steps_sampled_before_learning_starts=32,
+                          target_network_update_freq=200,
+                          prioritized_replay=True,
+                          num_replay_shards=2,
+                          replay_shard_capacity=500)
+                .debugging(seed=0)
+                .build())
+        try:
+            result = {}
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                result = algo.train()
+                rep = result["replay"]
+                if result["num_env_steps_trained_total"] > 0 and \
+                        rep["priority_updates_sent"] > 0:
+                    break
+            rep = result["replay"]
+            assert result["num_env_steps_trained_total"] > 0, result
+            assert rep["batches_pulled"] > 0, rep
+            assert rep["priority_updates_sent"] > 0, rep
+            assert rep["healthy_shards"] == 2, rep
+            shards = algo._replay_group.shard_stats()  # noqa: SLF001
+            assert sum(s["added"] for s in shards) > 0, shards
+            assert "qf_loss" in result["learner"]
+        finally:
+            algo.stop()
+
+
+class TestMultiAgentGang:
+    def test_ma_num_learners_gang_e2e(self, ray_start):
+        """The algorithm.py multi-agent num_learners>0 rejection is
+        lifted: a 2-learner gang trains distinct per-module policies
+        with static lane->module shapes, per-module stats, and weight
+        movement on every module."""
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        from ray_tpu.rllib import make_multi_agent, register_env
+        from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+        register_env("ma_cartpole_replay_gang",
+                     make_multi_agent("CartPole-v1"))
+        algo = (PPOConfig()
+                .environment("ma_cartpole_replay_gang",
+                             env_config={"num_agents": 2})
+                .multi_agent(
+                    policies={"pol_a": None, "pol_b": None},
+                    policy_mapping_fn=lambda aid: "pol_a"
+                    if aid == "agent_0" else "pol_b")
+                .learners(num_learners=2)
+                .training(train_batch_size=128, minibatch_size=64,
+                          num_epochs=1)
+                .debugging(seed=0)
+                .build())
+        try:
+            w0 = jax.tree.leaves(
+                algo.learner_group.get_weights()["pol_a"])
+            result = algo.train()
+            for mid in ("pol_a", "pol_b"):
+                assert f"{mid}/policy_loss" in result["learner"], \
+                    sorted(result["learner"])
+            w1 = jax.tree.leaves(
+                algo.learner_group.get_weights()["pol_a"])
+            assert any(not np.allclose(a, b) for a, b in zip(w0, w1))
+        finally:
+            algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos replay drill (satellite): 1-seed smoke in tier-1; the
+# multi-seed sweep stays behind -m slow
+# ---------------------------------------------------------------------------
+
+
+def _run_sweep(extra_args, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_sweep.py"),
+         "--schedule", "replay", "--format", "json", *extra_args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON from sweep: {proc.stdout[-2000:]}" \
+                  f"{proc.stderr[-2000:]}"
+    return json.loads(lines[-1])
+
+
+def test_chaos_sweep_replay_smoke():
+    out = _run_sweep(["--seeds", "1", "--timeout", "300"])
+    assert out["schedule"] == "replay"
+    assert out["failed_seeds"] == [], out
+    # the deterministic after_n shard kill fired
+    assert out["results"][0]["fired"] >= 1
+
+
+@pytest.mark.slow  # multi-seed shard-kill + RPC delay/drop drill
+def test_chaos_sweep_replay_multi_seed():
+    out = _run_sweep(["--seeds", "1,2,3,7", "--timeout", "350"],
+                     timeout=1600)
+    assert out["failed_seeds"] == [], out
+    assert all(r["fired"] >= 1 for r in out["results"])

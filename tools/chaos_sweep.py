@@ -83,6 +83,23 @@ SCHEDULES = {
          "method": "nm_*", "probability": 0.1, "max_fires": 2,
          "delay_ms": 8000.0},
     ],
+    # replay-plane drill: kill one ReplayShardActor mid-training (the
+    # after_n counter makes the death land deterministically once the
+    # shard has served a few push/sample RPCs, whatever the seed does
+    # to the timing), plus seeded delay and bounded connection drops on
+    # the task-push RPC path that carries replay push/sample/update
+    # traffic. The workload below must keep training through it: the
+    # ReplayGroup replaces the dead shard (fresh generation, empty
+    # buffer), env runners get a re-spec'd writer, and ownership drains.
+    "replay": [
+        {"fault": "kill_worker", "actor_class": "ReplayShardActor",
+         "method": "w_push_task", "after_n": 10, "probability": 1.0,
+         "max_fires": 1},
+        {"fault": "delay", "method": "w_push_task", "delay_ms": 2.0,
+         "jitter": True, "probability": 0.3},
+        {"fault": "drop_connection", "method": "w_push_task",
+         "probability": 0.02, "max_fires": 4},
+    ],
 }
 
 _SMOKE_WORKLOAD = """
@@ -286,6 +303,77 @@ assert not leaks, "ownership leak after wedge cycles: " + "; ".join(leaks)
 print(f"WEDGE_WORKLOAD_OK fired={fired} wedges={reasons.get('wedge', 0)}")
 """
 
+# Replay drill workload (schedule "replay"): a small sharded-replay
+# DQN (1 env runner, 2 prioritized shards) trained through the seeded
+# shard kill + RPC delay/drop schedule above. Exit 0 requires training
+# to keep making progress (steps trained keep growing after the kill),
+# the dead shard to be replaced by a fresh generation, and the driver's
+# ownership plane to drain afterwards.
+_REPLAY_WORKLOAD = """
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from ray_tpu.rllib.algorithms.dqn import DQNConfig
+
+algo = (DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, rollout_fragment_length=32)
+        .training(buffer_size=2000, train_batch_size=16,
+                  num_steps_sampled_before_learning_starts=32,
+                  target_network_update_freq=200, prioritized_replay=True,
+                  num_replay_shards=2, replay_shard_capacity=500)
+        .debugging(seed=0)
+        .build())
+
+iters = int(os.environ.get("RAY_TPU_SWEEP_REPLAY_ITERS", "25"))
+result = {}
+replaced_at = None
+for i in range(iters):
+    result = algo.train()
+    rep = result.get("replay", {})
+    if replaced_at is None and rep.get("shard_replacements", 0) >= 1:
+        replaced_at = i
+rep = result.get("replay", {})
+assert rep.get("shard_replacements", 0) >= 1, (
+    "chaos kill never cost a shard: " + repr(rep))
+assert rep.get("healthy_shards") == 2, rep
+assert result["num_env_steps_trained_total"] > 0, result
+# progress after the replacement: run a few more iterations and require
+# the trained counter to keep moving on the re-formed shard fleet
+before = result["num_env_steps_trained_total"]
+deadline = time.monotonic() + 60
+after = before
+while time.monotonic() < deadline:
+    result = algo.train()
+    after = result["num_env_steps_trained_total"]
+    if after > before:
+        break
+assert after > before, (before, after)
+algo.stop()
+
+# ownership drain canary: the dead shard generation, its inflight push
+# refs, and the pipelined sample refs must not leak pins or leases
+import gc
+
+from ray_tpu._private import ownership
+from ray_tpu._private import worker as worker_mod
+
+cw = worker_mod.global_worker().core_worker
+deadline = time.monotonic() + 15
+leaks = []
+while time.monotonic() < deadline:
+    gc.collect()
+    with cw._lock:
+        leaks = ownership.lease_drain_report(cw._ltab)
+    if not leaks:
+        break
+    time.sleep(0.25)
+assert not leaks, "ownership leak after replay chaos: " + "; ".join(leaks)
+print(f"REPLAY_WORKLOAD_OK replaced_at_iter={replaced_at}")
+"""
+
 _RUNNER = """
 import json
 import sys
@@ -376,8 +464,9 @@ def main() -> int:
         fd, tmp = tempfile.mkstemp(suffix="_chaos_smoke.py")
         with os.fdopen(fd, "w") as f:
             f.write({"elastic": _ELASTIC_WORKLOAD,
-                     "wedge": _WEDGE_WORKLOAD}.get(args.schedule,
-                                                   _SMOKE_WORKLOAD))
+                     "wedge": _WEDGE_WORKLOAD,
+                     "replay": _REPLAY_WORKLOAD}.get(args.schedule,
+                                                     _SMOKE_WORKLOAD))
         script_path = tmp
 
     results = []
