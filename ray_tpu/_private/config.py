@@ -160,6 +160,26 @@ class Config:
     postmortem_log_lines = _define("postmortem_log_lines", 100, int)
     postmortem_span_tail = _define("postmortem_span_tail", 200, int)
     postmortems_max = _define("postmortems_max", 256, int)
+    # Task-path batching (ROADMAP item 1): coalesce per-key lease
+    # requests into multi-grant nm_lease_request_batch RPCs, and batch
+    # cw_task_done reports off the worker's report drainer (many
+    # completions -> one flush-coalesced write). Both default on; the
+    # flags exist for the measured ablation (tools/bench_ablate.py
+    # --suite lease) and as kill switches.
+    task_lease_batching = _define("task_lease_batching", True, _bool)
+    task_done_batching = _define("task_done_batching", True, _bool)
+    # Same-node shm fast path: a task pushed to a worker on the owner's
+    # node rides an mmap'd SPSC byte-ring (_private/shm_channel.py)
+    # instead of the loopback socket, with a doorbell one-way only when
+    # the consumer ring is parked. Rings live next to the native store
+    # arena; silently degrades to RPC without one. Geometry: payload
+    # bytes per directed (producer -> consumer) ring.
+    shm_task_channel = _define("shm_task_channel", True, _bool)
+    shm_ring_bytes = _define("shm_ring_bytes", 1 << 20, int)
+    # Spec-blob interning: owner-side LRU of hash-dedup'd pickled
+    # function/arg blobs so 250k queued copies of the same closure cost
+    # one blob, not 250k (scale envelope, ROADMAP item 1).
+    spec_blob_cache_entries = _define("spec_blob_cache_entries", 256, int)
     # Transit pins on ObjectRefs embedded in task results: fallback TTL
     # used only when the owner's ack never arrives (the normal path
     # releases on ack — see _Executor._report_done).

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import chaos as chaos_lib
 from ray_tpu._private import log_plane as _log_plane
 from ray_tpu._private import memory_plane as _memory_plane
 from ray_tpu._private import metrics_plane as _metrics_plane
@@ -32,9 +33,11 @@ from ray_tpu._private import ownership as _ownership
 from ray_tpu._private import profiler as _profiler
 from ray_tpu._private import rpc as rpc_lib
 from ray_tpu._private import serialization as ser
+from ray_tpu._private import shm_channel as _shm
 from ray_tpu._private import spans as _spans
 from ray_tpu._private.config import Config
-from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID)
+from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID,
+                                  rand_bytes as _rand_bytes)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreFullError, StoreClient
 from ray_tpu._private.state import TaskSpec, TaskType
@@ -148,7 +151,18 @@ class _TaskEntry:
     # _raylet.pyx:269) — index keying makes retries/recovery re-reports
     # idempotent instead of appending duplicates
     dynamic_arrived: Dict[int, ObjectID] = field(default_factory=dict)
-    dynamic_event: threading.Event = field(default_factory=threading.Event)
+    # LAZY: created by the first ObjectRefGenerator waiter (under the
+    # owner's lock), not per entry — a threading.Event costs ~0.5KB and
+    # the 250k-task scale envelope holds an entry per queued task. The
+    # completion paths set it only when present; the waiter's 1s wait
+    # timeout covers the (setter saw None / waiter just created it)
+    # race without any extra locking.
+    dynamic_event: Optional[threading.Event] = None
+
+    def wake_dynamic(self) -> None:
+        ev = self.dynamic_event
+        if ev is not None:
+            ev.set()
 
 
 # Owner-side per-scheduling-key submission state lives in the ownership
@@ -174,6 +188,9 @@ class _ActorState:
     # DisconnectActor fails inflight requests)
     pushed: Dict[str, int] = field(default_factory=dict)
     resolving: bool = False
+    # node the live incarnation runs on (from get_actor_info): a push to
+    # an actor on the caller's own node takes the shm ring, not loopback
+    node_id_hex: Optional[str] = None
 
 
 class CoreWorker:
@@ -293,6 +310,11 @@ class CoreWorker:
         self._shutdown = False
         threading.Thread(target=self._borrow_release_loop, daemon=True,
                          name="borrow-release").start()
+        # lease-request tickets (key, nslots, nm) drained by the
+        # requester thread — see _maybe_request_leases
+        self._lease_req_q: "queue.Queue" = queue.Queue()
+        threading.Thread(target=self._lease_request_loop, daemon=True,
+                         name="lease-request").start()
         # Task state transitions → GCS task sink (reference
         # task_event_buffer.h:206 flushed to GcsTaskManager).
         self.task_events = TaskEventBuffer(rpc_lib.RpcClient(
@@ -303,8 +325,10 @@ class CoreWorker:
 
         handlers = {
             "cw_lease_granted": self._on_lease_granted,
+            "cw_lease_granted_batch": self._on_lease_granted_batch,
             "cw_lease_respill": self._on_lease_respill,
             "cw_task_done": self._on_task_done,
+            "cw_task_done_batch": self._on_task_done_batch,
             "cw_task_failed": self._on_task_failed,
             "cw_dynamic_child": self._on_dynamic_child,
             "cw_get_object": self._on_get_object,
@@ -360,6 +384,32 @@ class CoreWorker:
             self.executor = _Executor(self)
             handlers["w_push_task"] = self.executor.push_task
             handlers["w_cancel_task"] = self.executor.cancel_task
+        # Same-node shm task channel (_private/shm_channel.py): messages
+        # from local peers arrive over arena-backed rings and dispatch
+        # into this same handler table; shm_doorbell is the only part
+        # that rides the socket. Senders are created lazily per peer in
+        # _shm_send.
+        self._shm_senders: Dict[Tuple[str, int], _shm.Sender] = {}
+        self._shm_lock = threading.Lock()
+        self._shm_rx: Optional[_shm.Receiver] = None
+        # Spec-blob interning (scale envelope, ROADMAP item 1): 250k
+        # queued submissions of the same closure/args hold ONE bytes
+        # object instead of 250k identical pickles. Keyed by the blob
+        # itself — dict hashing + equality beats a crypto digest at
+        # these sizes and collisions are impossible by construction.
+        self._blob_cache: "collections.OrderedDict[bytes, bytes]" = \
+            collections.OrderedDict()
+        self._blob_cache_lock = threading.Lock()
+        self.blob_cache_hits = 0
+        if Config.shm_task_channel:
+            # chaos server hook runs here too: a fault rule (delay /
+            # kill_worker / stall) must fire identically whether the
+            # message rode the ring or the socket
+            def _shm_dispatch(method, kw, _handlers=handlers):
+                chaos_lib.on_server_dispatch(method)
+                return _handlers[method](**kw)
+            self._shm_rx = _shm.Receiver(_shm_dispatch)
+            handlers["shm_doorbell"] = self._shm_rx.on_doorbell
         self.server = rpc_lib.RpcServer(handlers, host=host)
         self.address = self.server.address
         # one trace row per process in the merged timeline
@@ -509,7 +559,7 @@ class CoreWorker:
     def _attach_trace(self, spec: TaskSpec) -> None:
         """Child tasks inherit the caller's trace; a driver-side submit
         outside any trace starts a fresh one."""
-        spec.trace_id = self.current_trace_id() or os.urandom(8).hex()
+        spec.trace_id = self.current_trace_id() or _rand_bytes(8).hex()
         parent = getattr(self._tls, "task_id", None)
         if parent is not None:
             spec.parent_task_id = parent.hex()
@@ -742,6 +792,12 @@ class CoreWorker:
         # forced (ray.free's explicit "free even though referenced")
         self._own.set_location(oid_hex, (FREED,), event="free",
                                force=force)
+        # wake + retire any parked waiter event (waiters re-check the
+        # location and see FREED; events are waiter-created and bounded
+        # by live waits, never by object count)
+        ev = self.object_events.pop(oid_hex, None)
+        if ev is not None:
+            ev.set()
         # release eager borrows on refs nested inside this result (see
         # _register_nested_borrows): remote owners via the async release
         # queue; locally-owned nested objects unpin (and may free) here
@@ -1049,7 +1105,7 @@ class CoreWorker:
         loc = self.store_value(h, value)
         with self._lock:
             self._own.set_location(h, loc, event="put")
-            ev = self.object_events.get(h)
+            ev = self.object_events.pop(h, None)
             if ev is not None:
                 ev.set()
         return ObjectRef(oid, self.address)
@@ -1260,7 +1316,12 @@ class CoreWorker:
         # results never involve the GCS at all.
         with self._lock:
             loc = self.objects.get(ref.hex())
-            ev = self.object_events.get(ref.hex())
+            # events are lazy: create one here (same lock as the
+            # completion setters) so the grace wait below has something
+            # to wait on even when no getter has parked yet
+            ev = self.object_events.setdefault(
+                ref.hex(), threading.Event()) \
+                if loc is not None and loc[0] == PENDING else None
         if loc is None or loc[0] != PENDING:
             return None  # already resolved
         if ev is not None and ev.wait(timeout=self.WAIT_EDGE_GRACE_S):
@@ -1567,7 +1628,35 @@ class CoreWorker:
     # Normal task submission
     # ------------------------------------------------------------------
 
+    # interning above this trades little dedup for LRU residency: big
+    # arg blobs are rare and unlikely to repeat byte-identically
+    _BLOB_INTERN_MAX = 64 * 1024
+
+    def _intern_blob(self, blob: bytes) -> bytes:
+        """Return a shared bytes object equal to `blob` (LRU-bounded by
+        Config.spec_blob_cache_entries). A fan-out of N .remote() calls
+        on the same function/args pickles N identical blobs; interning
+        keeps one and lets the N-1 copies die young."""
+        if not blob or len(blob) > self._BLOB_INTERN_MAX or \
+                Config.spec_blob_cache_entries <= 0:
+            return blob
+        with self._blob_cache_lock:
+            c = self._blob_cache
+            got = c.get(blob)
+            if got is not None:
+                c.move_to_end(blob)
+                self.blob_cache_hits += 1
+                return got
+            c[blob] = blob
+            if len(c) > Config.spec_blob_cache_entries:
+                c.popitem(last=False)
+        return blob
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        # lets a same-node executor report cw_task_done over the shm
+        # ring instead of the loopback socket
+        spec.owner_node_id = self.node_id_hex
+        spec.args = self._intern_blob(spec.args)
         return_ids = [ObjectID.for_task_return(spec.task_id, i + 1)
                       for i in range(spec.num_returns)]
         entry = _TaskEntry(spec=spec, retries_left=spec.max_retries,
@@ -1578,7 +1667,6 @@ class CoreWorker:
             for oid in return_ids:
                 self._own.set_location(oid.hex(), (PENDING,),
                                        event="submit")
-                self.object_events[oid.hex()] = threading.Event()
             self.tasks[spec.task_id.hex()] = entry
         # the caller's refs register BEFORE the task can complete: the
         # free-on-resolve check in _on_task_done reads local_refs == 0
@@ -1633,7 +1721,15 @@ class CoreWorker:
     def _maybe_request_leases(self, key, nm=None) -> None:
         """Issue lease requests until outstanding requests cover the
         backlog (one per queued task, capped): parallelism comes from
-        multiple leases, latency from per-lease pipelining."""
+        multiple leases, latency from per-lease pipelining.
+
+        With task_lease_batching the NM round trip moves OFF this
+        thread entirely: slots are claimed here (so the covered-by-
+        backlog invariant holds at claim time), then the requester
+        thread ships them — coalescing claims that pile up while one
+        RPC is in flight into a single nm_lease_request_batch. The
+        submit path's cost drops to local bookkeeping; this is the
+        difference between ~1/RTT tasks/s and wire-speed submission."""
         while True:
             with self._lock:
                 ks = self._ltab.get(key)
@@ -1643,9 +1739,66 @@ class CoreWorker:
                               self.MAX_PENDING_LEASE_REQUESTS)
                 if ks.requests_in_flight >= desired:
                     return
-                self._ltab.claim_slot(ks)
+                nslots = desired - ks.requests_in_flight \
+                    if Config.task_lease_batching else 1
+                for _ in range(nslots):
+                    self._ltab.claim_slot(ks)
+            if Config.task_lease_batching:
+                self._lease_req_q.put((key, nslots, nm))
+                return
             self._request_lease_for_key(key, nm=nm)
             nm = None
+
+    def _lease_request_loop(self) -> None:
+        """Requester thread: drains claimed-slot tickets, merges them
+        per key, and issues the (batch) lease RPCs. Claims were made by
+        the enqueuer, so nothing here races the slot accounting; one
+        slow NM can only stall its own key's inline send (other keys of
+        the same drain round go to short-lived threads)."""
+        while not self._shutdown:
+            try:
+                item = self._lease_req_q.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            batch = [item]
+            try:
+                while True:
+                    more = self._lease_req_q.get_nowait()
+                    if more is not None:
+                        batch.append(more)
+            except queue.Empty:
+                pass
+            merged: Dict[bytes, List] = {}
+            for key, nslots, nm in batch:
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = [nslots, nm]
+                else:
+                    cur[0] += nslots
+                    if nm is not None:
+                        cur[1] = nm
+            spread = len(merged) > 1
+            for key, (nslots, nm) in merged.items():
+                if spread:
+                    threading.Thread(
+                        target=self._send_lease_requests,
+                        args=(key, nslots, nm), daemon=True,
+                        name="lease-request-key").start()
+                else:
+                    self._send_lease_requests(key, nslots, nm)
+
+    def _send_lease_requests(self, key, nslots: int, nm=None) -> None:
+        try:
+            if nslots == 1:
+                self._request_lease_for_key(key, nm=nm)
+            else:
+                self._request_lease_batch_for_key(key, nslots, nm=nm)
+        except Exception:  # noqa: BLE001 - a stray error here must not
+            # kill the requester thread; the slot-balance watchdog
+            # surfaces any slots this leaks
+            logger.exception("lease request for key %r failed", key)
 
     def _release_request_slot(self, key) -> None:
         with self._lock:
@@ -1743,6 +1896,88 @@ class CoreWorker:
                     entry.in_key_queue = False
             self._ltab.release_slot(ks, event="slot_release_drained")
             return None
+
+    def _key_heads(self, key: bytes, n: int):
+        """Up to `n` distinct live queued (task_hex, entry) pairs of the
+        key, front-drained like _key_head but WITHOUT popping the live
+        ones (grants pop via _push_on_lease). The caller holds `n`
+        request slots; surplus slots beyond the live work found are
+        released here so slot accounting stays covered-by-backlog."""
+        heads = []
+        with self._lock:
+            ks = self._ltab.get(key)
+            if ks is None:
+                return heads
+            while ks.queue:
+                h = ks.queue[0]
+                entry = self.tasks.get(h)
+                if entry is not None and not entry.done:
+                    break
+                ks.queue.popleft()
+                if entry is not None:
+                    entry.in_key_queue = False
+            for h in ks.queue:
+                entry = self.tasks.get(h)
+                if entry is None or entry.done:
+                    continue
+                heads.append((h, entry))
+                if len(heads) >= n:
+                    break
+            for _ in range(n - len(heads)):
+                self._ltab.release_slot(
+                    ks, event="slot_release_drained" if not heads
+                    else "slot_release")
+        return heads
+
+    def _request_lease_batch_for_key(self, key: bytes, nslots: int,
+                                     nm=None) -> None:
+        """Multi-slot lease request: one nm_lease_request_batch RPC
+        covers up to `nslots` queue heads (the caller claimed that many
+        slots). Replies that queued park their slot at the NM exactly
+        like the singleton path; spilled/infeasible replies — and any
+        batch-level connection failure — fall back to the singleton
+        path, which owns the full spill-following/backoff machinery,
+        one claimed slot per remaining reply."""
+        heads = self._key_heads(key, nslots)
+        if not heads:
+            return
+        nm_cur = nm if nm is not None else self._nm
+        with self._lock:
+            for _h, entry in heads:
+                # recorded BEFORE the request so an async grant arriving
+                # first knows where to return the lease (same contract
+                # as the singleton path)
+                entry.lease_node = nm_cur.address
+        try:
+            replies = nm_cur.call(
+                "nm_lease_request_batch",
+                specs=[entry.spec for _h, entry in heads],
+                reply_to=self.address)
+        except Exception:  # noqa: BLE001 - connection-level failure:
+            # not a task failure. Re-enter the singleton path per held
+            # slot; it restarts from the local NM with its own
+            # conn-failure budget.
+            for _ in heads:
+                self._request_lease_for_key(key)
+            return
+        fallbacks = 0
+        spill_nm = None
+        with self._lock:
+            ks = self._ltab.get(key)
+            for kind, payload in replies:
+                if kind == "queued" and ks is not None:
+                    self._ltab.park(ks, tuple(nm_cur.address))
+                else:
+                    # "spill"/"infeasible": this slot never parked; the
+                    # singleton path below re-drives it (and follows the
+                    # first spill target directly)
+                    fallbacks += 1
+                    if kind == "spill" and spill_nm is None:
+                        spill_nm = tuple(payload)
+        for i in range(fallbacks):
+            self._request_lease_for_key(
+                key, nm=self._pool.get(spill_nm)
+                if i == 0 and spill_nm is not None else None)
 
     def _request_lease_for_key(self, key: bytes, nm=None) -> None:
         """Lease a worker for the key's queue head; follow spillback
@@ -1873,6 +2108,14 @@ class CoreWorker:
             threading.Thread(target=self._kick_key, args=(key,),
                              daemon=True, name="lease-kick").start()
 
+    def _on_lease_granted_batch(self, grants: List[Dict[str, Any]]) -> None:
+        """Grouped grant replies from one NM dispatch pass: each element
+        runs the full singleton handler (note_grant's dedup ring makes a
+        replayed batch element a returned duplicate, not a double
+        grant)."""
+        for g in grants:
+            self._on_lease_granted(**g)
+
     def ownership_snapshot(self, object_id: Optional[str] = None,
                            limit: int = 200) -> Dict[str, Any]:
         """This process's ownership-protocol view: live RefState rows
@@ -1920,6 +2163,49 @@ class CoreWorker:
     # worker executes normal tasks on ONE thread, so depth never
     # over-commits the lease's resources.
     LEASE_PIPELINE_DEPTH = 2
+
+    def _shm_send(self, addr, peer_node_id, method: str,
+                  kwargs: Dict[str, Any]) -> bool:
+        """Try the same-node shm ring to the peer process at `addr`;
+        False means not eligible / ring or arena full and the caller
+        must use the socket path (the message was NOT enqueued). A
+        doorbell send failure propagates — that is the same dead-peer
+        signal a socket one-way raises."""
+        if self._shutdown or not Config.shm_task_channel \
+                or not peer_node_id or peer_node_id != self.node_id_hex:
+            return False
+        key = tuple(addr)
+        s = self._shm_senders.get(key)
+        if s is None:
+            # the ring file lives next to the node's store arena — its
+            # directory doubles as "the shared-memory place on this
+            # node"; no arena means no shm fast path
+            arena = self.store.shared_arena()
+            if arena is None:
+                return False
+            with self._shm_lock:
+                s = self._shm_senders.get(key)
+                if s is None:
+                    try:
+                        s = _shm.Sender(
+                            os.path.dirname(arena.path),
+                            f"{self.worker_id.hex()[:12]}-{key[1]}",
+                            int(Config.shm_ring_bytes),
+                            doorbell=lambda path, _a=key:
+                                self._pool.get(_a).send_oneway(
+                                    "shm_doorbell", path=path))
+                    except OSError:
+                        return False
+                    self._shm_senders[key] = s
+        try:
+            # chaos client hook: drop_connection / partition rules fire
+            # on ring sends exactly as they would on the socket path
+            # (ConnectionLost propagates to the same call sites)
+            chaos_lib.on_client_call(method, key)
+            s.send(method, kwargs)
+            return True
+        except _shm.ShmUnavailable:
+            return False
 
     def _push_on_lease(self, key: bytes, lease_id: str,
                        fallback_entry: Optional[_TaskEntry] = None
@@ -2049,9 +2335,14 @@ class CoreWorker:
                 # report (the task enters _lease_running under the same
                 # lock that verified the lease is live, so a report
                 # arriving any time after sees it); send failures fail
-                # over right here
-                self._pool.get(tuple(worker_address)).send_oneway(
-                    "w_push_task", spec=entry.spec, lease_id=lease_id)
+                # over right here. Same-node workers take the shm ring
+                # (zero syscalls while hot) with the socket as spill.
+                if not self._shm_send(tuple(worker_address), node_id,
+                                      "w_push_task",
+                                      dict(spec=entry.spec,
+                                           lease_id=lease_id)):
+                    self._pool.get(tuple(worker_address)).send_oneway(
+                        "w_push_task", spec=entry.spec, lease_id=lease_id)
             except Exception as e:  # noqa: BLE001
                 with self._lock:
                     self._ltab.drop_lease(ks, lease_id)
@@ -2130,7 +2421,7 @@ class CoreWorker:
                                         (PENDING,))[0] != FREED:
                         self._own.set_location(oid.hex(), tuple(loc),
                                                event="dynamic_child")
-                    ev = self.object_events.get(oid.hex())
+                    ev = self.object_events.pop(oid.hex(), None)
                     if ev is not None:  # recovery getters waiting
                         ev.set()
         if retrying:
@@ -2163,17 +2454,30 @@ class CoreWorker:
                 if self.objects.get(oid.hex(), (PENDING,))[0] != FREED:
                     self._own.set_location(oid.hex(), tuple(loc),
                                            event="resolve")
-                ev = self.object_events.get(oid.hex())
+                # pop, don't get: events are waiter-created and resolve
+                # retires them — keeps the dict sized by objects being
+                # actively waited on, not by every ref ever created
+                ev = self.object_events.pop(oid.hex(), None)
                 if ev is not None:
                     ev.set()
         self._free_refless_returns(entry)
         self._unpin_args(entry.spec.arg_object_refs)
         self.task_events.record(h, state="FINISHED", ts_finished=_ev_now())
         _count_task_outcome("finished")
-        entry.dynamic_event.set()  # wake streaming iterators: task over
+        entry.wake_dynamic()  # wake streaming iterators: task over
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
         if lease_id is not None:
             self._settle_lease_slot(entry, lease_id, worker_exiting)
+
+    def _on_task_done_batch(self, reports: List[Dict[str, Any]]) -> None:
+        """Batched completion reports off a worker's report drainer:
+        many finished tasks, one RPC. Each element is exactly a
+        cw_task_done kwargs dict and runs the full singleton handler —
+        entry.done dedup plus the lease machine's settle no-op make a
+        replayed batch (idempotent resend after a send failure)
+        harmless."""
+        for r in reports:
+            self._on_task_done(**r)
 
     def _free_refless_returns(self, entry: _TaskEntry) -> None:
         """Free-on-resolve: a result whose every ref died while the
@@ -2242,8 +2546,8 @@ class CoreWorker:
                 self._own.set_location(child.hex(), tuple(loc),
                                        event="dynamic_child")
             entry.dynamic_arrived[child.return_index()] = child
-            entry.dynamic_event.set()
-            ev = self.object_events.get(child.hex())
+            entry.wake_dynamic()
+            ev = self.object_events.pop(child.hex(), None)
         if ev is not None:
             ev.set()
         self._fire_done_callbacks([child.hex()])
@@ -2307,7 +2611,7 @@ class CoreWorker:
                 if self.objects.get(oid.hex(), (PENDING,))[0] != FREED:
                     self._own.set_location(oid.hex(), (ERROR, blob),
                                            event="fail")
-                ev = self.object_events.get(oid.hex())
+                ev = self.object_events.pop(oid.hex(), None)
                 if ev is not None:
                     ev.set()
         # same refless-free sweep as the success path: a failed
@@ -2318,7 +2622,7 @@ class CoreWorker:
                                 ts_finished=_ev_now(),
                                 error=f"{error_type}: {message}"[:500])
         _count_task_outcome("failed")
-        entry.dynamic_event.set()
+        entry.wake_dynamic()
         self._fire_done_callbacks([oid.hex() for oid in entry.return_ids])
 
     # ------------------------------------------------------------------
@@ -2327,6 +2631,7 @@ class CoreWorker:
 
     def create_actor(self, spec: TaskSpec, name: str = "",
                      namespace: str = "") -> None:
+        spec.owner_node_id = self.node_id_hex
         self._pin_args(spec.arg_object_refs)
         with self._lock:
             self.actors[spec.actor_id.hex()] = _ActorState(
@@ -2347,6 +2652,14 @@ class CoreWorker:
         with self._lock:
             if actor_id.hex() not in self.actors:
                 self.actors[actor_id.hex()] = _ActorState(actor_id=actor_id)
+
+    def actor_is_dead(self, actor_id: ActorID) -> bool:
+        """Owner-side liveness peek (death pubsub keeps it fresh): a
+        dict lookup, no RPC. Used by compiled DAGs to notice a cached
+        actor died and fall back to the interpreted path."""
+        with self._lock:
+            st = self.actors.get(actor_id.hex())
+            return bool(st is not None and st.dead)
 
     def actor_pending_calls(self, actor_id: ActorID) -> int:
         """Caller-side count of this actor's submitted-but-unfinished
@@ -2376,12 +2689,13 @@ class CoreWorker:
         spec = TaskSpec(
             task_id=TaskID.of(self.job_id), job_id=self.job_id,
             task_type=TaskType.ACTOR_TASK, function_key=function_key,
-            function_name=method_name, args=args_blob,
+            function_name=method_name, args=self._intern_blob(args_blob),
             arg_object_refs=arg_refs, num_returns=num_returns,
             resources={}, owner_address=self.address,
             owner_worker_id=self.worker_id, actor_id=actor_id,
             actor_method_name=method_name,
             concurrency_group=concurrency_group)
+        spec.owner_node_id = self.node_id_hex
         spec.dynamic_returns = dynamic_returns
         # before the spec becomes reachable by other threads: a queued
         # spec can be popped+pickled by an in-flight _resolve_actor the
@@ -2417,7 +2731,6 @@ class CoreWorker:
             for oid in return_ids:
                 self._own.set_location(oid.hex(), (PENDING,),
                                        event="submit")
-                self.object_events[oid.hex()] = threading.Event()
             self.tasks[spec.task_id.hex()] = _TaskEntry(
                 spec=spec, retries_left=0, return_ids=return_ids)
             self._actor_pending[actor_id.hex()] = pending + 1
@@ -2453,8 +2766,14 @@ class CoreWorker:
             # one-way push (reference PushTask is async with an error
             # callback): send failures raise and re-resolve below; a
             # push lost in a dying actor's buffer is failed by the
-            # death/incarnation bookkeeping (state.pushed) instead
-            self._pool.get(addr).send_oneway("w_push_task", spec=spec)
+            # death/incarnation bookkeeping (state.pushed) instead.
+            # Same-node actors take the shm ring.
+            with self._lock:
+                st = self.actors.get(spec.actor_id.hex())
+                peer_node = st.node_id_hex if st is not None else None
+            if not self._shm_send(tuple(addr), peer_node, "w_push_task",
+                                  dict(spec=spec)):
+                self._pool.get(addr).send_oneway("w_push_task", spec=spec)
             with self._lock:
                 state = self.actors[spec.actor_id.hex()]
                 state.pushed[spec.task_id.hex()] = state.incarnation
@@ -2494,6 +2813,8 @@ class CoreWorker:
                                  and state.last_address != new_addr)
                     state.address = new_addr
                     state.last_address = new_addr
+                    state.node_id_hex = info.node_id.hex() \
+                        if info.node_id is not None else None
                     state.resolving = False
                     q, state.queue = state.queue, []
                     q.sort(key=lambda s: s.sequence_number)
@@ -2802,6 +3123,7 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        self._lease_req_q.put(None)
         _metrics_plane.unregister_sampler("core_worker")
         _metrics_plane.unregister_snapshot_extra(
             _memory_plane.PROC_DIGEST_KEY)
@@ -2848,6 +3170,15 @@ class CoreWorker:
             self.task_events.stop()
         except Exception:  # noqa: BLE001 - teardown; event sink may be gone
             pass
+        if self._shm_rx is not None:
+            self._shm_rx.stop()
+        with self._shm_lock:
+            senders, self._shm_senders = dict(self._shm_senders), {}
+        for s in senders.values():
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
         self.server.stop()
         self.store.close()
         self._pool.close_all()
@@ -2874,6 +3205,12 @@ class _Executor:
         self._next_seq: Dict[str, int] = {}
         self._buffer: Dict[str, Dict[int, TaskSpec]] = {}
         self._cancelled: set = set()
+        # task_ids already queued via push_task: makes a retried
+        # w_push_task (rpc reply lost after a successful send) a no-op
+        # instead of a double execution. Bounded — a retry lands within
+        # seconds, not after thousands of intervening pushes.
+        self._pushed_ids: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
         self._threads: List[threading.Thread] = []
         # named concurrency groups: group -> dedicated task queue
         self._group_queues: Dict[str, "queue.Queue"] = {}
@@ -2890,6 +3227,17 @@ class _Executor:
         self._group_tls = threading.local()
         self._default_threads = 0
         self._group_widths: Dict[str, int] = {}
+        # done-report drainer: the common-path cw_task_done one-ways
+        # queue here and ship in owner-grouped batches (one frame — or
+        # one shm ring slot — for N completions) instead of one socket
+        # write per task. idle flags the drainer as between batches so
+        # the pre-exit flush can tell "queue empty" from "report still
+        # in the drainer's hands".
+        self._report_q: "queue.Queue" = queue.Queue()
+        self._report_idle = threading.Event()
+        self._report_idle.set()
+        threading.Thread(target=self._report_drain_loop, daemon=True,
+                         name="done-report-drain").start()
         self._spawn_exec_threads(1)
 
     def has_spare_capacity(self) -> bool:
@@ -2970,6 +3318,23 @@ class _Executor:
                     nxt += 1
                 self._next_seq[owner] = nxt
         else:
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                # duplicate-safe so the NM's creation push can sit in the
+                # rpc retry set: a reply lost AFTER a successful send is
+                # re-sent, and the second copy must queue nothing. Safe
+                # for creation ONLY — an actor restart lands on a fresh
+                # worker process, so the same creation task_id never
+                # legitimately arrives here twice. (NORMAL_TASK retries
+                # DO reuse the task_id on a possibly-reused worker, and
+                # ACTOR_TASK pushes are already guarded by the per-owner
+                # sequence cursor above.)
+                tid = spec.task_id.hex()
+                with self._lock:
+                    if tid in self._pushed_ids:
+                        return "ok"
+                    self._pushed_ids[tid] = None
+                    while len(self._pushed_ids) > 64:
+                        self._pushed_ids.popitem(last=False)
             spec._lease_id = lease_id  # type: ignore[attr-defined]
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 self._spawn_exec_threads(max(1, spec.max_concurrency))
@@ -3310,6 +3675,12 @@ class _Executor:
             # in flight (chaos `delay` on this path) could otherwise
             # arrive after the pins' TTL and find the nested objects
             # freed (ADVICE r5).
+            if worker_exiting:
+                # earlier one-way reports may still sit on the drainer;
+                # ship them before the exit-ack — a report lost with
+                # the exiting process would retry an already-succeeded
+                # task (side effects twice)
+                self._flush_reports()
             self.cw._pool.get(spec.owner_address).call(
                 "cw_task_done", task_id=spec.task_id,
                 results=results, lease_id=lease_id,
@@ -3317,15 +3688,100 @@ class _Executor:
                 worker_exiting=worker_exiting,
                 nested_refs=nested_refs)
             return True
+        report = dict(task_id=spec.task_id, results=results,
+                      lease_id=lease_id,
+                      dynamic_children=dynamic_children,
+                      worker_exiting=worker_exiting,
+                      nested_refs=nested_refs)
+        if Config.task_done_batching:
+            # hand off to the drainer: delivery failures are retried
+            # there (blocking, per report) with the same backoff this
+            # method's caller would apply
+            self._report_q.put((tuple(spec.owner_address),
+                                spec.owner_node_id, report))
+            return False
         # one-way: the worker moves on to its next task without
         # waiting out the owner's bookkeeping round trip (send
         # failures still raise; a dead owner is the only loss case
         # and its results are moot)
-        self.cw._pool.get(spec.owner_address).send_oneway(
-            "cw_task_done", task_id=spec.task_id, results=results,
-            lease_id=lease_id, dynamic_children=dynamic_children,
-            worker_exiting=worker_exiting, nested_refs=nested_refs)
+        if not self.cw._shm_send(tuple(spec.owner_address),
+                                 spec.owner_node_id, "cw_task_done",
+                                 report):
+            self.cw._pool.get(spec.owner_address).send_oneway(
+                "cw_task_done", **report)
         return False
+
+    def _report_drain_loop(self) -> None:
+        while True:
+            first = self._report_q.get()
+            self._report_idle.clear()
+            batch = [first]
+            try:
+                while True:
+                    batch.append(self._report_q.get_nowait())
+            except queue.Empty:
+                pass
+            self._ship_batch(batch)
+            if self._report_q.empty():
+                self._report_idle.set()
+
+    def _ship_batch(self, batch: List[Tuple]) -> None:
+        by_owner: Dict[Tuple, List[Dict]] = {}
+        for owner, owner_node, report in batch:
+            by_owner.setdefault((owner, owner_node), []).append(report)
+        for (owner, owner_node), reports in by_owner.items():
+            self._ship_reports(owner, owner_node, reports)
+
+    def _ship_reports(self, owner, owner_node,
+                      reports: List[Dict]) -> None:
+        cw = self.cw
+        try:
+            with _spans.span("cw.task_done_batch", n=len(reports)):
+                if len(reports) == 1:
+                    if not cw._shm_send(owner, owner_node,
+                                        "cw_task_done", reports[0]):
+                        cw._pool.get(owner).send_oneway(
+                            "cw_task_done", **reports[0])
+                elif not cw._shm_send(owner, owner_node,
+                                      "cw_task_done_batch",
+                                      dict(reports=reports)):
+                    cw._pool.get(owner).send_oneway(
+                        "cw_task_done_batch", reports=reports)
+            return
+        except Exception:  # noqa: BLE001 - fall through to per-report
+            pass           # blocking retries
+        # A LOST completion report strands the task at its owner (see
+        # _report_done); each report retries individually so one bad
+        # element can't take its batch siblings down with it.
+        for r in reports:
+            delivered = False
+            for delay_s in (0.1, 0.4, 1.0):
+                time.sleep(delay_s)
+                try:
+                    cw._pool.get(owner).call("cw_task_done", **r)
+                    delivered = True
+                    break
+                except Exception:  # noqa: BLE001 - retried with backoff;
+                    continue       # the owner may be mid-restart
+            if not delivered:
+                logger.warning("owner %s unreachable for task result",
+                               owner)
+
+    def _flush_reports(self) -> None:
+        """Ship everything queued on the done-report drainer from the
+        CALLING thread, then wait (bounded) for the drainer to go idle
+        so no report is left in its hands when the process exits."""
+        while True:
+            batch = []
+            try:
+                while True:
+                    batch.append(self._report_q.get_nowait())
+            except queue.Empty:
+                pass
+            if not batch:
+                break
+            self._ship_batch(batch)
+        self._report_idle.wait(timeout=2.0)
 
     def _report_error(self, spec: TaskSpec, err: Exception,
                       worker_exiting: bool = False) -> None:

@@ -9,8 +9,31 @@ so lineage can be read off an id without a directory lookup.
 from __future__ import annotations
 
 import os
+import random
 import struct
 from typing import Optional
+
+# Process-local id entropy. os.urandom is a syscall per call — measured
+# at hundreds of µs under syscall-filtered sandboxes — and id minting
+# sits on the per-task hot path (TaskID + trace id + lease id). One
+# urandom seed per PROCESS feeds a userspace PRNG instead; distinct
+# processes get distinct seeds, so cross-process uniqueness matches
+# urandom's for our id widths. Re-seeded when the pid changes: a forked
+# child inheriting the parent's PRNG state would mint the parent's
+# exact id stream.
+_rng: Optional[random.Random] = None
+_rng_pid: Optional[int] = None
+
+
+def rand_bytes(n: int) -> bytes:
+    """Fast unique-id entropy (NOT for cryptographic use)."""
+    global _rng, _rng_pid
+    pid = os.getpid()
+    rng = _rng
+    if rng is None or _rng_pid != pid:
+        rng = _rng = random.Random(os.urandom(16))
+        _rng_pid = pid
+    return rng.getrandbits(n * 8).to_bytes(n, "big")
 
 
 class BaseID:
@@ -26,7 +49,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls) -> "BaseID":
-        return cls(os.urandom(cls.SIZE))
+        return cls(rand_bytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str) -> "BaseID":
@@ -82,7 +105,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "ActorID":
-        return cls(os.urandom(12) + job_id.binary())
+        return cls(rand_bytes(12) + job_id.binary())
 
     def job_id(self) -> JobID:
         return JobID(self._bin[12:16])
@@ -95,7 +118,7 @@ class TaskID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "TaskID":
-        return cls(os.urandom(12) + job_id.binary())
+        return cls(rand_bytes(12) + job_id.binary())
 
     @classmethod
     def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":

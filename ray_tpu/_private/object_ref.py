@@ -164,6 +164,14 @@ class ObjectRefGenerator:
                     return ObjectRef(child, cw.address)
                 if entry.done:
                     break
+                # events are lazy (the owner holds an entry per queued
+                # task; most tasks never have a streaming iterator) —
+                # the first waiter creates one under the owner's lock.
+                # Completion paths set it only when present, so the 1s
+                # wait timeout below bounds the missed-wakeup window of
+                # a setter that read None just before this create.
+                if entry.dynamic_event is None:
+                    entry.dynamic_event = threading.Event()
                 entry.dynamic_event.clear()
             entry.dynamic_event.wait(timeout=1.0)
         # task over: surface any error via the handle, else serve any

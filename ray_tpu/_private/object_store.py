@@ -775,6 +775,14 @@ class StoreClient:
             self._fast_arena_path = ""
             return None
 
+    def shared_arena(self):
+        """Public handle on the node-local process-shared arena (or
+        None): the same mapping the put fast path allocates from, reused
+        by the shm task channel (_private/shm_channel.py) for same-node
+        control messages — its allocator lock is process-shared, so any
+        local process may alloc and any other may free."""
+        return self._fast_arena()
+
     def create(self, object_id: str, size: int) -> memoryview:
         """Writable block for a new object. Fast path: allocate straight
         from the process-shared arena — no RPC; seal() then registers

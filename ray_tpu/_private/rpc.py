@@ -250,8 +250,23 @@ _IDEMPOTENT_METHODS = frozenset({
     # completion report used to strand the task (and its arg pins)
     # forever — the ownership fuzzer's drop schedules hit exactly this.
     "cw_task_done", "cw_task_failed", "nm_return_worker",
+    # batched forms of the above: each element dedups exactly like its
+    # singleton twin, so replaying a whole batch is as safe as replaying
+    # one report. cw_lease_granted_batch rides note_grant's dedup ring;
+    # nm_lease_request_batch re-queues under the SAME lease ids only on
+    # the client's resend-after-send-failure path (the NM never saw the
+    # first copy), and a duplicate grant for an id is dropped by the
+    # owner anyway.
+    "cw_task_done_batch", "nm_lease_request_batch", "cw_lease_granted_batch",
     # pure read: the borrower's current claim set (anti-entropy sweep)
     "cw_claims",
+    # actor-creation push (the NM's only call-form w_push_task): the
+    # executor dedups creation specs by task_id, so a resend after a
+    # lost reply queues nothing. Without the retry budget, two
+    # back-to-back connect failures against a freshly-spawned worker
+    # (loaded box, listener backlog) declared the actor dead before it
+    # ever ran. Lease-path pushes ride send_oneway and are unaffected.
+    "w_push_task",
 })
 
 
@@ -392,6 +407,58 @@ class RpcClient:
                         raise ConnectionLost(
                             f"oneway rpc to {self.address} failed: "
                             f"{method}")
+
+    def send_oneways(self, items) -> None:
+        """Flush-coalesced fire-and-forget: ship N queued one-way frames
+        in ONE sendall. `items` is a list of (method, kwargs) pairs; each
+        becomes its own wire frame (the server's frame loop needs no
+        change), but the kernel sees a single write — one syscall, one
+        TCP segment train, instead of N per-message round trips through
+        the socket layer.
+
+        Failure semantics: a send error resends the WHOLE batch on a
+        fresh connection, so every element must be duplicate-safe (the
+        same contract as retrying an idempotent call). Callers batch
+        only methods from the duplicate-safe set (cw_task_done et al) —
+        and a batch that fails both attempts raises with NO element
+        delivered-or-not knowledge, exactly like a lost singleton
+        one-way: the out-of-band failure path (death pubsub, lease
+        reclaim) owns recovery for every sibling, not just the first.
+        """
+        if not items:
+            return
+        if len(items) == 1:
+            method, kwargs = items[0]
+            self.send_oneway(method, **kwargs)
+            return
+        frames = []
+        for method, kwargs in items:
+            payload = pickle.dumps((method, kwargs, True), protocol=5)
+            frames.append(_LEN.pack(len(payload)))
+            frames.append(payload)
+        blob = b"".join(frames)
+        with _spans.span("rpc.client.oneway_batch", n=len(items),
+                         bytes=len(blob)) \
+                if len(blob) >= (1 << 16) else _spans.NOOP, \
+                self._lock:
+            for attempt in (0, 1):
+                try:
+                    chaos_lib.on_client_call(items[0][0], self.address)
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    # the lock IS the per-connection serializer (same
+                    # contract as send_oneway/_send_frame): writers
+                    # queued behind it would interleave frames on the
+                    # shared socket if this moved outside
+                    self._sock.sendall(blob)  # graftlint: disable=RT015
+                    return
+                except (ConnectionLost, ConnectionResetError,
+                        BrokenPipeError, OSError):
+                    self.close_locked()
+                    if attempt == 1:
+                        raise ConnectionLost(
+                            f"oneway batch ({len(items)} frames) to "
+                            f"{self.address} failed")
 
     def close_locked(self) -> None:
         if self._sock is not None:

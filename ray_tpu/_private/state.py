@@ -167,6 +167,11 @@ class TaskSpec:
     # trace id; parent_task_id links the causal chain.
     trace_id: Optional[str] = None
     parent_task_id: Optional[str] = None
+    # Owner's node id hex: lets an executor on the same node pick the
+    # shm ring for its cw_task_done report instead of the loopback
+    # socket (_private/shm_channel.py). A real field, not an ad-hoc
+    # attribute, so the compact positional pickle fast path holds.
+    owner_node_id: str = ""
     # Misc
     name: str = ""
     namespace: str = ""

@@ -40,5 +40,13 @@ async def await_ref(ref: Any, loop: asyncio.AbstractEventLoop,
             fut.set_result(None)
 
     cw = worker_mod.global_worker().core_worker
+    start = loop.time()
     cw.add_done_callback(ref, _done)
     await asyncio.wait_for(fut, timeout)
+    # budget enforced on wake, not just by the timer: when a loaded
+    # box stalls the loop past BOTH the timeout timer and the result's
+    # call_soon_threadsafe, the resolve callback is queued first and
+    # wait_for reports success for a request that blew its deadline —
+    # the caller (e.g. the ingress 504 path) must still see a timeout
+    if timeout is not None and loop.time() - start > timeout:
+        raise asyncio.TimeoutError

@@ -165,6 +165,20 @@ class ProxyFleetManager:
                         timeout=self.PING_TIMEOUT_S) != "pong":
                     ray_tpu.kill(actor)
                     return None
+                # config check: a live registered predecessor may be a
+                # condemned zombie (user-killed, the kill not yet
+                # delivered) from an OLDER fleet generation — adopting
+                # it would serve stale ports/timeouts under the new
+                # config. Mismatch → replace, same as a dead ping.
+                armed = ray_tpu.get(  # graftlint: disable=RT015
+                    actor.armed_config.remote(),
+                    timeout=self.PING_TIMEOUT_S)
+                if armed != {"http_port": self._http_port,
+                             "grpc_port": self._grpc_port,
+                             "request_timeout_s":
+                                 self._request_timeout_s}:
+                    ray_tpu.kill(actor)
+                    return None
             except Exception:  # noqa: BLE001 - raced a dying actor
                 return None
         except Exception:  # noqa: BLE001 — node vanished mid-start;
@@ -187,8 +201,9 @@ class ProxyFleetManager:
             except Exception:  # noqa: BLE001 - already dead
                 pass
             return None
-        logger.info("serve fleet: proxy up on node %s (http:%d)",
-                    node_id[:12], st.http_port)
+        logger.info("serve fleet: proxy up on node %s (http:%d, "
+                    "request timeout %ss)", node_id[:12], st.http_port,
+                    self._request_timeout_s)
         return st
 
     def _drain_and_stop(self, st: _ProxyState) -> None:

@@ -249,7 +249,12 @@ class AsyncProxy:
                 if stages is not None:
                     stages["route_s"] = perf_counter() - t0
                 self._refresh_admission(name, handle)
-                await await_ref(ref, self._loop, remaining)
+                # budget re-read AFTER the (blocking) submit phase: a
+                # slow routing fetch must shrink the await window, or a
+                # result landing just past the deadline beats the stale
+                # timer and a deserved 504 becomes a late 200
+                await await_ref(ref, self._loop,
+                                max(0.0, deadline - perf_counter()))
                 return await self._loop.run_in_executor(
                     self._pool,
                     lambda: ray_tpu.get(ref, timeout=30))
@@ -631,6 +636,16 @@ class AsyncProxyActor:
                                  grpc_port=grpc_port,
                                  request_timeout_s=request_timeout_s)
         self.node_id = node_id
+        # the RAW constructor args (0 = ephemeral port, None = config
+        # default), not the resolved values: the fleet's adopt path
+        # compares these against its armed config — a predecessor from
+        # an older fleet generation must not serve a newer config
+        self._armed = {"http_port": http_port, "grpc_port": grpc_port,
+                       "request_timeout_s": request_timeout_s}
+
+    @_control_group
+    def armed_config(self) -> Dict[str, Any]:
+        return dict(self._armed)
 
     @_control_group
     def ready(self) -> int:

@@ -18,18 +18,47 @@ def _cluster(ray_start):
 
 
 @pytest.mark.slow
-def test_fifty_thousand_queued_tasks_complete():
-    """50k tasks queued ahead of workers (reference envelope row: 1M+
+def test_quarter_million_queued_tasks_complete():
+    """250k tasks queued ahead of workers (reference envelope row: 1M+
     tasks queued on one node, README.md:30 — scaled to the CI box; up
-    from r4's 20k after owner-side lease reuse + the dispatch
-    shape-failure memo made the backlog path O(shapes))."""
+    from r5's 50k after spec-blob interning made N queued copies of one
+    closure cost one pickle, batched lease grants + async lease
+    requester made the backlog path cheap per task, and the GCS task
+    tables became bounded rings)."""
     @ray_tpu.remote
     def inc(x):
         return x + 1
 
-    refs = [inc.remote(i) for i in range(50_000)]
-    out = ray_tpu.get(refs, timeout=900)
-    assert out == [i + 1 for i in range(50_000)]
+    n = 250_000
+    refs = [inc.remote(i) for i in range(n)]
+    out = ray_tpu.get(refs, timeout=1800)
+    assert out == [i + 1 for i in range(n)]
+
+
+def test_spec_blob_interning_dedups_queued_args():
+    """Tier-1 twin of the 250k envelope: the owner keeps ONE args blob
+    for a fan-out of identical submissions (the property that makes the
+    250k backlog fit in memory), LRU-bounded so distinct blobs can't
+    grow it without bound."""
+    import ray_tpu._private.worker as worker_mod
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    cw = worker_mod.global_worker().core_worker
+    hits0 = cw.blob_cache_hits
+    refs = [inc.remote(7) for _ in range(64)]
+    assert ray_tpu.get(refs, timeout=120) == [8] * 64
+    # every submission after the first of an identical (fn, args) pair
+    # must hit the cache
+    assert cw.blob_cache_hits - hits0 >= 63
+    specs = [e.spec for e in cw.tasks.values()
+             if e.spec.function_name == "inc"]
+    blobs = {id(s.args) for s in specs}
+    assert len(blobs) <= 2, "identical args blobs were not interned"
+    from ray_tpu._private.config import Config
+    assert len(cw._blob_cache) <= Config.spec_blob_cache_entries
 
 
 @pytest.mark.slow

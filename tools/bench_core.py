@@ -7,7 +7,15 @@ release/benchmarks/README.md:27-31. Numbers are machine-dependent;
 committing the JSON gives each round a recorded baseline on the CI box
 (VERDICT r3 #5).
 
-Usage: python tools/bench_core.py [--out BENCH_CORE_r04.json]
+Usage: python tools/bench_core.py [--out BENCH_CORE_r06.json]
+           [--n 2000] [--format json] [--floor NAME=VALUE ...]
+
+--floor turns the run into a regression gate: after measuring, each
+NAME (a results key) is asserted >= VALUE and the process exits
+non-zero listing every miss. tests/test_bench_smoke.py wires this as a
+tier-1 smoke with tiny op counts and floors far below the recorded
+baseline — it catches order-of-magnitude breakage (a serialized lease
+path, a dead fast path), not CI-box noise.
 """
 
 from __future__ import annotations
@@ -24,10 +32,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="BENCH_CORE_r04.json")
+    ap.add_argument("--out", default="")
     ap.add_argument("--n", type=int, default=2000,
                     help="ops per throughput suite")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json: print the result document to stdout")
+    ap.add_argument("--floor", action="append", default=[],
+                    metavar="NAME=VALUE",
+                    help="fail (exit 1) if results[NAME].value < VALUE; "
+                         "repeatable")
+    ap.add_argument("--skip-dag", action="store_true",
+                    help="skip the compiled-DAG suite (it spawns "
+                         "several actor workers)")
     args = ap.parse_args()
+
+    floors = []
+    for spec in args.floor:
+        name, _, val = spec.partition("=")
+        floors.append((name, float(val)))
 
     import numpy as np
 
@@ -35,6 +57,7 @@ def main() -> int:
 
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     results = {}
+    quiet = args.format == "json"
 
     def timed(name, fn, ops, unit="ops/s"):
         fn()  # warm (workers spawned, code paths jitted)
@@ -43,7 +66,8 @@ def main() -> int:
         dt = time.perf_counter() - t0
         results[name] = {"value": round(ops / dt, 1), "unit": unit,
                          "ops": ops, "seconds": round(dt, 3)}
-        print(f"{name}: {ops / dt:,.0f} {unit}", flush=True)
+        if not quiet:
+            print(f"{name}: {ops / dt:,.0f} {unit}", flush=True)
 
     n = args.n
 
@@ -85,16 +109,69 @@ def main() -> int:
           lambda: ray_tpu.wait(wait_refs, num_returns=1000,
                                timeout=60.0), 1000)
 
+    if not args.skip_dag:
+        # compiled vs interpreted DAG repeat-execution: the interpreted
+        # walk instantiates a FRESH actor per execute; the compiled
+        # plan reuses it, so the ratio is dominated by actor-creation
+        # round trips it skips (acceptance: >= 3x)
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class _Stage:
+            def apply(self, x):
+                return x + 1
+
+        with InputNode() as inp:
+            dag = _Stage.bind().apply.bind(inp)
+        reps = 5
+
+        def run_interpreted():
+            for i in range(reps):
+                # serial on purpose: the suite measures per-execute
+                # round-trip latency, not pipelined throughput
+                ray_tpu.get(dag.execute(i))  # graftlint: disable=RT002
+
+        run_interpreted()  # warm worker pool
+        t0 = time.perf_counter()
+        run_interpreted()
+        dt_interp = (time.perf_counter() - t0) / reps
+        comp = dag.experimental_compile()
+        ray_tpu.get(comp.execute(0))  # warm the compiled channel
+        t0 = time.perf_counter()
+        for i in range(reps):
+            ray_tpu.get(comp.execute(i))  # graftlint: disable=RT002
+        dt_comp = (time.perf_counter() - t0) / reps
+        comp.teardown()
+        results["dag_compiled_speedup_x"] = {
+            "value": round(dt_interp / dt_comp, 1), "unit": "x",
+            "interpreted_ms": round(dt_interp * 1e3, 2),
+            "compiled_ms": round(dt_comp * 1e3, 2)}
+        if not quiet:
+            print(f"dag_compiled_speedup_x: {dt_interp / dt_comp:.1f}x "
+                  f"({dt_interp*1e3:.1f}ms -> {dt_comp*1e3:.1f}ms)",
+                  flush=True)
+
     out = {
         "suite": "core_microbenchmark",
         "host": {"cpus": os.cpu_count()},
         "results": results,
     }
-    with open(args.out, "w", encoding="utf-8") as f:
-        json.dump(out, f, indent=1)
-    print(f"wrote {args.out}")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=1)
+        if not quiet:
+            print(f"wrote {args.out}")
+    if quiet:
+        print(json.dumps(out, indent=1))
     ray_tpu.shutdown()
-    return 0
+
+    misses = [(name, floor, results[name]["value"])
+              for name, floor in floors
+              if results[name]["value"] < floor]
+    for name, floor, got in misses:
+        print(f"FLOOR MISS: {name} = {got} < {floor}", file=sys.stderr,
+              flush=True)
+    return 1 if misses else 0
 
 
 if __name__ == "__main__":
