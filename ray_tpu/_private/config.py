@@ -66,6 +66,25 @@ class Config:
     metrics_sample_interval_s = _define(
         "metrics_sample_interval_s", 2.0, float)
     metrics_history_samples = _define("metrics_history_samples", 300, int)
+    # Durable tiered history (_private/metrics_history.py): segment
+    # directory (empty = derive from the GCS persist path, or stay
+    # memory-only without one), total on-disk retention budget split
+    # across the raw/30s/5min tiers, and how many buffered samples a
+    # tier accumulates before writing one fsync'd segment.
+    metrics_history_dir = _define("metrics_history_dir", "", str)
+    metrics_history_retention_bytes = _define(
+        "metrics_history_retention_bytes", 32 << 20, int)
+    metrics_history_segment_samples = _define(
+        "metrics_history_segment_samples", 32, int)
+    # Goodput ledger (_private/goodput.py): the `goodput_regression`
+    # probe alerts when a job's productive_step fraction of its
+    # accounted wall time over the sliding window drops below the
+    # floor, naming the dominant badput bucket. Both
+    # metrics_configure-tunable at runtime.
+    watchdog_goodput_floor = _define(
+        "watchdog_goodput_floor", 0.5, float)
+    watchdog_goodput_window_s = _define(
+        "watchdog_goodput_window_s", 120.0, float)
     watchdog_cooldown_s = _define("watchdog_cooldown_s", 30.0, float)
     watchdog_wait_edge_age_s = _define(
         "watchdog_wait_edge_age_s", 120.0, float)

@@ -150,6 +150,7 @@ class GcsServer:
         # — survives a GCS restart.
         persist_path = persist_path or os.environ.get(
             "RAY_TPU_GCS_PERSIST_PATH")
+        self._persist_path = persist_path
         self.store = PersistentStore(persist_path) if persist_path \
             else InMemoryStore()
         self._pool = rpc_lib.ClientPool(timeout=30)
@@ -334,13 +335,22 @@ class GcsServer:
         from ray_tpu._private import metrics_plane as metrics_plane_lib
         metrics_plane_lib.register_sampler("gcs",
                                            self._sample_metric_gauges)
-        self.metrics_plane = metrics_plane_lib.MetricsPlane(self)
+        # Durable history segments live next to the KV snapshot when
+        # the GCS persists (a restart replays both); explicit
+        # Config.metrics_history_dir overrides inside the plane.
+        hist_dir = None
+        if self._persist_path:
+            hist_dir = self._persist_path + ".metrics"
+        self.metrics_plane = metrics_plane_lib.MetricsPlane(
+            self, history_dir=hist_dir)
         self.server.register("metrics_collect", self.metrics_plane.collect)
         self.server.register("metrics_prometheus",
                              self.metrics_plane.prometheus)
         self.server.register("metrics_merged", self.metrics_plane.merged)
         self.server.register("metrics_history",
                              self.metrics_plane.query_history)
+        self.server.register("metrics_history_range",
+                             self.metrics_plane.query_history_range)
         self.server.register("metrics_configure",
                              self.metrics_plane.configure)
         self._health_thread = threading.Thread(

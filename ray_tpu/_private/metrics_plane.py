@@ -361,7 +361,9 @@ class Watchdog:
                  gang_heartbeat_stale_s: float = 10.0,
                  jit_recompiles: int = 3,
                  jit_recompile_warmup_s: float = 60.0,
-                 host_transfer_bytes: float = float(1 << 20)) -> None:
+                 host_transfer_bytes: float = float(1 << 20),
+                 goodput_floor: float = 0.5,
+                 goodput_window_s: float = 120.0) -> None:
         self._emit = emit
         self.cooldown_s = cooldown_s
         self.wait_edge_age_s = wait_edge_age_s
@@ -377,6 +379,11 @@ class Watchdog:
         self.jit_recompiles = jit_recompiles
         self.jit_recompile_warmup_s = jit_recompile_warmup_s
         self.host_transfer_bytes = host_transfer_bytes
+        self.goodput_floor = goodput_floor
+        self.goodput_window_s = goodput_window_s
+        # goodput probe: job -> deque of (monotonic ts, {bucket: total})
+        # snapshots spanning the sliding window
+        self._goodput_window: Dict[str, "deque"] = {}
         # jax sentinel storm probe: step-region label -> monotonic ts
         # its first compile series appeared (warmup grace clock)
         self._jit_first_seen: Dict[str, float] = {}
@@ -1104,6 +1111,74 @@ class Watchdog:
                     f"host_sync.* in `ray_tpu timeline --spans`)",
                     severity="ERROR", region=region, value=delta)
 
+    def _probe_goodput(self, series: Dict[str, float],
+                       interval_s: float) -> None:
+        """`goodput_regression`: a job's productive_step fraction of
+        its accounted wall time over the sliding window
+        (goodput_window_s) dropped below goodput_floor — the gang is
+        alive but its time is going somewhere other than training.
+        Judged from per-window DELTAS of the harvested
+        `ray_tpu_goodput_seconds_total{job,bucket}` counters (so an old
+        bad patch can't alert forever), and the alert names the
+        DOMINANT badput bucket — the triage pointer: feed_stall means
+        starve the sampler less, elastic_reconfig/wedge_recovery means
+        churn, compile means a recompile hazard (see the
+        jit_recompile_storm probe), idle means unattributed driver
+        time (graftlint RT024's territory). Windows where the job was
+        live for under half the wall time are skipped — a ledger that
+        just appeared (or a paused harvest) must not read as badput."""
+        now = time.monotonic()
+        prefix = "ray_tpu_goodput_seconds_total{"
+        totals: Dict[str, Dict[str, float]] = {}
+        for key, v in series.items():
+            if not key.startswith(prefix):
+                continue
+            tags = self._series_tags(key)
+            job = tags.get("job")
+            bucket = tags.get("bucket")
+            if job and bucket:
+                totals.setdefault(job, {})[bucket] = v
+        # evict jobs gone from the harvest (ledger's proc died); a
+        # returning job pays one fresh baseline window
+        for job in [j for j in self._goodput_window if j not in totals]:
+            del self._goodput_window[job]
+        window = max(self.goodput_window_s, 0.0)
+        for job, cur in totals.items():
+            dq = self._goodput_window.setdefault(job, deque())
+            dq.append((now, cur))
+            # keep one entry at-or-past the window edge as the baseline
+            while len(dq) >= 3 and now - dq[1][0] >= window:
+                dq.popleft()
+            if len(dq) < 2:
+                continue  # baseline round for this job
+            t0, base = dq[0]
+            wall = now - t0
+            if wall <= 0:
+                continue
+            deltas = {b: max(0.0, cur.get(b, 0.0) - base.get(b, 0.0))
+                      for b in set(cur) | set(base)}
+            accounted = sum(deltas.values())
+            if accounted < 0.5 * wall:
+                continue  # job not live for most of the window
+            productive = deltas.get("productive_step", 0.0)
+            frac = productive / accounted
+            if frac >= self.goodput_floor:
+                continue
+            badput = {b: d for b, d in deltas.items()
+                      if b != "productive_step" and d > 0}
+            dominant, dom_s = max(
+                badput.items(), key=lambda kv: kv[1],
+                default=("idle", 0.0))
+            self._alert(
+                "goodput_regression", job,
+                f"job {job!r}: productive fraction "
+                f"{100.0 * frac:.0f}% over the last {wall:.0f}s is "
+                f"below the {100.0 * self.goodput_floor:.0f}% floor — "
+                f"dominant badput bucket is {dominant!r} "
+                f"({dom_s:.1f}s of {accounted:.1f}s accounted); see "
+                f"`ray_tpu goodput --job {job}`", severity="ERROR",
+                job=job, value=frac, dominant=dominant)
+
     def _probe_harvest_coverage(self, unreachable: List[str]) -> None:
         for node in unreachable:
             self._alert(
@@ -1130,6 +1205,7 @@ class Watchdog:
                       lambda: self._probe_gang_wedge(series),
                       lambda: self._probe_jax_sentinel(series),
                       lambda: self._probe_replay_stall(series),
+                      lambda: self._probe_goodput(series, interval_s),
                       lambda: self._probe_harvest_coverage(
                           unreachable_nodes)):
             try:
@@ -1152,13 +1228,19 @@ class MetricsPlane:
 
     COLLECT_TIMEOUT_S = 5.0
 
-    def __init__(self, gcs: Any) -> None:
+    def __init__(self, gcs: Any,
+                 history_dir: Optional[str] = None) -> None:
         from ray_tpu._private.config import Config
+        from ray_tpu._private.metrics_history import TieredHistory
         from ray_tpu.util.metrics import (Gauge, Histogram,
                                           get_or_create)
         self._gcs = gcs
         self.interval_s = Config.metrics_sample_interval_s
-        self.history = SeriesHistory(Config.metrics_history_samples)
+        self.history = TieredHistory(
+            Config.metrics_history_samples,
+            dir=Config.metrics_history_dir or history_dir or None,
+            retention_bytes=Config.metrics_history_retention_bytes,
+            segment_samples=Config.metrics_history_segment_samples)
         self.aggregator = ClusterAggregator()
         self.watchdog = Watchdog(
             emit=gcs._emit,
@@ -1176,7 +1258,9 @@ class MetricsPlane:
             jit_recompiles=Config.watchdog_jit_recompiles,
             jit_recompile_warmup_s=(
                 Config.watchdog_jit_recompile_warmup_s),
-            host_transfer_bytes=Config.watchdog_host_transfer_bytes)
+            host_transfer_bytes=Config.watchdog_host_transfer_bytes,
+            goodput_floor=Config.watchdog_goodput_floor,
+            goodput_window_s=Config.watchdog_goodput_window_s)
         self._harvest_hist = get_or_create(
             Histogram, "ray_tpu_metrics_harvest_seconds",
             description="wall time of one cluster metrics harvest "
@@ -1314,13 +1398,21 @@ class MetricsPlane:
             t0 = time.monotonic()
             snaps, unreachable = self._harvest()
             series = self.aggregator.update(snaps)
-            # the ring's retention contract is samples x interval_s:
-            # forced rounds (collects, dumps) between sampler ticks
-            # must not shrink that window, so appends are time-gated
-            if (self.interval_s <= 0
-                    or t0 - self._last_history_mono
-                    >= 0.9 * self.interval_s):
-                self.history.append(time.time(), series)
+            # the ring's retention contract is samples x interval_s of
+            # NON-forced samples: rounds forced between sampler ticks
+            # (collects, dumps) land in the raw tier tagged forced=True
+            # — visible to sparklines (no gaps), excluded from rate
+            # computation and from the retention count — instead of
+            # being dropped outright as they were pre-PR-20
+            due = (self.interval_s <= 0
+                   or t0 - self._last_history_mono
+                   >= 0.9 * self.interval_s)
+            kinds = {m["name"]: m["kind"]
+                     for snap in snaps
+                     for m in snap.get("metrics", ())}
+            self.history.append(time.time(), series, kinds=kinds,
+                                forced=not due)
+            if due:
                 self._last_history_mono = t0
             self.watchdog.evaluate(snaps, series, unreachable,
                                    interval_s=self.interval_s)
@@ -1394,8 +1486,23 @@ class MetricsPlane:
 
     def query_history(self, names: Optional[List[str]] = None,
                       limit: Optional[int] = None) -> Dict[str, Any]:
+        rows = self.history.query_ex(names=names, limit=limit)
         return {"interval_s": self.interval_s,
-                "samples": self.history.query(names=names, limit=limit)}
+                "samples": [(ts, series) for ts, series, _f in rows],
+                "forced": [f for _ts, _s, f in rows]}
+
+    def query_history_range(self, names: Optional[List[str]] = None,
+                            since_s: float = 600.0,
+                            tier: str = "raw") -> Dict[str, Any]:
+        """The `metrics_history_range` RPC: lookback-window read across
+        the durable tiers (raw samples, or downsampled windows with
+        counters as per-window deltas and gauges as [min, mean, max]),
+        reaching through on-disk segments — including pre-restart ones
+        replayed at GCS startup."""
+        return {"interval_s": self.interval_s,
+                "tier": tier,
+                "samples": self.history.range_query(
+                    names=names, since_s=since_s, tier=tier)}
 
     def configure(self, interval_s: Optional[float] = None,
                   cooldown_s: Optional[float] = None,
@@ -1412,7 +1519,9 @@ class MetricsPlane:
                   step_deadline_s: Optional[float] = None,
                   jit_recompiles: Optional[int] = None,
                   jit_recompile_warmup_s: Optional[float] = None,
-                  host_transfer_bytes: Optional[float] = None
+                  host_transfer_bytes: Optional[float] = None,
+                  goodput_floor: Optional[float] = None,
+                  goodput_window_s: Optional[float] = None
                   ) -> Dict[str, Any]:
         """Runtime tuning (ops + tests): adjust the sample interval and
         watchdog thresholds without restarting the GCS.
@@ -1456,6 +1565,10 @@ class MetricsPlane:
         if host_transfer_bytes is not None:
             self.watchdog.host_transfer_bytes = \
                 float(host_transfer_bytes)
+        if goodput_floor is not None:
+            self.watchdog.goodput_floor = float(goodput_floor)
+        if goodput_window_s is not None:
+            self.watchdog.goodput_window_s = float(goodput_window_s)
         return {"interval_s": self.interval_s,
                 "cooldown_s": self.watchdog.cooldown_s,
                 "wait_edge_age_s": self.watchdog.wait_edge_age_s,
@@ -1476,9 +1589,17 @@ class MetricsPlane:
                 "jit_recompile_warmup_s":
                     self.watchdog.jit_recompile_warmup_s,
                 "host_transfer_bytes":
-                    self.watchdog.host_transfer_bytes}
+                    self.watchdog.host_transfer_bytes,
+                "goodput_floor": self.watchdog.goodput_floor,
+                "goodput_window_s": self.watchdog.goodput_window_s}
 
     def stop(self) -> None:
         self._stopped = True
         self._wake.set()
         self._liveness_wake.set()
+        try:
+            # flush buffered history segments so a restart replays
+            # right up to the last harvest
+            self.history.stop()
+        except Exception:  # noqa: BLE001 - shutdown is best-effort
+            logger.exception("metrics history flush failed on stop")

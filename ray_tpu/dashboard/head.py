@@ -33,6 +33,8 @@ reference parity: dashboard/head.py (aiohttp head hosting module routes)
     GET /api/metrics  — the same harvest as JSON: per-proc snapshots +
                         merged series (?history=1 → the GCS's in-memory
                         time-series ring instead)
+    GET /api/goodput  — per-job productive/badput wall-time ledger
+                        (?job=&window=secs; _private/goodput.py)
     GET /api/logs     — attributed cluster logs (one logs_query fan-out;
                         filters: node_id/worker_id/actor/task_id/
                         trace_id/level/match/tail/timeout)
@@ -331,6 +333,15 @@ class DashboardHead:
                          params.get("names", "").split(",") if n]
                 return s.metrics_history(names=names or None)
             return s.cluster_metrics()
+        if route == "/api/goodput":
+            # per-job productive/badput wall-time ledger
+            # (_private/goodput.py; CLI: `ray_tpu goodput`):
+            # ?job=<name> filters, ?window=<secs> reports the trailing
+            # window via the durable history instead of job lifetime
+            return s.goodput(
+                job=params.get("job"),
+                window_s=(float(params["window"])
+                          if "window" in params else None))
         if route == "/api/metrics/config":
             from ray_tpu.dashboard.metrics import write_metrics_configs
             return write_metrics_configs()

@@ -1,12 +1,14 @@
-"""JAX/XLA hot-path rule family: RT020-RT023.
+"""JAX/XLA hot-path rule family: RT020-RT024.
 
 XLA gives speed back silently: a jit cache miss per step (RT020), an
 implicit device->host sync inside the learner loop (RT021), a donated
-buffer read after the call that donated it (RT022), or a pin/lease/slot
+buffer read after the call that donated it (RT022), a pin/lease/slot
 acquired without an exception-safe release (RT023 — the bug class the
-PR 12 chaos fuzzer kept finding by hand). These rules are the static
-half of the pairing whose runtime half is ray_tpu/util/jax_sentinel.py
-(compile counters + transfer accounting on the live learner).
+PR 12 chaos fuzzer kept finding by hand), or a bare time.sleep inside
+a goodput-instrumented loop (RT024 — phantom idle in the wall-time
+ledger). These rules are the static half of the pairing whose runtime
+half is ray_tpu/util/jax_sentinel.py (compile counters + transfer
+accounting on the live learner) and _private/goodput.py (the ledger).
 
 Analysis building blocks shared by the family:
 
@@ -1003,3 +1005,79 @@ class LeakOnRaise(_JaxRule):
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         yield from self.project_check({ctx.path: self.collect_facts(ctx)})
+
+
+# =====================================================================
+# RT024: unattributed sleep in a goodput-instrumented training path
+# =====================================================================
+
+
+class UnattributedSleep(_JaxRule):
+    id = "RT024"
+    name = "unattributed-sleep-in-training-path"
+    rationale = ("the goodput ledger classifies every second of a "
+                 "bound thread's wall time; a bare time.sleep inside an "
+                 "instrumented loop lands in whatever bucket happens to "
+                 "be open (or reads as phantom idle) with no signal "
+                 "why — the wait must be named")
+
+    _SLEEP_NAMES = {"time.sleep", "sleep"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if _path_exempt(ctx.path):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not self._instrumented(ctx, fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if ctx.dotted(node.func) not in self._SLEEP_NAMES:
+                    continue
+                if self._inside_bucket(ctx, node, fn):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"bare time.sleep in goodput-instrumented "
+                    f"'{fn.name}': the blocked wall time is "
+                    f"unattributed (phantom idle in the job's goodput "
+                    f"ledger) — wrap it in `with goodput.bucket(...)` "
+                    f"naming the wait, or move the pacing out of the "
+                    f"instrumented path")
+
+    @staticmethod
+    def _goodput_call(ctx: ModuleContext, call: ast.Call) -> bool:
+        """A call into the ledger API: goodput.bucket/charge/enter/
+        ledger(...) (module alias included — anything dotted through a
+        name ending in 'goodput')."""
+        dotted = ctx.dotted(call.func) or ""
+        head, _, tail = dotted.rpartition(".")
+        return head.endswith("goodput") and \
+            tail in ("bucket", "charge", "enter", "ledger")
+
+    def _instrumented(self, ctx: ModuleContext, fn: ast.AST) -> bool:
+        """The function participates in ledger accounting: it opens
+        bucket scopes, charges time, or binds a ledger."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and self._goodput_call(ctx, node):
+                return True
+        return False
+
+    def _inside_bucket(self, ctx: ModuleContext, node: ast.AST,
+                       fn: ast.AST) -> bool:
+        """Lexically under a `with goodput.bucket(...)` (or a ledger
+        method's .bucket(...)) — the sleep's wall time IS attributed."""
+        for anc in ctx.ancestors(node):
+            if anc is fn:
+                return False
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    e = item.context_expr
+                    if not isinstance(e, ast.Call):
+                        continue
+                    dotted = ctx.dotted(e.func) or ""
+                    if dotted.rpartition(".")[2] == "bucket":
+                        return True
+        return False

@@ -1001,10 +1001,11 @@ class BlockingCallInAsync(Rule):
 from ray_tpu.lint.concurrency import (BlockingUnderLock,  # noqa: E402
                                       LockOrderCycle, MixedGuardAccess)
 # JAX/XLA hot-path layer (recompile hazards, hidden syncs, donation,
-# leak-on-raise) — the static half of the jax_sentinel pairing.
+# leak-on-raise, unattributed sleeps) — the static half of the
+# jax_sentinel / goodput-ledger pairing.
 from ray_tpu.lint.jaxrules import (DonationMisuse,  # noqa: E402
                                    HiddenHostSync, LeakOnRaise,
-                                   RecompileHazard)
+                                   RecompileHazard, UnattributedSleep)
 
 ALL_RULES: List[Rule] = [
     NestedBlockingGet(), GetInLoop(), HostEffectInJit(),
@@ -1015,7 +1016,7 @@ ALL_RULES: List[Rule] = [
     LockOrderCycle(), UnboundedWaitInServingPath(),
     OwnershipBookkeepingDiscipline(), BlockingCallInAsync(),
     RecompileHazard(), HiddenHostSync(), DonationMisuse(),
-    LeakOnRaise(),
+    LeakOnRaise(), UnattributedSleep(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -425,17 +425,24 @@ class DQN(Algorithm):
 
         cfg = self.config
         group = self._replay_group
+        # goodput ledger for the replay learner thread: replay-sample
+        # starvation is replay_stall (distinct from the on-policy
+        # feed_stall — a starved replay plane has different fixes)
+        from ray_tpu._private import goodput
+        goodput.ledger("dqn").bind()
         if self.learner_group._local is not None:
             from ray_tpu.rllib.utils.device_feed import DeviceFeed
             self._replay_feed = DeviceFeed(group.queue,
-                                           stop_event=self._replay_stop)
+                                           stop_event=self._replay_stop,
+                                           stall_bucket="replay_stall")
         while not self._replay_stop.is_set():
             staged = None
             try:
                 if self._replay_feed is not None:
                     batch, meta = self._replay_feed.get(timeout=0.2)
                 else:
-                    staged, meta = group.queue.get(timeout=0.2)
+                    with goodput.bucket("replay_stall"):
+                        staged, meta = group.queue.get(timeout=0.2)
                     batch = staged.as_dict()
             except queue.Empty:
                 continue

@@ -188,6 +188,11 @@ class Impala(Algorithm):
     def _learner_loop(self) -> None:
         import time as _time
 
+        # goodput ledger for the learner thread: sampling starvation
+        # is feed_stall, LearnerGroup.update opens productive_step,
+        # unwrapped remainder is honest idle
+        from ray_tpu._private import goodput
+        goodput.ledger("impala").bind()
         # Local learner: double-buffered host→HBM prefetch so transfer k+1
         # overlaps update k (SURVEY §7.3 EnvRunner→Learner throughput).
         # Gang learners receive host batches over RPC instead.
@@ -200,7 +205,8 @@ class Impala(Algorithm):
                 if self._feed is not None:
                     batch, steps = self._feed.get(timeout=0.2)
                 else:
-                    batch, steps = self._train_queue.get(timeout=0.2)
+                    with goodput.bucket("feed_stall"):
+                        batch, steps = self._train_queue.get(timeout=0.2)
             except queue.Empty:
                 continue
             try:

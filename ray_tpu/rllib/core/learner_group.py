@@ -335,12 +335,18 @@ class LearnerGroup:
     def update(self, batch: Dict[str, np.ndarray],
                minibatch_size: Optional[int] = None,
                num_iters: int = 1, seed: int = 0) -> Dict[str, float]:
+        from ray_tpu._private import goodput
         if self._local is not None:
-            return self._local.update(batch, minibatch_size, num_iters,
-                                      seed)
+            # the local learner computes in-process: sentinel compile
+            # events on this thread re-attribute warmup out of the
+            # productive window
+            with goodput.bucket(goodput.PRODUCTIVE):
+                return self._local.update(batch, minibatch_size,
+                                          num_iters, seed)
         try:
-            return self._update_remote(batch, minibatch_size, num_iters,
-                                       seed)
+            with goodput.bucket(goodput.PRODUCTIVE):
+                return self._update_remote(batch, minibatch_size,
+                                           num_iters, seed)
         except Exception as e:  # noqa: BLE001 - actor death mid-update
             from ray_tpu.exceptions import RayTaskError
             from ray_tpu.train.backend_executor import GangWedgedError
@@ -361,8 +367,9 @@ class LearnerGroup:
                 "wedge" if isinstance(e, GangWedgedError)
                 else "worker_death",
                 target=self._target_learners)
-            return self._update_remote(batch, minibatch_size, num_iters,
-                                       seed)
+            with goodput.bucket(goodput.PRODUCTIVE):
+                return self._update_remote(batch, minibatch_size,
+                                           num_iters, seed)
 
     def _update_remote(self, batch, minibatch_size, num_iters, seed):
         import ray_tpu

@@ -184,8 +184,12 @@ class DeviceFeed:
 
     def __init__(self, host_queue: "queue.Queue",
                  depth: int = 2,
-                 stop_event: Optional[threading.Event] = None):
+                 stop_event: Optional[threading.Event] = None,
+                 stall_bucket: str = "feed_stall"):
         self._host = host_queue
+        # goodput bucket the consumer's blocked get() time charges to
+        # (replay learners pass "replay_stall")
+        self._stall_bucket = stall_bucket
         self._out: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = stop_event or threading.Event()
         self.wait_s = 0.0
@@ -292,11 +296,16 @@ class DeviceFeed:
         # feed.wait = consumer blocked on the feed (starvation: upstream
         # sampling is the bottleneck); feed.xfer isolates the tail spent
         # waiting for an already-dequeued transfer to land in HBM
+        from ray_tpu._private import goodput
         with _spans.span("feed.wait") as _sp:
             try:
                 dev, meta = self._out.get(timeout=timeout)
             except queue.Empty:
-                self.wait_s += time.perf_counter() - t0
+                waited = time.perf_counter() - t0
+                self.wait_s += waited
+                # starvation is badput on the consumer's ledger even
+                # when the get comes back empty
+                goodput.charge(self._stall_bucket, waited)
                 _sp["empty"] = True
                 raise
             t1 = time.perf_counter()
@@ -307,6 +316,7 @@ class DeviceFeed:
             t2 = time.perf_counter()
         self.wait_s += t2 - t0
         self.xfer_s += t2 - t1
+        goodput.charge(self._stall_bucket, t2 - t0)
         self.batches += 1
         return dev, meta
 

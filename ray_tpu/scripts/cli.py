@@ -804,12 +804,19 @@ def cmd_top(args) -> int:
         ts, latest = samples[-1]
         keys = sorted(latest)
         window = samples[-30:]
+        # rates come from the sampler's CADENCED rounds only: forced
+        # harvests (metrics dump, tests) land in the ring for the
+        # sparklines but their sub-interval spacing would turn a rate
+        # into noise
+        forced = hist.get("forced") or [False] * len(samples)
+        paced = [smp for smp, f in zip(samples, forced) if not f] \
+            or samples
         rows = []
         for k in keys:
             vals = [smp.get(k) for _t, smp in window]
             rate = ""
-            if len(samples) >= 2:
-                (t0, prev), (t1, cur) = samples[-2], samples[-1]
+            if len(paced) >= 2:
+                (t0, prev), (t1, cur) = paced[-2], paced[-1]
                 if k in prev and k in cur and t1 > t0:
                     rate = f"{(cur[k] - prev[k]) / (t1 - t0):+.1f}/s"
             rows.append({"series": k, "value": f"{latest[k]:g}",
@@ -818,6 +825,49 @@ def cmd_top(args) -> int:
         print(f"== ray_tpu top · {len(keys)} series · "
               f"sample interval {hist['interval_s']:g}s")
         _print_table(rows, ["series", "value", "rate", "history"])
+    return 0
+
+
+def cmd_goodput(args) -> int:
+    """Per-job wall-time ledger: every second of gang lifetime bucketed
+    into productive_step / compile / checkpoint / reconfig / stalls /
+    idle (see README "Goodput & metrics history")."""
+    _connect(args)
+    from ray_tpu.util import state as s
+    from ray_tpu._private import goodput as gp
+    report = s.goodput(job=args.job, window_s=args.window, fresh=True)
+    if args.format == "json":
+        print(json.dumps(report, default=str))
+        return 0
+    jobs = report.get("jobs") or {}
+    if not jobs:
+        print("(no goodput ledgers yet — training loops create them "
+              "on their first step)")
+        return 0
+    window = (f"last {report['window_s']:g}s"
+              if report.get("window_s") else "job lifetime")
+    for job, rec in sorted(jobs.items()):
+        frac = rec.get("productive_frac")
+        frac_txt = f"{100 * frac:.1f}%" if frac is not None else "n/a"
+        print(f"== job {job} · {window} · "
+              f"accounted {rec['accounted_s']:.1f}s · "
+              f"productive {frac_txt}")
+        buckets = rec.get("buckets") or {}
+        total = rec.get("accounted_s") or 0.0
+        rows = []
+        for name in gp.BUCKETS:
+            secs = buckets.get(name, 0.0)
+            if not secs and name != gp.PRODUCTIVE:
+                continue
+            share = f"{100 * secs / total:.1f}%" if total else ""
+            rows.append({"bucket": name, "seconds": f"{secs:.2f}",
+                         "share": share})
+        _print_table(rows, ["bucket", "seconds", "share"])
+        inflight = rec.get("in_flight")
+        if inflight:
+            print(f"   in-flight: {inflight.get('bucket') or 'idle'} "
+                  f"for {inflight.get('bucket_age_s', 0.0):.1f}s "
+                  f"(proc {inflight.get('proc', '?')})")
     return 0
 
 
@@ -1029,6 +1079,17 @@ def main(argv=None) -> int:
     p.add_argument("--filter", default="ray_tpu_",
                    help="series name prefix ('' for everything)")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("goodput", help="per-job productive/badput "
+                                       "wall-time ledger")
+    p.add_argument("--address", default=None)
+    p.add_argument("--job", default=None,
+                   help="only this job (default: all jobs)")
+    p.add_argument("--window", type=float, default=None,
+                   help="report the trailing N seconds instead of "
+                        "job lifetime (needs the GCS history ring)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.set_defaults(fn=cmd_goodput)
 
     p = sub.add_parser("chaos", help="fault injection: list/inject/clear "
                                      "chaos rules (see README)")

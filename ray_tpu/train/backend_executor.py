@@ -32,6 +32,7 @@ import uuid
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu._private import goodput
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.config import ScalingConfig
 from ray_tpu.train.session import TrainContext, TrainingResult
@@ -217,7 +218,13 @@ class BackendExecutor:
             "datasets": datasets,
         }
         self._latest_checkpoint_dir = checkpoint_dir
-        self._init_sessions(checkpoint_dir)
+        if checkpoint_dir is not None:
+            # resuming a prior run: session init re-reads model state
+            # from the durable checkpoint on every rank
+            with goodput.bucket("checkpoint_restore"):
+                self._init_sessions(checkpoint_dir)
+        else:
+            self._init_sessions(checkpoint_dir)
         self._start_sessions()
 
     def _init_sessions(self, checkpoint_dir: Optional[str]) -> None:
@@ -282,18 +289,24 @@ class BackendExecutor:
                     continue
                 self._maybe_grow()
             try:
-                refs = [w.next_result.remote(timeout=timeout)
-                        for w in self.worker_group.workers]
-                if self._elastic:
-                    # wedge-aware wait: poll so a rank hung INSIDE a
-                    # collective (stale heartbeat + expired step
-                    # deadline) is detected and hard-killed instead of
-                    # blocking the whole gang for the full timeout
-                    results = self._await_round(refs, timeout)
-                else:
-                    # the get IS batched; the loop is the restart path
-                    results = ray_tpu.get(  # graftlint: disable=RT002
-                        refs, timeout=timeout + 60)
+                # the round wait IS the training step from the driver's
+                # vantage: the gang is stepping (goodput) until a span
+                # inside re-attributes (compile charge, elastic window)
+                with goodput.bucket(goodput.PRODUCTIVE):
+                    refs = [w.next_result.remote(timeout=timeout)
+                            for w in self.worker_group.workers]
+                    if self._elastic:
+                        # wedge-aware wait: poll so a rank hung INSIDE
+                        # a collective (stale heartbeat + expired step
+                        # deadline) is detected and hard-killed instead
+                        # of blocking the whole gang for the full
+                        # timeout
+                        results = self._await_round(refs, timeout)
+                    else:
+                        # the get IS batched; the loop is the restart
+                        # path
+                        results = ray_tpu.get(  # graftlint: disable=RT002
+                            refs, timeout=timeout + 60)
             except Exception as e:  # noqa: BLE001 - actor death etc.
                 self._handle_failure(e)
                 continue

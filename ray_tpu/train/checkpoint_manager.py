@@ -129,6 +129,12 @@ class CheckpointManager:
         copy+fsync into a tmp dir, rename into place, THEN advance the
         LATEST pointer — a kill mid-save can never leave a torn dir as
         the resume target."""
+        from ray_tpu._private import goodput
+        with goodput.bucket("checkpoint_save"):
+            return self._register_impl(worker_dir, metrics)
+
+    def _register_impl(self, worker_dir: str,
+                       metrics: Dict[str, Any]) -> Checkpoint:
         self._sweep_tmp()
         self._counter += 1
         name = f"checkpoint_{self._counter:06d}"

@@ -74,6 +74,13 @@ class DataParallelTrainer:
         ckpt_mgr = CheckpointManager(
             run_dir, self._run_config.checkpoint_config)
 
+        # goodput ledger for this job, bound to the driving thread:
+        # every wall second of fit() lands in exactly one bucket
+        # (checkpoint persists, elastic re-forms, and compile charges
+        # re-attribute inside the open scopes; the rest is idle)
+        from ray_tpu._private import goodput
+        goodput.ledger(run_name).bind()
+
         executor = BackendExecutor(
             self._backend_config, self._scaling_config,
             max_failures=self._run_config.failure_config.max_failures)
@@ -120,6 +127,7 @@ class DataParallelTrainer:
             error = e
         finally:
             executor.shutdown()
+            goodput.unbind()
 
         return Result(
             metrics=last_metrics,

@@ -173,6 +173,13 @@ class _Reconfig:
             "reason": reason, "phase": "detect",
             "started_mono": self._t0,
         }
+        # goodput: the whole detect->resume window is badput on the
+        # driver's ledger — wedge recoveries get their own bucket so
+        # churn and hangs stay distinguishable in the ledger
+        from ray_tpu._private import goodput
+        self._goodput_token = goodput.enter(
+            "wedge_recovery" if reason == "wedge"
+            else "elastic_reconfig")
         spans.instant("elastic.detect", reason=reason,
                       gang=tracker.name, world_size=world_size)
 
@@ -184,6 +191,9 @@ class _Reconfig:
         return _Phase(self, name, attrs)
 
     def finish(self, world_size: int) -> None:
+        from ray_tpu._private import goodput
+        goodput.exit(self._goodput_token)
+        self._goodput_token = None
         self.to_world = world_size
         self.duration_s = time.monotonic() - self._t0
         spans.instant("elastic.resumed", reason=self.reason,
@@ -192,6 +202,9 @@ class _Reconfig:
         self.tracker._finished(self, ok=True)
 
     def abort(self, error: Optional[BaseException] = None) -> None:
+        from ray_tpu._private import goodput
+        goodput.exit(self._goodput_token)
+        self._goodput_token = None
         self.duration_s = time.monotonic() - self._t0
         spans.instant("elastic.aborted", reason=self.reason,
                       gang=self.tracker.name,

@@ -128,6 +128,12 @@ def _on_event_duration(event: str, duration: float,
         _compile_counter.inc(
             1.0, tags={"fn": fn,
                        "kind": "first" if n == 0 else "recompile"})
+        # goodput: the event fires synchronously on the jit-calling
+        # thread with the compile's wall duration — re-attribute it out
+        # of whatever ledger bucket is open there (typically
+        # productive_step) into `compile`
+        from ray_tpu._private import goodput
+        goodput.charge("compile", float(duration))
     except Exception:  # noqa: BLE001 - telemetry is best-effort
         pass
 

@@ -483,6 +483,101 @@ def metrics_history(names: Optional[List[str]] = None,
     return _gcs().call("metrics_history", names=names, limit=limit)
 
 
+def metrics_history_range(names: Optional[List[str]] = None,
+                          since_s: float = 600.0,
+                          tier: str = "raw") -> Dict[str, Any]:
+    """Lookback-window read of the GCS's durable tiered history
+    (_private/metrics_history.py): samples with wall ts within the last
+    `since_s` seconds from `tier` ("raw" | "30s" | "5min"), reaching
+    through the on-disk segments — including ones replayed from before
+    a GCS restart. Downsampled tiers carry counters as per-window
+    deltas and gauges as [min, mean, max]."""
+    return _gcs().call("metrics_history_range", names=names,
+                       since_s=since_s, tier=tier)
+
+
+def goodput(job: Optional[str] = None,
+            window_s: Optional[float] = None,
+            fresh: bool = False) -> Dict[str, Any]:
+    """Per-job goodput/badput ledger view (_private/goodput.py):
+    lifetime bucket totals from the harvested
+    `ray_tpu_goodput_seconds_total{job,bucket}` series plus each live
+    ledger's in-flight snapshot (current bucket + age), with
+    productive fraction per job. `window_s` restricts the totals to
+    the recent window by diffing the durable raw history tier instead
+    of lifetime counters. `fresh=True` harvests NOW first (sub-second
+    view for tests/CLI)."""
+    from ray_tpu._private.goodput import METRIC, SNAPSHOT_KEY
+    merged = cluster_metrics(fresh=fresh)
+    prefix = METRIC + "{"
+
+    def _tags(key: str) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for part in key[len(prefix):-1].split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                out[k] = v
+        return out
+
+    def _collect(series: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
+        jobs: Dict[str, Dict[str, float]] = {}
+        for key, v in series.items():
+            if not (key.startswith(prefix) and key.endswith("}")):
+                continue
+            if isinstance(v, (list, tuple)):
+                v = v[1]  # downsampled gauge artifact; counters are flat
+            tags = _tags(key)
+            j, b = tags.get("job"), tags.get("bucket")
+            if j and b:
+                jobs.setdefault(j, {})[b] = \
+                    jobs.get(j, {}).get(b, 0.0) + float(v)
+        return jobs
+
+    totals = _collect(merged.get("series", {}))
+    if window_s is not None:
+        hist = metrics_history_range(names=[METRIC],
+                                     since_s=float(window_s),
+                                     tier="raw")
+        samples = hist.get("samples") or []
+        if samples:
+            base = _collect(samples[0][1])
+            for j, buckets in totals.items():
+                jb = base.get(j, {})
+                for b in list(buckets):
+                    buckets[b] = max(0.0,
+                                     buckets[b] - jb.get(b, 0.0))
+    # live in-flight snapshots ride the harvest as a snapshot extra
+    inflight: Dict[str, Any] = {}
+    for snap in merged.get("procs", ()):
+        extra = snap.get(SNAPSHOT_KEY)
+        if extra:
+            for j, view in (extra.get("jobs") or {}).items():
+                inflight[j] = {"bucket": view.get("bucket"),
+                               "bucket_age_s": view.get("bucket_age_s"),
+                               "uptime_s": view.get("uptime_s"),
+                               "proc": snap.get("proc")}
+    jobs_out: Dict[str, Any] = {}
+    names = set(totals) | set(inflight)
+    for j in sorted(names):
+        if job is not None and j != job:
+            continue
+        buckets = totals.get(j, {})
+        accounted = sum(buckets.values())
+        productive = buckets.get("productive_step", 0.0)
+        jobs_out[j] = {
+            "buckets": {b: round(v, 3)
+                        for b, v in sorted(buckets.items())},
+            "accounted_s": round(accounted, 3),
+            "productive_s": round(productive, 3),
+            "productive_frac": round(productive / accounted, 4)
+            if accounted else None,
+            "in_flight": inflight.get(j),
+        }
+    return {"ts": merged.get("ts"),
+            "window_s": window_s,
+            "jobs": jobs_out}
+
+
 def metrics_configure(**knobs: Any) -> Dict[str, Any]:
     """Tune the GCS metrics plane + watchdog live, no restart
     (_private/metrics_plane.py configure): `interval_s`, `cooldown_s`,

@@ -1,0 +1,655 @@
+"""Goodput observatory (ISSUE 20): per-job productive/badput wall-time
+ledger + durable downsampled metrics history.
+
+Units: ledger bucket classification against a fake clock (exact
+totals), charge/borrow conservation, downsample-tier math (counter
+deltas across window boundaries, gauge min/mean/max), retention
+eviction bounds, crash-safe segment replay, the goodput_regression
+watchdog probe (fires within two harvests on a seeded feed stall,
+quiet on a healthy stream), ledger overhead (<1% of a 5 ms step), and
+the perf_report <-> ledger taxonomy reconciliation.
+
+Flagship (tier-1): a live 2-worker elastic JaxTrainer over a
+standalone persisted GCS; a chaos kill_worker preemption re-forms the
+gang and `util.state.goodput()` must attribute the recovery window to
+elastic_reconfig (not idle) alongside a real productive fraction; then
+the GCS restarts at the same address and `metrics_history_range` must
+still return the PRE-restart goodput series from the on-disk segments.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu._private import goodput
+from ray_tpu._private import metrics_history as mh
+from ray_tpu._private import metrics_plane as mp
+
+from tests.conftest import assert_ownership_drains
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# Ledger: classification, nesting, charge/borrow
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_classification_exact_totals():
+    """Seeded fake span stream -> exact bucket totals; the invariant
+    sum(buckets) == wall time since the ledger was born."""
+    clk = FakeClock()
+    led = goodput.GoodputLedger("job", time_fn=clk)
+    clk.advance(2.0)                       # unattributed -> idle
+    with led.bucket(goodput.PRODUCTIVE):
+        clk.advance(5.0)
+        with led.bucket("checkpoint_save"):  # innermost wins
+            clk.advance(1.5)
+        clk.advance(2.5)
+    clk.advance(1.0)                       # idle again
+    t = led.totals()
+    assert t["idle"] == pytest.approx(3.0)
+    assert t[goodput.PRODUCTIVE] == pytest.approx(7.5)
+    assert t["checkpoint_save"] == pytest.approx(1.5)
+    assert sum(t.values()) == pytest.approx(clk.t - 1000.0)
+
+
+def test_ledger_charge_borrows_and_clamps():
+    """charge() re-attributes time out of the open window; it can never
+    mint seconds that did not pass (clamped to the unaccounted span),
+    and the window's own bucket gets the remainder."""
+    clk = FakeClock()
+    led = goodput.GoodputLedger("j", time_fn=clk)
+    led.push(goodput.PRODUCTIVE)
+    clk.advance(4.0)
+    led.charge("compile", 1.0)     # the sentinel's compile event
+    led.charge("compile", 50.0)    # bogus duration: only 3.0s remain
+    clk.advance(2.0)
+    led.pop(goodput.PRODUCTIVE)
+    t = led.totals()
+    assert t["compile"] == pytest.approx(4.0)  # 1.0 + clamped 3.0
+    assert t[goodput.PRODUCTIVE] == pytest.approx(2.0)
+    assert sum(t.values()) == pytest.approx(6.0)
+
+
+def test_ledger_unbalanced_pop_unwinds():
+    """An exception that skips inner pops must not wedge the stack:
+    popping an outer name unwinds through the matching entry."""
+    clk = FakeClock()
+    led = goodput.GoodputLedger("j", time_fn=clk)
+    led.push("a")
+    led.push("b")
+    clk.advance(1.0)
+    led.pop("a")                   # unwinds b too
+    clk.advance(1.0)
+    t = led.totals()
+    assert t["b"] == pytest.approx(1.0)
+    assert t["idle"] == pytest.approx(1.0)
+    assert led.snapshot()["bucket"] == "idle"
+
+
+def test_ledger_flush_deltas_monotone():
+    clk = FakeClock()
+    led = goodput.GoodputLedger("j", time_fn=clk)
+    with led.bucket(goodput.PRODUCTIVE):
+        clk.advance(3.0)
+    d1 = led.flush_deltas()
+    assert d1[goodput.PRODUCTIVE] == pytest.approx(3.0)
+    assert not led.flush_deltas()  # nothing new accrued
+    clk.advance(2.0)
+    d2 = led.flush_deltas()
+    assert d2 == pytest.approx({"idle": 2.0})
+
+
+def test_module_api_noops_unbound_and_binds_per_thread():
+    """Library code instruments unconditionally: bucket()/charge() are
+    no-ops without a bound ledger, and bindings are thread-local."""
+    goodput.unbind()
+    with goodput.bucket(goodput.PRODUCTIVE):
+        pass
+    goodput.charge("compile", 1.0)
+    assert goodput.exit(goodput.enter("elastic_reconfig")) is None
+
+    clk = FakeClock()
+    led = goodput.GoodputLedger("tl", time_fn=clk)
+    led.bind()
+    try:
+        seen = []
+
+        def other():
+            seen.append(goodput.current())
+
+        th = threading.Thread(target=other)
+        th.start()
+        th.join()
+        assert seen == [None]          # binding did not leak threads
+        assert goodput.current() is led
+        tok = goodput.enter("elastic_reconfig")
+        clk.advance(2.0)
+        goodput.exit(tok)
+        assert led.totals()["elastic_reconfig"] == pytest.approx(2.0)
+    finally:
+        goodput.unbind()
+
+
+def test_ledger_overhead_under_one_percent_of_step():
+    """The always-on contract: a bucket transition (push+pop) must cost
+    well under 1% of a 5 ms training step — i.e. < 50 us mean, with
+    wide margin for a loaded CI box."""
+    led = goodput.GoodputLedger("bench")
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with led.bucket(goodput.PRODUCTIVE):
+            pass
+    per = (time.perf_counter() - t0) / n
+    assert per < 50e-6, f"bucket transition cost {per * 1e6:.1f}us"
+
+
+# ---------------------------------------------------------------------------
+# Durable tiered history
+# ---------------------------------------------------------------------------
+
+
+def _aligned_base(interval=30.0, back_windows=8):
+    """A window-aligned wall ts recent enough for range_query cutoffs."""
+    return (int(time.time() // interval) - back_windows) * interval
+
+
+def test_downsample_counter_deltas_and_gauge_minmeanmax(tmp_path):
+    hist = mh.TieredHistory(max_samples=100, dir=str(tmp_path / "h"))
+    kinds = {"c_total": "counter", "g": "gauge"}
+    t0 = _aligned_base()
+    # window 1: counter 10 -> 30, gauge 1/5/3
+    for dt, c, g in ((2, 10.0, 1.0), (12, 20.0, 5.0), (22, 30.0, 3.0)):
+        hist.append(t0 + dt, {"c_total": c, "g": g}, kinds=kinds)
+    # window 2: counter 50 -> 60 (base = 30, window 1's last)
+    for dt, c, g in ((32, 50.0, 2.0), (42, 60.0, 2.0)):
+        hist.append(t0 + dt, {"c_total": c, "g": g}, kinds=kinds)
+    hist.append(t0 + 62, {"c_total": 61.0, "g": 0.0}, kinds=kinds)
+
+    rows = hist.range_query(tier="30s", since_s=3600.0)
+    assert len(rows) == 2
+    (ts1, s1), (ts2, s2) = rows
+    assert ts1 == pytest.approx(t0 + 30) and ts2 == pytest.approx(t0 + 60)
+    # window 1 has no previous window: base falls back to the first
+    # value seen in-window (delta covers observed growth, 30 - 10)
+    assert s1["c_total"] == pytest.approx(20.0)
+    # window 2's base is window 1's LAST value: the 30 -> 50 growth
+    # that happened ACROSS the boundary lands in window 2
+    assert s2["c_total"] == pytest.approx(30.0)
+    assert s1["g"] == pytest.approx([1.0, 3.0, 5.0])  # [min, mean, max]
+    assert s2["g"] == pytest.approx([2.0, 2.0, 2.0])
+
+
+def test_history_replay_after_restart(tmp_path):
+    """Crash-safety: segments written tmp+fsync+rename are replayed on
+    construction — a new instance over the same dir serves the old
+    samples from both query() and range_query()."""
+    d = str(tmp_path / "h")
+    t0 = _aligned_base()
+    h1 = mh.TieredHistory(max_samples=50, dir=d, segment_samples=4)
+    for i in range(10):
+        h1.append(t0 + 2 * i, {"x_total": float(i), "g": float(i)},
+                  kinds={"x_total": "counter", "g": "gauge"})
+    h1.stop()  # flush pending segments (the GCS shutdown path)
+    assert h1.segments_written >= 2 and h1.write_errors == 0
+
+    h2 = mh.TieredHistory(max_samples=50, dir=d, segment_samples=4)
+    replayed = h2.query(names=["x_total"])
+    assert [s["x_total"] for _ts, s in replayed] == \
+        [float(i) for i in range(10)]
+    ranged = h2.range_query(names=["x_total"], since_s=3600.0)
+    assert len(ranged) == 10
+    # a torn segment (crash artifact) is skipped, not fatal
+    torn = os.path.join(d, "raw", "seg-000000000000001-999999.json")
+    with open(torn, "w") as f:
+        f.write('{"v":1,"tier":"raw","samples":[[1,')
+    h3 = mh.TieredHistory(max_samples=50, dir=d, segment_samples=4)
+    assert len(h3.query(names=["x_total"])) == 10
+
+
+def test_history_retention_eviction_bound(tmp_path):
+    """Old segments are evicted oldest-first once a tier exceeds its
+    byte budget; disk usage stays bounded and the newest segment is
+    never evicted."""
+    d = str(tmp_path / "h")
+    hist = mh.TieredHistory(max_samples=20, dir=d,
+                            retention_bytes=1 << 16,  # clamp floor: 64 KiB
+                            segment_samples=2)
+    t0 = _aligned_base(back_windows=40)
+    fat = {f"series_{i}_total": 1.0 for i in range(40)}  # ~1 KiB/sample
+    for i in range(400):
+        hist.append(t0 + 0.1 * i, dict(fat, tick=float(i)))
+    hist.flush()
+    assert hist.segments_evicted > 0
+    # raw tier budget is retention/2
+    assert hist.disk_usage() <= (1 << 16), hist.disk_usage()
+    assert hist._segment_files("raw"), "newest segment must survive"
+
+
+def test_history_forced_samples_ring_bounds():
+    """Forced samples ride the ring tagged, bounded by the 2x hard cap;
+    non-forced retention (max_samples) is unaffected by forced spam."""
+    hist = mh.TieredHistory(max_samples=4)
+    for i in range(8):
+        hist.append(float(i), {"v": float(i)}, forced=True)
+    for i in range(8, 12):
+        hist.append(float(i), {"v": float(i)}, forced=False)
+    rows = hist.query_ex()
+    assert len(rows) <= 8                       # 2 * max hard cap
+    assert sum(1 for r in rows if not r[2]) == 4  # all paced kept
+    assert [r[0] for r in rows if not r[2]] == [8.0, 9.0, 10.0, 11.0]
+
+
+def test_history_disk_failure_degrades_to_memory(tmp_path):
+    """A dead segment dir must not break the harvest: writes degrade to
+    memory-only and count write_errors."""
+    import shutil
+    d = str(tmp_path / "h")
+    hist = mh.TieredHistory(max_samples=10, dir=d, segment_samples=1)
+    raw = os.path.join(d, "raw")
+    shutil.rmtree(raw)
+    open(raw, "w").close()  # a file where the dir should be (root can
+    try:                    # still write through chmod, this it can't)
+        hist.append(time.time(), {"v": 1.0})
+        assert hist.write_errors >= 1
+        assert len(hist.query()) == 1  # the ring still has it
+    finally:
+        os.unlink(raw)
+
+
+def test_harvest_round_under_one_second_with_durable_writer(tmp_path):
+    """Acceptance: a forced harvest round, durable writer flushing a
+    segment EVERY round (segment_samples=1 via config), completes well
+    under 1s."""
+    from ray_tpu._private.config import Config
+
+    class _FakeGcs:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.nodes = {}
+            self.subscribers = {}
+
+        def _emit(self, *a, **k):
+            pass
+
+    old = (Config.metrics_history_dir, Config.metrics_history_segment_samples)
+    Config.metrics_history_dir = str(tmp_path / "hist")
+    Config.metrics_history_segment_samples = 1
+    try:
+        plane = mp.MetricsPlane(_FakeGcs())
+        try:
+            plane.collect()  # warm (registers, first fan-out)
+            t0 = time.perf_counter()
+            plane.collect()
+            dt = time.perf_counter() - t0
+            assert dt < 1.0, f"harvest round took {dt:.2f}s"
+            plane.history.flush()
+            assert plane.history.segments_written >= 1
+            assert plane.history.write_errors == 0
+        finally:
+            plane.stop()
+    finally:
+        Config.metrics_history_dir, \
+            Config.metrics_history_segment_samples = old
+
+
+# ---------------------------------------------------------------------------
+# goodput_regression watchdog probe
+# ---------------------------------------------------------------------------
+
+
+def _goodput_series(job, **buckets):
+    return {f"ray_tpu_goodput_seconds_total{{bucket={b},job={job}}}": v
+            for b, v in buckets.items()}
+
+
+def _make_goodput_watchdog(events, floor=0.5):
+    # window 0 => judged on per-harvest deltas: the probe fires on the
+    # FIRST post-baseline harvest that shows the regression
+    return mp.Watchdog(
+        emit=lambda et, msg, severity="INFO", **f:
+            events.append({"et": et, "msg": msg, "severity": severity,
+                           **f}),
+        cooldown_s=0.0, wait_edge_age_s=600.0,
+        store_occupancy_frac=0.95, queue_depth=1000,
+        goodput_floor=floor, goodput_window_s=0.0)
+
+
+def _goodput_alerts(events):
+    return [e for e in events if e.get("probe") == "goodput_regression"]
+
+
+def test_goodput_regression_probe_fires_on_seeded_feed_stall():
+    """A seeded feed-stall-dominated window alerts within 2 harvests
+    (one baseline + the regressing delta), ERROR severity, naming the
+    dominant badput bucket."""
+    events = []
+    wd = _make_goodput_watchdog(events)
+    wd.evaluate([], _goodput_series("j", productive_step=10.0), [],
+                interval_s=0.5)
+    assert not _goodput_alerts(events)  # baseline harvest: no judgment
+    time.sleep(0.01)
+    wd.evaluate([], _goodput_series("j", productive_step=10.5,
+                                    feed_stall=4.0), [], interval_s=0.5)
+    alerts = _goodput_alerts(events)
+    assert len(alerts) == 1, events
+    al = alerts[0]
+    assert al["severity"] == "ERROR"
+    assert al["job"] == "j" and al["dominant"] == "feed_stall"
+    assert "feed_stall" in al["msg"]
+    assert al["value"] < 0.5
+
+
+def test_goodput_regression_probe_quiet_on_healthy_stream():
+    events = []
+    wd = _make_goodput_watchdog(events)
+    cum = 0.0
+    for _ in range(4):
+        cum += 1.0
+        wd.evaluate([], _goodput_series("j", productive_step=cum,
+                                        checkpoint_save=0.1 * cum), [],
+                    interval_s=0.5)
+        time.sleep(0.01)
+    assert not _goodput_alerts(events)
+
+
+def test_goodput_regression_probe_skips_barely_live_jobs():
+    """A job accounted for under half the wall window (ledger just
+    appeared / gang gone) must not read as badput."""
+    events = []
+    wd = _make_goodput_watchdog(events)
+    wd.evaluate([], _goodput_series("j", idle=0.001), [], interval_s=0.5)
+    time.sleep(0.1)  # wall 0.1s >> 2 * the 0.002s accounted delta
+    wd.evaluate([], _goodput_series("j", idle=0.002), [], interval_s=0.5)
+    assert not _goodput_alerts(events)
+    # and a vanished job's window state is evicted
+    wd.evaluate([], {}, [], interval_s=0.5)
+    assert "j" not in wd._goodput_window
+
+
+# ---------------------------------------------------------------------------
+# perf_report reconciliation (standing consistency check)
+# ---------------------------------------------------------------------------
+
+
+def _trace_events(segments, pid="p", tid="t"):
+    return [{"ph": "X", "cat": "span", "pid": pid, "tid": tid,
+             "name": name, "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6}
+            for name, t0, t1 in segments]
+
+
+def test_perf_report_goodput_block_reconciles_with_ledger():
+    """The trace-derived goodput block and a ledger driven over the
+    SAME span timeline agree within 10% per bucket — the two vantages
+    (span coverage vs wall-clock classifier) must not drift."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools import perf_report
+
+    # one learner thread, 10s window: 6s stepping (with a nested rpc),
+    # 2s starved on the feed, 1s elastic re-form, 1s uncovered
+    segments = [
+        ("learner.update", 0.0, 4.0),
+        ("store.get", 1.0, 1.5),           # nests inside the update
+        ("feed.wait", 4.0, 6.0),
+        ("learner.update", 6.0, 8.0),
+        ("elastic.reform", 8.0, 9.0),
+        ("learner.warmup_marker", 9.9, 10.0),
+    ]
+    report = perf_report.attribute(_trace_events(segments))
+    gp = report["goodput"]
+    assert gp["window_s"] == pytest.approx(10.0)
+
+    # replay the same timeline into a ledger via the taxonomy map
+    clk = FakeClock()
+    led = goodput.GoodputLedger("trace", time_fn=clk)
+    cursor = 0.0
+    for name, t0, t1 in segments:
+        if name.startswith("store."):
+            continue  # nested inside learner.update: same goodput bucket
+        bucket = perf_report.GOODPUT_MAP[
+            perf_report._bucket_of(name) or "idle"]
+        clk.advance(t0 - cursor)  # gap -> idle
+        with led.bucket(bucket):
+            clk.advance(t1 - t0)
+        cursor = t1
+    totals = led.totals()
+    for bucket, trace_s in gp["buckets"].items():
+        assert totals.get(bucket, 0.0) == pytest.approx(
+            trace_s, rel=0.10, abs=0.05), (bucket, totals, gp)
+    assert gp["productive_frac"] == pytest.approx(
+        totals[goodput.PRODUCTIVE] / 10.0, rel=0.10)
+
+
+# ---------------------------------------------------------------------------
+# Flagship (tier-1): live elastic JaxTrainer + GCS restart durability
+# ---------------------------------------------------------------------------
+
+
+def _make_goodput_loop():
+    """Per-worker JaxTrainer loop (nested scope: cloudpickle ships it
+    by value). A jitted step so the sentinel's compile charge fires;
+    paced so the chaos kill lands mid-run."""
+
+    def loop(config):
+        import os as _os
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu import train as _train
+        from ray_tpu.train import Checkpoint as _Checkpoint
+
+        ctx = _train.get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        assert jax.process_count() == world
+
+        params = jnp.float32(100.0)
+        start = 0
+        ckpt = _train.get_checkpoint()
+        if ckpt:
+            meta = ckpt.get_metadata()
+            start = meta.get("step", -1) + 1
+            params = jnp.float32(meta.get("params", 100.0))
+
+        @jax.jit
+        def step_fn(p):
+            return p * 0.9
+
+        for step in range(start, config["steps"]):
+            params = step_fn(params)
+            loss = float(params) ** 2
+            _time.sleep(0.15)  # the per-step compute window
+            with open(config["progress"] + f".r{rank}", "a") as f:
+                f.write(f"{step},{world},{loss:.6f}\n")
+            if rank == 0:
+                cdir = _os.path.join(config["base"],
+                                     f"wip_{step}_{_os.getpid()}")
+                _os.makedirs(cdir, exist_ok=True)
+                c = _Checkpoint(cdir)
+                c.update_metadata({"step": step,
+                                   "params": float(params)})
+                _train.report({"step": step, "loss": loss},
+                              checkpoint=c)
+            else:
+                _train.report({"step": step, "loss": loss})
+
+    return loop
+
+
+def _wait(pred, deadline_s, what):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_flagship_elastic_goodput_and_history_survive_gcs_restart(
+        tmp_path):
+    """ISSUE 20 acceptance: a 2-worker elastic JaxTrainer over a
+    persisted standalone GCS. A chaos kill_worker preemption re-forms
+    the gang; `util.state.goodput()` must report a productive fraction
+    with the recovery window attributed to elastic_reconfig (not
+    idle). Then the GCS restarts at the same address mid-session and
+    `metrics_history_range` must still serve the pre-restart goodput
+    series from the replayed on-disk segments."""
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.node_manager import NodeManager
+    from ray_tpu.train import (DataParallelTrainer, FailureConfig,
+                               JaxTrainer, RunConfig, ScalingConfig)
+    from ray_tpu.train.jax_backend import JaxConfig
+    from ray_tpu.util import state as state_api
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    goodput._reset_for_tests()
+
+    steps_total = 40
+    progress = str(tmp_path / "progress")
+    persist = str(tmp_path / "gcs.snapshot")
+    gcs = GcsServer(persist_path=persist)
+    host, port = gcs.address
+    nm = NodeManager(gcs.address, session_dir=str(tmp_path / "sess"),
+                     resources={"CPU": 4, "trainslot": 3}, is_head=True)
+    gcs2 = None
+    fit_result = []
+    harvest_s = 0.5
+    try:
+        ray_tpu.init(address=f"{host}:{port}")
+        chaos.clear()
+        state_api.metrics_configure(interval_s=harvest_s,
+                                    cooldown_s=0.1)
+
+        trainer = JaxTrainer(
+            _make_goodput_loop(),
+            train_loop_config={"steps": steps_total,
+                               "base": str(tmp_path),
+                               "progress": progress},
+            jax_config=JaxConfig(distributed=True, coordinator_port=0),
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"trainslot": 1.0},
+                elastic_min_workers=1,
+                elastic_reform_timeout_s=15.0),
+            run_config=RunConfig(
+                storage_path=str(tmp_path), name="goodput_flagship",
+                failure_config=FailureConfig(max_failures=4)))
+        t = threading.Thread(
+            target=lambda: fit_result.append(trainer.fit()),
+            daemon=True)
+        t.start()
+
+        def _rows():
+            p = progress + ".r0"
+            if not os.path.exists(p):
+                return []
+            return [ln.split(",") for ln in
+                    open(p).read().splitlines() if ln]
+
+        # phase 1: world-2 training underway
+        _wait(lambda: len(_rows()) >= 3 and _rows()[-1][1] == "2",
+              90, "world-2 training")
+
+        # phase 2: preempt one gang member -> elastic re-form
+        steps_before = len(_rows())
+        chaos.inject("kill_worker", actor_class="RayTrainWorker",
+                     max_fires=1)
+        _wait(lambda: len(_rows()) >= steps_before + 2,
+              90, "post-preemption resume")
+
+        # phase 3: the goodput view. productive fraction is real, and
+        # the kill->re-form window landed in elastic_reconfig — NOT in
+        # idle-only accounting
+        view = _wait(
+            lambda: (lambda v:
+                     v if v.get("jobs", {}).get("goodput_flagship", {})
+                     .get("buckets", {}).get("elastic_reconfig", 0) > 0
+                     else None)(state_api.goodput(fresh=True)),
+            30, "elastic_reconfig attribution in state.goodput")
+        job = view["jobs"]["goodput_flagship"]
+        assert job["productive_frac"] is not None
+        assert job["buckets"].get("productive_step", 0.0) > 0.0, job
+        assert job["buckets"]["elastic_reconfig"] > 0.0, job
+        assert job["in_flight"] is not None, job
+
+        # windowed view draws from the same raw tier
+        windowed = state_api.goodput(job="goodput_flagship",
+                                     window_s=300.0)
+        assert "goodput_flagship" in windowed["jobs"]
+
+        # the CLI surfaces the same report
+        from ray_tpu.scripts import cli
+        rc = cli.main(["goodput", "--address", f"{host}:{port}",
+                       "--job", "goodput_flagship", "--format", "json"])
+        assert rc == 0
+
+        # phase 4: run to completion (bounded by steps_total)
+        t.join(timeout=120)
+        assert not t.is_alive(), "fit() never finished"
+        assert fit_result and fit_result[0].error is None, \
+            f"run failed: {fit_result[0].error!r}"
+
+        # phase 5: the goodput series is on disk. Restart the GCS at
+        # the SAME address; the replayed segments must serve the
+        # pre-restart series through metrics_history_range.
+        state_api.cluster_metrics(fresh=True)  # one final harvest
+        pre = state_api.metrics_history_range(
+            names=[goodput.METRIC], since_s=600.0, tier="raw")
+        pre_rows = [(ts, s) for ts, s in pre["samples"] if s]
+        assert pre_rows, "no goodput series in the durable history"
+        t_restart = time.time()
+
+        gcs.shutdown()
+        time.sleep(0.5)
+        gcs2 = GcsServer(host=host, port=port, persist_path=persist)
+        _wait(lambda: [n for n in gcs2.get_all_nodes() if n.alive],
+              30, "node re-register after GCS restart")
+
+        post = state_api.metrics_history_range(
+            names=[goodput.METRIC], since_s=600.0, tier="raw")
+        old_rows = [(ts, s) for ts, s in post["samples"]
+                    if s and ts < t_restart]
+        assert old_rows, \
+            "pre-restart goodput series lost across the GCS restart"
+        # the replayed values are the pre-restart counters themselves
+        last_ts, last_series = old_rows[-1]
+        assert any(v > 0 for v in last_series.values()), last_series
+        # downsampled tier is queryable too (may be empty on a short
+        # run — the call itself must succeed, and reject bad tiers)
+        state_api.metrics_history_range(names=[goodput.METRIC],
+                                        since_s=3600.0, tier="30s")
+        with pytest.raises(Exception):
+            state_api.metrics_history_range(tier="nope")
+    finally:
+        chaos.clear()
+        try:
+            ray_tpu.shutdown()
+        finally:
+            nm.shutdown()
+            for g in (gcs, gcs2):
+                try:
+                    if g is not None:
+                        g.shutdown()
+                except Exception:
+                    pass
+    goodput._reset_for_tests()

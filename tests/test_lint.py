@@ -946,7 +946,8 @@ def test_rule_catalogue_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == [f"RT00{i}" for i in range(1, 10)] + \
         ["RT010", "RT011", "RT012", "RT013", "RT014", "RT015", "RT016",
-         "RT017", "RT018", "RT019", "RT020", "RT021", "RT022", "RT023"]
+         "RT017", "RT018", "RT019", "RT020", "RT021", "RT022", "RT023",
+         "RT024"]
     assert all(r.rationale for r in ALL_RULES)
 
 
@@ -2211,3 +2212,62 @@ def test_cli_unknown_rule_id_exits_2():
     from ray_tpu.lint.__main__ import main
     assert main([".", "--select=RT999"]) == 2
     assert main([".", "--ignore=RT01,RT002"]) == 2
+
+
+# ---- RT024 unattributed sleep in goodput-instrumented path ---------------
+
+RT024_POS = """
+    import time
+    from ray_tpu._private import goodput
+
+    def train_loop(feed):
+        while True:
+            with goodput.bucket(goodput.PRODUCTIVE):
+                step(feed)
+            time.sleep(0.5)
+"""
+
+RT024_NEG_WRAPPED = """
+    import time
+    from ray_tpu._private import goodput
+
+    def train_loop(feed):
+        while True:
+            with goodput.bucket(goodput.PRODUCTIVE):
+                step(feed)
+            with goodput.bucket("feed_stall"):
+                time.sleep(0.5)
+"""
+
+RT024_NEG_UNINSTRUMENTED = """
+    import time
+
+    def pacing_loop():
+        while True:
+            poll()
+            time.sleep(0.5)
+"""
+
+RT024_SUPPRESSED = """
+    import time
+    from ray_tpu._private import goodput
+
+    def train_loop(feed):
+        with goodput.bucket(goodput.PRODUCTIVE):
+            step(feed)
+        time.sleep(0.5)  # graftlint: disable=RT024
+"""
+
+
+def test_rt024_bare_sleep_in_instrumented_loop_flagged():
+    fs = [f for f in findings(RT024_POS) if f.rule_id == "RT024"]
+    assert len(fs) == 1
+    assert "train_loop" in fs[0].message
+    assert "unattributed" in fs[0].message
+
+
+@pytest.mark.parametrize("src", [RT024_NEG_WRAPPED,
+                                 RT024_NEG_UNINSTRUMENTED,
+                                 RT024_SUPPRESSED])
+def test_rt024_wrapped_uninstrumented_and_suppressed_fine(src):
+    assert "RT024" not in rules_hit(src)

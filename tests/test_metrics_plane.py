@@ -326,9 +326,12 @@ def test_watchdog_lease_probe_backlog_variant_alerts():
     assert not _lease_alerts(events2)
 
 
-def test_forced_rounds_do_not_distort_history_ring():
-    """metrics_collect / dump rounds between sampler ticks must not
-    shrink the ring's samples x interval_s retention window."""
+def test_forced_rounds_land_tagged_not_dropped():
+    """metrics_collect / dump rounds between sampler ticks land in the
+    ring TAGGED forced (so `ray_tpu top` sparklines have no gaps) and
+    are excluded only from rate computation — the old time-gate dropped
+    them entirely, blinding the history to anything a forced harvest
+    observed."""
     class _FakeGcs:
         def __init__(self):
             self._lock = threading.Lock()
@@ -342,8 +345,14 @@ def test_forced_rounds_do_not_distort_history_ring():
     try:
         for _ in range(3):
             plane.collect()  # forced harvest-NOW rounds, ms apart
-        assert len(plane.history.query()) == 1, \
-            "forced rounds must be time-gated out of the history ring"
+        out = plane.query_history()
+        assert len(out["samples"]) == 3, \
+            "forced rounds must land in the history ring"
+        assert len(out["forced"]) == 3
+        # sub-interval spacing: at most the first round counts as paced;
+        # the rest must carry the forced tag so rates skip them
+        assert sum(1 for f in out["forced"] if not f) <= 1
+        assert out["forced"][-1] is True
     finally:
         plane.stop()
 

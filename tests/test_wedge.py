@@ -560,6 +560,15 @@ def test_wedge_flagship_sigstop_detect_kill_reform(ray_start,
 
         # the stall fired exactly once and was accounted
         assert sum(r["fired"] for r in chaos.list_rules()) == 1
+
+        # PR 20: the wedge-recovery window landed in the goodput ledger
+        # as wedge_recovery — not phantom idle — alongside real
+        # productive_step time from the result rounds
+        from ray_tpu._private import goodput as goodput_mod
+        gsum = goodput_mod.summary().get("wedge_flagship")
+        assert gsum is not None, goodput_mod.summary().keys()
+        assert gsum["buckets"].get("wedge_recovery", 0.0) > 0.0, gsum
+        assert gsum["buckets"].get("productive_step", 0.0) > 0.0, gsum
     finally:
         chaos.clear()
         # restore the config DEFAULT (monkeypatch teardown runs after

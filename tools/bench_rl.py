@@ -59,7 +59,12 @@ def bench_ppo_cartpole(seconds: float) -> dict:
     """BASELINE config #1: PPO CartPole-v1, single in-process learner."""
     import jax
 
+    from ray_tpu._private import goodput
     from ray_tpu.rllib.algorithms.ppo.ppo import PPOConfig
+
+    # bind a goodput ledger on the driving thread so LearnerGroup.update
+    # and the sentinel's compile charges classify this run's wall time
+    goodput.ledger("bench_ppo").bind()
 
     cfg = (PPOConfig()
            .environment("CartPole-v1")
@@ -100,8 +105,10 @@ def bench_ppo_cartpole(seconds: float) -> dict:
         "learn_phase_s": round(times.get("update", 0.0), 2),
         "sample_phase_s": round(times.get("sample_sync", 0.0), 2),
         "episode_return_mean": _ret_mean(last),
+        "goodput": goodput.summary().get("bench_ppo"),
     }
     algo.stop()
+    goodput.unbind()
     return result
 
 
@@ -111,6 +118,7 @@ def bench_impala_minipong(seconds: float) -> dict:
     import jax
 
     import ray_tpu
+    from ray_tpu._private import goodput
     from ray_tpu.rllib.algorithms.impala.impala import ImpalaConfig
 
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
@@ -164,6 +172,9 @@ def bench_impala_minipong(seconds: float) -> dict:
         "learner_busy_s": round(busy_s, 2),
         "episode_return_mean": _ret_mean(last),
         "num_healthy_env_runners": last.get("num_healthy_env_runners"),
+        # the learner thread binds the "impala" ledger: its wall time
+        # split into productive/compile/feed_stall/idle
+        "goodput": goodput.summary().get("impala"),
     }
     algo.stop()
 

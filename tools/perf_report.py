@@ -169,7 +169,45 @@ def attribute(events: List[Dict[str, Any]],
         "attributed_pct": round(100.0 * attributed / window, 2)
         if window else 0.0,
     }
+    report["goodput"] = goodput_view(report)
     return report
+
+
+# trace bucket -> goodput ledger bucket (_private/goodput.py). The two
+# accountings observe the same loop from different vantages — the trace
+# from span coverage of the learner thread, the ledger from its own
+# wall-clock classifier — so on a chaos-free run they must agree within
+# tolerance (tests/test_goodput.py keeps that as a standing check).
+# Compute spans all map to productive_step: from the ledger's vantage
+# the gang is stepping whether the step-internal microsecond went to
+# XLA, the feed pipeline, or a store RPC.
+GOODPUT_MAP: Dict[str, str] = {
+    "learner_compute": "productive_step",
+    "device_feed": "productive_step",
+    "store_rpc": "productive_step",
+    "host_sync": "productive_step",
+    "rollout_wait": "feed_stall",
+    "elastic_reconfig": "elastic_reconfig",
+    "idle": "idle",
+}
+
+
+def goodput_view(report: Dict[str, Any]) -> Dict[str, Any]:
+    """Project the trace attribution into the goodput ledger's bucket
+    taxonomy so the two can be reconciled (see README "Goodput &
+    metrics history")."""
+    buckets: Dict[str, float] = {}
+    for b, rec in report["buckets"].items():
+        gb = GOODPUT_MAP.get(b, "idle")
+        buckets[gb] = buckets.get(gb, 0.0) + rec["seconds"]
+    window = report.get("window_s") or 0.0
+    productive = buckets.get("productive_step", 0.0)
+    return {
+        "window_s": window,
+        "buckets": {b: round(s, 6) for b, s in sorted(buckets.items())},
+        "productive_frac": round(productive / window, 4)
+        if window else None,
+    }
 
 
 def format_text(report: Dict[str, Any]) -> str:
@@ -182,6 +220,11 @@ def format_text(report: Dict[str, Any]) -> str:
                      f"{rec['pct']:6.2f}%")
     lines.append(f"  attributed: {report['attributed_pct']:.2f}% "
                  f"(idle = {report['buckets']['idle']['pct']:.2f}%)")
+    gp = report.get("goodput")
+    if gp and gp.get("productive_frac") is not None:
+        lines.append("  goodput: productive "
+                     f"{100 * gp['productive_frac']:.1f}% of window "
+                     "(ledger taxonomy; `ray_tpu goodput` compares)")
     return "\n".join(lines)
 
 
